@@ -1,0 +1,81 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+A brand-new, TPU-first framework (JAX/XLA/pjit/Pallas idioms) providing the
+capabilities of the PaddlePaddle Fluid reference stack: a declarative layer/op
+library with autodiff and optimizers, a compiled single-device executor
+(replacing the Fluid op-loop Executor, reference
+``paddle/fluid/framework/executor.cc:50-490``), data-parallel training over
+ICI/DCN collectives (replacing the NCCL ParallelExecutor, reference
+``paddle/fluid/framework/parallel_executor.cc:134``), variable-length-sequence
+support (LoD-equivalent), async host data pipelines, checkpoint/resume,
+profiling, metrics, and a benchmark CLI.
+
+Architecture: programs are pure Python functions traced by JAX into a single
+XLA executable per (program, shapes) — there is no per-op interpreter.
+Parallelism is expressed with ``jax.sharding.Mesh`` + ``pjit``/``shard_map``
+and compiled XLA collectives instead of a hand-scheduled SSA graph over NCCL.
+"""
+
+from paddle_tpu.version import __version__
+
+from paddle_tpu.core import config, enforce, dtypes, unique_name
+from paddle_tpu.core.enforce import EnforceError, enforce as check
+from paddle_tpu import framework
+from paddle_tpu.framework import (
+    build,
+    name_scope,
+    Model,
+    create_parameter,
+    create_state,
+)
+from paddle_tpu import initializer
+from paddle_tpu import regularizer
+from paddle_tpu import clip
+from paddle_tpu import ops
+from paddle_tpu import layers
+from paddle_tpu import optimizer
+from paddle_tpu import lr_scheduler
+from paddle_tpu import backward
+from paddle_tpu.executor import Executor
+from paddle_tpu import reader
+from paddle_tpu import metrics
+from paddle_tpu import io
+from paddle_tpu import checkpoint
+from paddle_tpu import parallel
+from paddle_tpu.parallel import DataParallel
+
+CPUPlace = config.CPUPlace
+TPUPlace = config.TPUPlace
+
+__all__ = [
+    "__version__",
+    "config",
+    "enforce",
+    "dtypes",
+    "unique_name",
+    "EnforceError",
+    "check",
+    "framework",
+    "build",
+    "name_scope",
+    "Model",
+    "create_parameter",
+    "create_state",
+    "initializer",
+    "regularizer",
+    "clip",
+    "ops",
+    "layers",
+    "optimizer",
+    "lr_scheduler",
+    "backward",
+    "Executor",
+    "reader",
+    "metrics",
+    "io",
+    "checkpoint",
+    "parallel",
+    "DataParallel",
+    "CPUPlace",
+    "TPUPlace",
+]

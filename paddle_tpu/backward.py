@@ -1,0 +1,68 @@
+"""Autodiff entry points — the ``append_backward`` equivalent.
+
+Reference: ``python/paddle/fluid/backward.py:469`` (append_backward rewrites
+the program with grad ops from per-op C++ makers, dedups with sum ops, prunes
+no-grad branches) and ``backward.py:685`` (calc_gradient). TPU-native: the
+backward pass is ``jax.grad``/``jax.vjp`` over the traced program — XLA does
+the dedup/pruning/scheduling. These wrappers keep the reference API shape
+(loss in, grads-by-param-name out) and handle the state collection.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.framework import Model, Variables
+
+
+def append_backward(
+    model: Model,
+    *args,
+    state: Optional[dict] = None,
+    rng=None,
+    no_grad_set: Optional[set] = None,
+    **kwargs,
+):
+    """Return a function params → (loss, (grads, new_state, aux)) for the
+    model whose first (or only) output is the scalar loss.
+
+    ``no_grad_set`` (param names) mirrors the reference's no_grad pruning:
+    those leaves get zero gradients and are excluded from differentiation.
+    """
+
+    def loss_fn(params, state_in):
+        out, new_state = model.apply(
+            Variables(params, state_in or {}), *args, rng=rng, is_train=True, **kwargs
+        )
+        loss = out[0] if isinstance(out, (tuple, list)) else out
+        return jnp.mean(loss), (new_state, out)
+
+    def run(params, state_in=None):
+        diff = {k: v for k, v in params.items() if not no_grad_set or k not in no_grad_set}
+        frozen = {k: v for k, v in params.items() if no_grad_set and k in no_grad_set}
+
+        def fn(p):
+            return loss_fn({**p, **frozen}, state_in if state_in is not None else state)
+
+        (loss, (new_state, out)), grads = jax.value_and_grad(fn, has_aux=True)(diff)
+        grads.update({k: jnp.zeros_like(v) for k, v in frozen.items()})
+        return loss, (grads, new_state, out)
+
+    return run
+
+
+def calc_gradient(fn: Callable, argnums=0):
+    """Gradient of an arbitrary traced function (reference calc_gradient)."""
+    return jax.grad(fn, argnums=argnums)
+
+
+def value_and_grad(fn: Callable, argnums=0, has_aux: bool = False):
+    return jax.value_and_grad(fn, argnums=argnums, has_aux=has_aux)
+
+
+def stop_gradient(x):
+    """Reference ``@GRAD`` blocking / stop_gradient attr."""
+    return jax.lax.stop_gradient(x)

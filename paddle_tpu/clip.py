@@ -1,0 +1,63 @@
+"""Gradient clipping.
+
+Reference: ``python/paddle/fluid/clip.py`` — GradientClipByValue /
+GradientClipByNorm / GradientClipByGlobalNorm appended as graph ops.
+TPU-native: pure pytree transforms applied inside the compiled update step.
+Under data parallelism the global norm is computed on the *already psum-ed*
+gradients, so all replicas clip identically (the reference relied on
+allreduce-before-clip ordering for the same property).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+class GradientClipBase:
+    def __call__(self, grads: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        raise NotImplementedError
+
+
+class GradientClipByValue(GradientClipBase):
+    def __init__(self, max: float, min: float | None = None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, grads):
+        return {k: jnp.clip(g, self.min, self.max) for k, g in grads.items()}
+
+
+class GradientClipByNorm(GradientClipBase):
+    """Per-tensor L2-norm clip."""
+
+    def __init__(self, clip_norm: float):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads):
+        def clip_one(g):
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(norm, 1e-12))
+            return (g.astype(jnp.float32) * scale).astype(g.dtype)
+
+        return {k: clip_one(g) for k, g in grads.items()}
+
+
+class GradientClipByGlobalNorm(GradientClipBase):
+    """Global-norm clip across the whole grad pytree."""
+
+    def __init__(self, clip_norm: float):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads):
+        leaves = [g.astype(jnp.float32) for g in jax.tree_util.tree_leaves(grads)]
+        global_norm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(global_norm, 1e-12))
+        return jax.tree_util.tree_map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
+
+
+def global_norm(grads) -> jax.Array:
+    leaves = [g.astype(jnp.float32) for g in jax.tree_util.tree_leaves(grads)]
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))

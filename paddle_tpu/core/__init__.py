@@ -1,0 +1,18 @@
+"""Core substrate: places/config, error enforcement, dtypes, naming, logging.
+
+TPU-native replacement for the reference platform layer
+(``paddle/fluid/platform/`` — Place variants ``platform/place.h:134``,
+DeviceContextPool ``platform/device_context.h:198``, PADDLE_ENFORCE
+``platform/enforce.h``, gflags init ``platform/init.cc:76``). On TPU the
+device context / stream / allocator machinery is owned by XLA+PJRT, so this
+layer reduces to: typed run configuration and flags, error macros, dtype
+policy, unique naming, logging, and profiler hooks.
+"""
+
+from paddle_tpu.core import config
+from paddle_tpu.core import dtypes
+from paddle_tpu.core import enforce
+from paddle_tpu.core import logging as ptlog
+from paddle_tpu.core import unique_name
+
+__all__ = ["config", "dtypes", "enforce", "ptlog", "unique_name"]

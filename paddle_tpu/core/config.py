@@ -1,0 +1,128 @@
+"""Typed flags/config and Place abstraction.
+
+Reference: scattered gflags (``framework/scope.cc:23-34``,
+``platform/gpu_info.cc:22``, ``operator.cc:28`` check_nan_inf, etc.), Python
+``core.init_gflags`` passthrough, and the Place variant
+(``platform/place.h:134`` CPUPlace/CUDAPlace/CUDAPinnedPlace).
+
+TPU-native design: one frozen-ish dataclass of flags, settable from env vars
+(``PADDLE_TPU_<NAME>``) or programmatically; Places reduce to CPU vs TPU and
+resolve to jax devices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Flags:
+    """Global runtime flags (gflags parity, typed)."""
+
+    # verbosity for vlog()
+    v: int = 0
+    # numeric sanitizer: check NaN/Inf on fetched outputs (FLAGS_check_nan_inf,
+    # reference operator.cc:28,725-737). In-graph via jax_debug_nans is separate.
+    check_nan_inf: bool = False
+    # print per-step timing/memory like FLAGS_benchmark (executor.cc:399-401)
+    benchmark: bool = False
+    # mixed precision: bf16 compute for matmul/conv (MXU-native)
+    use_bf16_compute: bool = False
+    # default seed for program-level RNG when none is given
+    seed: int = 0
+    # host data pipeline: prefetch depth of the device double-buffer
+    # (reference double_buffer reader, operators/reader/buffered_reader.cc)
+    prefetch_depth: int = 2
+    # directory for profiler traces
+    profile_dir: str = "/tmp/paddle_tpu_profile"
+
+    @staticmethod
+    def _coerce(value: str, typ):
+        if typ is bool:
+            return value.lower() in ("1", "true", "yes", "on")
+        return typ(value)
+
+    def load_env(self) -> "Flags":
+        """Override fields from PADDLE_TPU_<UPPERNAME> env vars."""
+        for f in dataclasses.fields(self):
+            env = os.environ.get(f"PADDLE_TPU_{f.name.upper()}")
+            if env is not None:
+                setattr(self, f.name, self._coerce(env, f.type if isinstance(f.type, type) else type(getattr(self, f.name))))
+        return self
+
+
+_flags = Flags().load_env()
+
+
+def flags() -> Flags:
+    return _flags
+
+
+def set_flags(**kwargs) -> None:
+    for k, v in kwargs.items():
+        if not hasattr(_flags, k):
+            raise AttributeError(f"unknown flag {k!r}")
+        setattr(_flags, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Places. On TPU the real device topology is owned by jax/PJRT; Place is a
+# thin user-facing selector kept for API parity with fluid.CPUPlace()/
+# fluid.CUDAPlace(i) call sites.
+# ---------------------------------------------------------------------------
+
+
+class Place:
+    platform: str = "cpu"
+
+    def device(self):
+        import jax
+
+        devs = [d for d in jax.devices() if _platform_matches(d, self.platform)]
+        if not devs:
+            # fall back to whatever the default backend offers
+            devs = jax.devices()
+        return devs[getattr(self, "device_id", 0) % len(devs)]
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and getattr(self, "device_id", 0) == getattr(other, "device_id", 0)
+
+    def __hash__(self):
+        return hash((type(self).__name__, getattr(self, "device_id", 0)))
+
+
+def _platform_matches(dev, platform: str) -> bool:
+    p = dev.platform.lower()
+    if platform == "tpu":
+        # 'axon' is the tunneled TPU platform name in this environment
+        return p in ("tpu", "axon")
+    return p == platform
+
+
+class CPUPlace(Place):
+    platform = "cpu"
+
+
+class TPUPlace(Place):
+    platform = "tpu"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"TPUPlace({self.device_id})"
+
+
+def default_place() -> Place:
+    """TPU if available, else CPU — mirrors fluid's cuda-if-compiled default."""
+    import jax
+
+    for d in jax.devices():
+        if _platform_matches(d, "tpu"):
+            return TPUPlace(0)
+    return CPUPlace()

@@ -1,0 +1,70 @@
+"""Dtype registry and mixed-precision policy.
+
+Reference: Fluid's VarType/proto dtypes (``paddle/fluid/framework/framework.proto``)
+and the handwritten ``platform/float16.h`` (1084 LoC of CUDA fp16 intrinsics).
+On TPU, bf16 is native MXU input; the policy object decides compute/param/
+output dtypes per the standard mixed-precision recipe: params fp32, compute
+bf16, reductions fp32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype name → jnp dtype (mirrors VarType enum coverage).
+_DTYPES = {
+    "bool": jnp.bool_,
+    "int8": jnp.int8,
+    "uint8": jnp.uint8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+}
+
+
+def convert(dtype) -> np.dtype:
+    """Resolve a string/np/jnp dtype to a canonical numpy dtype object."""
+    if isinstance(dtype, str):
+        if dtype not in _DTYPES:
+            raise KeyError(f"unknown dtype name {dtype!r}; known: {sorted(_DTYPES)}")
+        return np.dtype(_DTYPES[dtype])
+    return np.dtype(dtype)
+
+
+def is_floating(dtype) -> bool:
+    return np.issubdtype(convert(dtype), np.floating) or convert(dtype) == np.dtype(jnp.bfloat16)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Mixed-precision policy: where each dtype class is used.
+
+    TPU-first default: keep parameters and optimizer state in fp32, run
+    matmul/conv compute in bf16 (MXU native), accumulate/reduce in fp32.
+    """
+
+    param_dtype: np.dtype = np.dtype(np.float32)
+    compute_dtype: np.dtype = np.dtype(np.float32)
+    accum_dtype: np.dtype = np.dtype(np.float32)
+
+    def cast_to_compute(self, x):
+        if is_floating(x.dtype) and x.dtype != self.compute_dtype:
+            return x.astype(self.compute_dtype)
+        return x
+
+
+FP32 = Policy()
+MIXED_BF16 = Policy(compute_dtype=np.dtype(jnp.bfloat16))
+
+
+def default_policy() -> Policy:
+    from paddle_tpu.core import config
+
+    return MIXED_BF16 if config.flags().use_bf16_compute else FP32

@@ -1,0 +1,52 @@
+"""Error enforcement — replacement for PADDLE_ENFORCE macros.
+
+Reference: ``paddle/fluid/platform/enforce.h`` (PADDLE_ENFORCE* with
+demangled stack traces, ``enforce.h:72-120``). Python tracebacks already
+carry the stack; we add structured context (op name, expected/actual) so
+failures inside traced/jitted code are still diagnosable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+
+class EnforceError(RuntimeError):
+    """Raised when an enforce check fails (PADDLE_ENFORCE parity)."""
+
+    def __init__(self, message: str, *, op: Optional[str] = None):
+        self.op = op
+        if op:
+            message = f"[op:{op}] {message}"
+        super().__init__(message)
+
+
+def enforce(cond: Any, message: str = "enforce failed", *, op: Optional[str] = None) -> None:
+    """PADDLE_ENFORCE(cond, msg): raise EnforceError when ``cond`` is falsy.
+
+    ``cond`` must be a host-side (static) value — do not pass traced arrays;
+    use ``jax.debug`` / ``checkify`` for in-graph checks.
+    """
+    if not cond:
+        raise EnforceError(message, op=op)
+
+
+def enforce_eq(a: Any, b: Any, message: str = "", *, op: Optional[str] = None) -> None:
+    if a != b:
+        raise EnforceError(f"expected {a!r} == {b!r}. {message}", op=op)
+
+
+def enforce_in(value: Any, allowed: Sequence[Any], what: str = "value", *, op: Optional[str] = None) -> None:
+    if value not in allowed:
+        raise EnforceError(f"{what} must be one of {list(allowed)!r}, got {value!r}", op=op)
+
+
+def enforce_rank(shape: Sequence[int], rank: int, what: str = "input", *, op: Optional[str] = None) -> None:
+    if len(shape) != rank:
+        raise EnforceError(f"{what} must have rank {rank}, got shape {tuple(shape)}", op=op)
+
+
+def not_none(value: Any, what: str = "value", *, op: Optional[str] = None) -> Any:
+    if value is None:
+        raise EnforceError(f"{what} must not be None", op=op)
+    return value

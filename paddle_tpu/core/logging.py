@@ -1,0 +1,43 @@
+"""Logging — replacement for glog VLOG / pretty_log.
+
+Reference: glog usage throughout the C++ core (``pybind.cc:513`` InitGLOG)
+and ``paddle/fluid/string/pretty_log.h``. Maps to stdlib logging with a
+VLOG-style verbosity gate controlled by the ``v`` flag.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_logger = logging.getLogger("paddle_tpu")
+if not _logger.handlers:
+    _h = logging.StreamHandler(sys.stderr)
+    _h.setFormatter(logging.Formatter("[%(levelname).1s %(asctime)s paddle_tpu] %(message)s", "%H:%M:%S"))
+    _logger.addHandler(_h)
+    _logger.setLevel(logging.INFO)
+    _logger.propagate = False
+
+
+def get_logger() -> logging.Logger:
+    return _logger
+
+
+def vlog(level: int, msg: str, *args) -> None:
+    """VLOG(level): emitted when flags().v >= level."""
+    from paddle_tpu.core import config
+
+    if config.flags().v >= level:
+        _logger.info(msg, *args)
+
+
+def info(msg: str, *args) -> None:
+    _logger.info(msg, *args)
+
+
+def warning(msg: str, *args) -> None:
+    _logger.warning(msg, *args)
+
+
+def error(msg: str, *args) -> None:
+    _logger.error(msg, *args)

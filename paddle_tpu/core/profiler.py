@@ -1,0 +1,97 @@
+"""Profiling: host-side RAII annotations + jax.profiler device traces.
+
+Reference: ``platform/profiler.h:73-91`` (RecordEvent/RecordBlock RAII),
+``platform/profiler.cc:476`` aggregation tables, CUPTI DeviceTracer
+(``platform/device_tracer.h:49-103``), Python context managers
+``python/paddle/fluid/profiler.py:125-221``.
+
+TPU-native mapping: device-side tracing is jax.profiler (XPlane/Perfetto,
+viewable in TensorBoard/xprof); host-side step breakdown keeps the RAII
+annotation idiom via ``record_event`` which both feeds a host aggregation
+table and emits a TraceAnnotation visible in device traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Iterator, Optional
+
+import jax
+
+_events: dict[str, list[float]] = defaultdict(list)
+_enabled: bool = False
+
+
+@contextlib.contextmanager
+def record_event(name: str) -> Iterator[None]:
+    """RAII host annotation (RecordEvent parity). Cheap when disabled."""
+    if not _enabled:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+        return
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    _events[name].append(time.perf_counter() - t0)
+
+
+def enable_profiler() -> None:
+    global _enabled
+    _enabled = True
+    _events.clear()
+
+
+def disable_profiler() -> dict[str, dict[str, float]]:
+    """Stop host profiling and return the aggregation table
+    (name → {calls, total_s, mean_s, min_s, max_s}), mirroring the sorted
+    summary of reference ``profiler.cc:476``."""
+    global _enabled
+    _enabled = False
+    table = {}
+    for name, times in _events.items():
+        table[name] = {
+            "calls": len(times),
+            "total_s": sum(times),
+            "mean_s": sum(times) / len(times),
+            "min_s": min(times),
+            "max_s": max(times),
+        }
+    return table
+
+
+def summary_string(table: Optional[dict] = None) -> str:
+    table = table if table is not None else disable_profiler()
+    rows = sorted(table.items(), key=lambda kv: -kv[1]["total_s"])
+    lines = [f"{'Event':40s} {'Calls':>8s} {'Total(s)':>10s} {'Mean(ms)':>10s}"]
+    for name, s in rows:
+        lines.append(f"{name:40s} {s['calls']:8d} {s['total_s']:10.4f} {s['mean_s'] * 1e3:10.3f}")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profiler(log_dir: Optional[str] = None) -> Iterator[None]:
+    """Device-trace context manager (fluid.profiler.profiler parity):
+    captures a jax.profiler trace (XPlane) into ``log_dir`` and host events."""
+    from paddle_tpu.core import config
+
+    log_dir = log_dir or config.flags().profile_dir
+    enable_profiler()
+    with jax.profiler.trace(log_dir):
+        yield
+    from paddle_tpu.core import logging as ptlog
+
+    ptlog.info("profiler trace written to %s\n%s", log_dir, summary_string())
+
+
+def start_profiler(log_dir: Optional[str] = None) -> None:
+    from paddle_tpu.core import config
+
+    enable_profiler()
+    jax.profiler.start_trace(log_dir or config.flags().profile_dir)
+
+
+def stop_profiler() -> dict:
+    jax.profiler.stop_trace()
+    return disable_profiler()

@@ -1,0 +1,318 @@
+"""Program/parameter framework — the TPU-native replacement for Fluid's
+Program/Block/Operator graph builder.
+
+Reference: ``python/paddle/fluid/framework.py:207,496,923`` (Program/Block/
+Operator/Variable/Parameter), ``python/paddle/fluid/layer_helper.py`` (param
+creation plumbing), ``paddle/fluid/framework/program_desc.h:30`` (ProgramDesc).
+
+Design: instead of appending OpDescs to a mutable program, a model is a plain
+Python function that calls layer functions; :func:`build` wraps it into a
+:class:`Model` with pure ``init``/``apply`` functions suitable for ``jax.jit``
+/ ``pjit``. Parameters are named leaves in a flat dict pytree — the name
+hierarchy (``name_scope``) mirrors Fluid's block/parameter naming so that
+checkpoints and param-sharding rules can address parameters by name. Mutable
+non-trainable state (e.g. BatchNorm moving stats, reference
+``operators/batch_norm_op.cc``) lives in a separate "state" collection and is
+threaded functionally: ``apply`` returns ``(output, new_state)``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, NamedTuple, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import dtypes as dtypes_mod
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.enforce import EnforceError, enforce
+
+
+class ParamAttr:
+    """Per-parameter attributes (reference ``python/paddle/fluid/param_attr.py``):
+    name, initializer, regularizer, trainable, learning-rate multiplier, plus a
+    TPU-native addition: a logical sharding spec (tuple of mesh-axis names or
+    None per dim) consumed by ``paddle_tpu.parallel``."""
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        initializer=None,
+        regularizer=None,
+        trainable: bool = True,
+        learning_rate: float = 1.0,
+        sharding: Optional[Tuple[Optional[str], ...]] = None,
+    ):
+        self.name = name
+        self.initializer = initializer
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.learning_rate = learning_rate
+        self.sharding = sharding
+
+    @staticmethod
+    def to_attr(attr: Union["ParamAttr", str, bool, None]) -> "ParamAttr":
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return ParamAttr(trainable=False)
+        return ParamAttr()
+
+
+@dataclasses.dataclass
+class ParamInfo:
+    """Static metadata recorded at creation time for each parameter."""
+
+    name: str
+    shape: Tuple[int, ...]
+    dtype: np.dtype
+    trainable: bool = True
+    learning_rate: float = 1.0
+    regularizer: Any = None
+    sharding: Optional[Tuple[Optional[str], ...]] = None
+
+
+class Variables(NamedTuple):
+    """The full variable set of a model: trainable params + mutable state."""
+
+    params: Dict[str, jax.Array]
+    state: Dict[str, jax.Array]
+
+
+class _Frame:
+    def __init__(self, mode: str, params, state, rng, is_train: bool):
+        assert mode in ("init", "apply")
+        self.mode = mode
+        self.params: Dict[str, jax.Array] = params
+        self.state: Dict[str, jax.Array] = state
+        self.new_state: Dict[str, jax.Array] = {}
+        self.param_info: Dict[str, ParamInfo] = {}
+        self.rng = rng
+        self.rng_counter = 0
+        self.is_train = is_train
+        self.name_stack: list[str] = []
+        self.generator = unique_name.Generator()
+
+
+_tls = threading.local()
+
+
+def _current_frame() -> _Frame:
+    frame = getattr(_tls, "frame", None)
+    if frame is None:
+        raise EnforceError(
+            "no active framework frame: layer functions that create parameters "
+            "must run inside Model.init/Model.apply (wrap your network with "
+            "paddle_tpu.build)"
+        )
+    return frame
+
+
+def in_frame() -> bool:
+    return getattr(_tls, "frame", None) is not None
+
+
+def is_training() -> bool:
+    """Whether the current trace is a training-mode apply (dropout/BN switch)."""
+    return _current_frame().is_train
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str):
+    """Hierarchical name scope (fluid.name_scope parity, ``framework.py`` tail).
+    Scope names are uniquified per frame so loops create block_0, block_1, ..."""
+    frame = _current_frame()
+    scoped = frame.generator.generate("/".join(frame.name_stack + [prefix]))
+    leaf = scoped.rsplit("/", 1)[-1] if "/" in scoped else scoped
+    frame.name_stack.append(leaf)
+    try:
+        yield
+    finally:
+        frame.name_stack.pop()
+
+
+def _full_name(frame: _Frame, key: str, given: Optional[str]) -> str:
+    if given is not None:
+        base = "/".join(frame.name_stack + [given])
+        return base
+    return frame.generator.generate("/".join(frame.name_stack + [key]))
+
+
+def next_rng_key() -> jax.Array:
+    """Fold a fresh PRNG key off the frame key (dropout, random ops)."""
+    frame = _current_frame()
+    if frame.rng is None:
+        raise EnforceError(
+            "this program uses randomness (dropout/random ops): pass rng= to "
+            "Model.init/Model.apply"
+        )
+    frame.rng_counter += 1
+    return jax.random.fold_in(frame.rng, frame.rng_counter)
+
+
+def create_parameter(
+    shape: Sequence[int],
+    dtype="float32",
+    name: Optional[str] = None,
+    attr: Union[ParamAttr, str, None] = None,
+    default_initializer=None,
+) -> jax.Array:
+    """Create (init mode) or fetch (apply mode) a named parameter.
+
+    Mirrors LayerHelper.create_parameter (reference
+    ``python/paddle/fluid/layer_helper.py``): resolves ParamAttr, applies the
+    default initializer (Xavier for weights unless overridden), records
+    regularizer/lr-mult metadata for the optimizer.
+    """
+    from paddle_tpu import initializer as init_mod
+
+    frame = _current_frame()
+    attr = ParamAttr.to_attr(attr)
+    np_dtype = dtypes_mod.convert(dtype)
+    full = _full_name(frame, "param", attr.name or name)
+    shape = tuple(int(s) for s in shape)
+
+    info = ParamInfo(
+        name=full,
+        shape=shape,
+        dtype=np_dtype,
+        trainable=attr.trainable,
+        learning_rate=attr.learning_rate,
+        regularizer=attr.regularizer,
+        sharding=attr.sharding,
+    )
+    frame.param_info[full] = info
+
+    if frame.mode == "init":
+        if full in frame.params:
+            raise EnforceError(f"duplicate parameter name {full!r}")
+        initializer = attr.initializer or default_initializer or init_mod.Xavier()
+        frame.rng_counter += 1
+        if frame.rng is not None:
+            key = jax.random.fold_in(frame.rng, frame.rng_counter)
+        else:
+            # no rng given: still break symmetry between parameters by
+            # folding the creation counter into the flag-seeded key
+            from paddle_tpu.core import config as _cfg
+
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(_cfg.flags().seed), frame.rng_counter
+            )
+        frame.params[full] = initializer(key, shape, np_dtype)
+        return frame.params[full]
+    if full not in frame.params:
+        raise EnforceError(
+            f"parameter {full!r} not found in provided params; model structure "
+            "must match between init and apply"
+        )
+    value = frame.params[full]
+    if tuple(value.shape) != shape:
+        raise EnforceError(
+            f"parameter {full!r} shape mismatch: created with {shape}, got {tuple(value.shape)}"
+        )
+    return value
+
+
+def create_state(
+    name: str,
+    shape: Sequence[int],
+    dtype="float32",
+    init: Optional[Callable[[Tuple[int, ...], Any], jax.Array]] = None,
+) -> jax.Array:
+    """Create/fetch a mutable (non-trainable) state entry, e.g. BN moving mean."""
+    frame = _current_frame()
+    np_dtype = dtypes_mod.convert(dtype)
+    full = _full_name(frame, name, name)
+    shape = tuple(int(s) for s in shape)
+    if frame.mode == "init":
+        value = (init or (lambda s, d: jnp.zeros(s, d)))(shape, np_dtype)
+        frame.state[full] = value
+        return value
+    if full not in frame.state:
+        raise EnforceError(f"state {full!r} missing from provided state dict")
+    return frame.new_state.get(full, frame.state[full])
+
+
+def update_state(name: str, value) -> None:
+    """Record a new value for a state entry, addressed by the same local name
+    (within the same name_scope) it was created with."""
+    frame = _current_frame()
+    scoped = "/".join(frame.name_stack + [name])
+    full = scoped if (scoped in frame.state or scoped in frame.new_state) else name
+    if frame.mode == "init":
+        if full not in frame.state:
+            raise EnforceError(f"unknown state {name!r} (create_state first)")
+        return  # init keeps the initial value
+    if full not in frame.state:
+        raise EnforceError(f"unknown state {name!r} (create_state first)")
+    frame.new_state[full] = value
+
+
+class Model:
+    """A built program: pure ``init`` and ``apply`` (jit/pjit-compatible).
+
+    Replaces the (ProgramDesc → Executor) pair: ``apply`` traced under
+    ``jax.jit`` becomes the single compiled XLA executable that the reference
+    ran as a per-op interpreter loop (``framework/executor.cc:354``).
+    """
+
+    def __init__(self, fn: Callable, name: Optional[str] = None):
+        self._fn = fn
+        self.name = name or getattr(fn, "__name__", "model")
+        self.param_info: Dict[str, ParamInfo] = {}
+
+    def init(self, rng: Optional[jax.Array] = None, *args, **kwargs) -> Variables:
+        if isinstance(rng, int):
+            rng = jax.random.PRNGKey(rng)
+        frame = _Frame("init", params={}, state={}, rng=rng, is_train=True)
+        prev = getattr(_tls, "frame", None)
+        _tls.frame = frame
+        try:
+            with unique_name.guard(frame.generator):
+                self._fn(*args, **kwargs)
+        finally:
+            _tls.frame = prev
+        self.param_info = frame.param_info
+        return Variables(params=frame.params, state=frame.state)
+
+    def apply(
+        self,
+        variables: Union[Variables, Dict[str, jax.Array], Tuple],
+        *args,
+        rng: Optional[jax.Array] = None,
+        is_train: bool = False,
+        **kwargs,
+    ):
+        """Run the program. Returns ``(output, new_state)``."""
+        if isinstance(variables, Variables):
+            params, state = variables.params, variables.state
+        elif isinstance(variables, tuple) and len(variables) == 2:
+            params, state = variables
+        else:
+            params, state = variables, {}
+        if isinstance(rng, int):
+            rng = jax.random.PRNGKey(rng)
+        frame = _Frame("apply", params=params, state=state, rng=rng, is_train=is_train)
+        prev = getattr(_tls, "frame", None)
+        _tls.frame = frame
+        try:
+            with unique_name.guard(frame.generator):
+                out = self._fn(*args, **kwargs)
+        finally:
+            _tls.frame = prev
+        if not self.param_info:
+            self.param_info = frame.param_info
+        new_state = dict(state)
+        new_state.update(frame.new_state)
+        return out, new_state
+
+
+def build(fn: Callable, name: Optional[str] = None) -> Model:
+    """Wrap a layer-calling function into a Model (the transform)."""
+    return Model(fn, name=name)

@@ -1,0 +1,139 @@
+"""Parameter initializers.
+
+Reference: ``python/paddle/fluid/initializer.py`` (Constant/Uniform/Normal/
+TruncatedNormal/Xavier/MSRA/Bilinear, implemented there as startup-program
+init *ops*). TPU-native: pure functions ``(key, shape, dtype) -> array``
+evaluated inside ``Model.init`` — the whole init is one compiled program
+rather than a startup ProgramDesc.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _fan_in_out(shape: Tuple[int, ...]) -> Tuple[int, int]:
+    # Convention matches the reference (initializer.py _compute_fans): for
+    # conv weights [H, W, Cin, Cout] (our NHWC layout) receptive field
+    # multiplies both fans; for matrices [in, out].
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[:-2]))
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+class Initializer:
+    def __call__(self, key: jax.Array, shape: Sequence[int], dtype) -> jax.Array:
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype=jnp.float32, minval=self.low, maxval=self.high).astype(dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, loc: float = 0.0, scale: float = 1.0):
+        self.loc, self.scale = loc, scale
+
+    def __call__(self, key, shape, dtype):
+        return (self.loc + self.scale * jax.random.normal(key, shape, dtype=jnp.float32)).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, loc: float = 0.0, scale: float = 1.0):
+        self.loc, self.scale = loc, scale
+
+    def __call__(self, key, shape, dtype):
+        x = jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype=jnp.float32)
+        return (self.loc + self.scale * x).astype(dtype)
+
+
+class Xavier(Initializer):
+    """Glorot init (reference XavierInitializer): uniform or normal scaled by
+    fan_in+fan_out."""
+
+    def __init__(self, uniform: bool = True, fan_in: Optional[int] = None, fan_out: Optional[int] = None):
+        self.uniform = uniform
+        self.fan_in, self.fan_out = fan_in, fan_out
+
+    def __call__(self, key, shape, dtype):
+        fin, fout = _fan_in_out(tuple(shape))
+        fin = self.fan_in or fin
+        fout = self.fan_out or fout
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fin + fout))
+            x = jax.random.uniform(key, shape, dtype=jnp.float32, minval=-limit, maxval=limit)
+        else:
+            std = math.sqrt(2.0 / (fin + fout))
+            x = std * jax.random.normal(key, shape, dtype=jnp.float32)
+        return x.astype(dtype)
+
+
+class MSRA(Initializer):
+    """He init (reference MSRAInitializer), fan_in scaled."""
+
+    def __init__(self, uniform: bool = True, fan_in: Optional[int] = None):
+        self.uniform = uniform
+        self.fan_in = fan_in
+
+    def __call__(self, key, shape, dtype):
+        fin, _ = _fan_in_out(tuple(shape))
+        fin = self.fan_in or fin
+        if self.uniform:
+            limit = math.sqrt(6.0 / fin)
+            x = jax.random.uniform(key, shape, dtype=jnp.float32, minval=-limit, maxval=limit)
+        else:
+            std = math.sqrt(2.0 / fin)
+            x = std * jax.random.normal(key, shape, dtype=jnp.float32)
+        return x.astype(dtype)
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel for conv_transpose (reference
+    BilinearInitializer) — weight shape [H, W, Cin, Cout] NHWC."""
+
+    def __call__(self, key, shape, dtype):
+        shape = tuple(shape)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D conv weight")
+        h, w = shape[0], shape[1]
+        f = math.ceil(w / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        grid_h = np.arange(h)
+        grid_w = np.arange(w)
+        filt = (1 - np.abs(grid_h / f - c))[:, None] * (1 - np.abs(grid_w / f - c))[None, :]
+        weight = np.zeros(shape, dtype=np.float32)
+        for i in range(min(shape[2], shape[3])):
+            weight[:, :, i, i] = filt
+        return jnp.asarray(weight, dtype=dtype)
+
+
+# Fluid-style aliases
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+XavierInitializer = Xavier
+MSRAInitializer = MSRA
+BilinearInitializer = Bilinear

@@ -1,0 +1,102 @@
+"""Model persistence: params and inference-model export.
+
+Reference: ``python/paddle/fluid/io.py:89-506`` (save/load_vars/params/
+persistables via save/load ops), ``io.py:544`` save_inference_model (prune to
+feed/fetch targets + serialize ProgramDesc), ``io.py:670``
+load_inference_model; C++ twins ``operators/save_op.cc``/``load_op.cc``.
+
+TPU-native: parameters serialize as a flat name→array archive (.npz, with a
+JSON manifest carrying dtype/shape/framework version — the analogue of the
+LoDTensor version+header stream, ``lod_tensor.cc`` SerializeToStream). The
+inference "program" artifact is a serialized StableHLO module from
+``jax.export`` — loadable from Python or from the C++ PJRT serving runtime.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+from paddle_tpu.core import logging as ptlog
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.framework import Model, Variables
+from paddle_tpu.version import __version__
+
+_MANIFEST = "manifest.json"
+_PARAMS_FILE = "params.npz"
+_STATE_FILE = "state.npz"
+_HLO_FILE = "program.stablehlo"
+
+
+def _save_dict(d: Dict[str, jax.Array], path: str) -> None:
+    np.savez(path, **{k: np.asarray(v) for k, v in d.items()})
+
+
+def _load_dict(path: str) -> Dict[str, np.ndarray]:
+    with np.load(path, allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def save_params(dirname: str, variables: Variables, filename_prefix: str = "") -> None:
+    """save_persistables parity: trainable params + mutable state."""
+    os.makedirs(dirname, exist_ok=True)
+    _save_dict(variables.params, os.path.join(dirname, filename_prefix + _PARAMS_FILE))
+    _save_dict(variables.state, os.path.join(dirname, filename_prefix + _STATE_FILE))
+    manifest = {
+        "framework_version": __version__,
+        "params": {k: {"shape": list(np.shape(v)), "dtype": str(np.asarray(v).dtype)} for k, v in variables.params.items()},
+        "state": {k: {"shape": list(np.shape(v)), "dtype": str(np.asarray(v).dtype)} for k, v in variables.state.items()},
+    }
+    with open(os.path.join(dirname, filename_prefix + _MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=1)
+
+
+def load_params(dirname: str, filename_prefix: str = "") -> Variables:
+    params = _load_dict(os.path.join(dirname, filename_prefix + _PARAMS_FILE))
+    state_path = os.path.join(dirname, filename_prefix + _STATE_FILE)
+    state = _load_dict(state_path) if os.path.exists(state_path) else {}
+    return Variables(params={k: jax.numpy.asarray(v) for k, v in params.items()},
+                     state={k: jax.numpy.asarray(v) for k, v in state.items()})
+
+
+def save_inference_model(
+    dirname: str,
+    model: Model,
+    variables: Variables,
+    example_args: Sequence[Any],
+    rng=None,
+) -> None:
+    """Export an inference program (reference save_inference_model): the
+    model is traced in eval mode with params baked as constants-free inputs,
+    serialized as StableHLO bytes + the weights archive."""
+    os.makedirs(dirname, exist_ok=True)
+
+    def infer_fn(params, state, *args):
+        out, _ = model.apply(Variables(params, state), *args, rng=rng, is_train=False)
+        return out
+
+    exported = jax.export.export(jax.jit(infer_fn))(
+        variables.params, variables.state, *example_args
+    )
+    with open(os.path.join(dirname, _HLO_FILE), "wb") as f:
+        f.write(exported.serialize())
+    save_params(dirname, variables)
+    ptlog.info("inference model saved to %s", dirname)
+
+
+def load_inference_model(dirname: str) -> Tuple[Callable, Variables]:
+    """Returns (callable(params, state, *args), variables). The callable is
+    the deserialized compiled program (reference load_inference_model)."""
+    with open(os.path.join(dirname, _HLO_FILE), "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    variables = load_params(dirname)
+
+    def run(*args):
+        return exported.call(variables.params, variables.state, *args)
+
+    return run, variables

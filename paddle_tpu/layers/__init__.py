@@ -1,0 +1,382 @@
+"""Declarative layer API — the ``fluid.layers`` equivalent.
+
+Reference: ``python/paddle/fluid/layers/nn.py`` (~190 layer functions that
+append OpDescs + create params via LayerHelper). Here each layer function
+creates/fetches named parameters through ``paddle_tpu.framework`` and returns
+the computed array immediately — the "program" is the enclosing Python
+function, compiled as one XLA executable by the Executor.
+
+Layout note: images are NHWC (TPU-native). ``data_format='NCHW'`` inputs are
+transposed on entry for compatibility with reference model configs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import framework, initializer as init_mod
+from paddle_tpu.core.enforce import enforce, enforce_in
+from paddle_tpu.framework import ParamAttr, create_parameter, create_state, name_scope, update_state
+from paddle_tpu.ops import math as om
+from paddle_tpu.ops import nn as on
+from paddle_tpu.ops import rnn as orn
+from paddle_tpu.ops import sequence as oseq
+from paddle_tpu.ops import attention as oattn
+
+# functional ops re-exported under layers.* for fluid.layers parity
+from paddle_tpu.ops.math import *  # noqa: F401,F403
+from paddle_tpu.ops.nn import (  # noqa: F401
+    softmax,
+    log_softmax,
+    cross_entropy,
+    softmax_with_cross_entropy,
+    sigmoid_cross_entropy_with_logits,
+    square_error_cost,
+    smooth_l1,
+    huber_loss,
+    kldiv_loss,
+    log_loss,
+    accuracy,
+    one_hot,
+    label_smooth,
+    l2_normalize,
+    cos_sim,
+    lrn,
+    pad2d,
+    resize_bilinear,
+    resize_nearest,
+    pixel_shuffle,
+)
+from paddle_tpu.ops.sequence import (  # noqa: F401
+    sequence_pool,
+    sequence_softmax,
+    sequence_reverse,
+    sequence_first_step,
+    sequence_last_step,
+    sequence_expand,
+)
+
+
+_ACTS = {
+    None: lambda x: x,
+    "relu": om.relu,
+    "relu6": om.relu6,
+    "sigmoid": om.sigmoid,
+    "tanh": om.tanh,
+    "softmax": on.softmax,
+    "gelu": om.gelu,
+    "leaky_relu": om.leaky_relu,
+    "swish": om.swish,
+    "elu": om.elu,
+}
+
+
+def _act(x, act: Optional[str]):
+    if act not in _ACTS:
+        raise KeyError(f"unknown activation {act!r}; known: {sorted(k for k in _ACTS if k)}")
+    return _ACTS[act](x)
+
+
+def _to_nhwc(x, data_format: str):
+    return jnp.transpose(x, (0, 2, 3, 1)) if data_format == "NCHW" else x
+
+
+def _from_nhwc(x, data_format: str):
+    return jnp.transpose(x, (0, 3, 1, 2)) if data_format == "NCHW" else x
+
+
+def fc(
+    input: jax.Array,
+    size: int,
+    num_flatten_dims: int = 1,
+    param_attr=None,
+    bias_attr=None,
+    act: Optional[str] = None,
+    name: Optional[str] = None,
+) -> jax.Array:
+    """Fully-connected layer (reference ``layers/nn.py`` fc → mul+sum ops).
+    Flattens trailing dims from ``num_flatten_dims`` into the matmul axis."""
+    with name_scope(name or "fc"):
+        lead = input.shape[:num_flatten_dims]
+        in_dim = 1
+        for s in input.shape[num_flatten_dims:]:
+            in_dim *= s
+        x2 = input.reshape((-1, in_dim))
+        w = create_parameter([in_dim, size], input.dtype, name="w", attr=param_attr)
+        out = jnp.matmul(x2, w, preferred_element_type=jnp.float32).astype(input.dtype)
+        if bias_attr is not False:
+            b = create_parameter(
+                [size], input.dtype, name="b", attr=bias_attr, default_initializer=init_mod.Constant(0.0)
+            )
+            out = out + b
+        out = out.reshape(tuple(lead) + (size,))
+        return _act(out, act)
+
+
+def embedding(
+    input: jax.Array,
+    size: Sequence[int],
+    param_attr=None,
+    padding_idx: Optional[int] = None,
+    dtype="float32",
+    name: Optional[str] = None,
+) -> jax.Array:
+    """Embedding lookup (reference ``lookup_table_op``); grads are dense
+    scatter-adds on TPU rather than SelectedRows."""
+    with name_scope(name or "embedding"):
+        table = create_parameter(
+            list(size), dtype, name="w", attr=param_attr, default_initializer=init_mod.Xavier()
+        )
+        return on.embedding_lookup(table, input, padding_idx=padding_idx)
+
+
+def conv2d(
+    input: jax.Array,
+    num_filters: int,
+    filter_size: Union[int, Sequence[int]],
+    stride: Union[int, Sequence[int]] = 1,
+    padding: Union[int, Sequence[int], str] = 0,
+    dilation: Union[int, Sequence[int]] = 1,
+    groups: int = 1,
+    param_attr=None,
+    bias_attr=None,
+    act: Optional[str] = None,
+    data_format: str = "NHWC",
+    name: Optional[str] = None,
+) -> jax.Array:
+    with name_scope(name or "conv2d"):
+        x = _to_nhwc(input, data_format)
+        kh, kw = (filter_size, filter_size) if isinstance(filter_size, int) else tuple(filter_size)
+        cin = x.shape[-1]
+        enforce(cin % groups == 0, f"channels {cin} not divisible by groups {groups}")
+        w = create_parameter(
+            [kh, kw, cin // groups, num_filters],
+            x.dtype,
+            name="w",
+            attr=param_attr,
+            default_initializer=init_mod.MSRA(uniform=False),
+        )
+        out = on.conv2d(x, w, stride=stride, padding=padding, dilation=dilation, groups=groups)
+        if bias_attr is not False:
+            b = create_parameter(
+                [num_filters], x.dtype, name="b", attr=bias_attr, default_initializer=init_mod.Constant(0.0)
+            )
+            out = out + b
+        out = _act(out, act)
+        return _from_nhwc(out, data_format)
+
+
+def conv2d_transpose(
+    input: jax.Array,
+    num_filters: int,
+    filter_size: Union[int, Sequence[int]],
+    stride: Union[int, Sequence[int]] = 1,
+    padding: Union[int, Sequence[int]] = 0,
+    param_attr=None,
+    bias_attr=None,
+    act: Optional[str] = None,
+    data_format: str = "NHWC",
+    name: Optional[str] = None,
+) -> jax.Array:
+    with name_scope(name or "conv2d_transpose"):
+        x = _to_nhwc(input, data_format)
+        kh, kw = (filter_size, filter_size) if isinstance(filter_size, int) else tuple(filter_size)
+        w = create_parameter(
+            [kh, kw, x.shape[-1], num_filters],
+            x.dtype,
+            name="w",
+            attr=param_attr,
+            default_initializer=init_mod.Xavier(),
+        )
+        out = on.conv2d_transpose(x, w, stride=stride, padding=padding)
+        if bias_attr is not False:
+            b = create_parameter(
+                [num_filters], x.dtype, name="b", attr=bias_attr, default_initializer=init_mod.Constant(0.0)
+            )
+            out = out + b
+        out = _act(out, act)
+        return _from_nhwc(out, data_format)
+
+
+def pool2d(
+    input: jax.Array,
+    pool_size: Union[int, Sequence[int]] = 2,
+    pool_type: str = "max",
+    pool_stride: Union[int, Sequence[int]] = 1,
+    pool_padding: Union[int, Sequence[int]] = 0,
+    global_pooling: bool = False,
+    ceil_mode: bool = False,
+    exclusive: bool = True,
+    data_format: str = "NHWC",
+) -> jax.Array:
+    x = _to_nhwc(input, data_format)
+    out = on.pool2d(
+        x,
+        pool_size=pool_size,
+        pool_type=pool_type,
+        pool_stride=pool_stride,
+        pool_padding=pool_padding,
+        ceil_mode=ceil_mode,
+        exclusive=exclusive,
+        global_pooling=global_pooling,
+    )
+    return _from_nhwc(out, data_format)
+
+
+def batch_norm(
+    input: jax.Array,
+    act: Optional[str] = None,
+    momentum: float = 0.9,
+    epsilon: float = 1e-5,
+    param_attr=None,
+    bias_attr=None,
+    is_test: Optional[bool] = None,
+    data_format: str = "NHWC",
+    name: Optional[str] = None,
+) -> jax.Array:
+    """BatchNorm with moving stats in the state collection (reference
+    ``operators/batch_norm_op.cc``; fluid kept stats as persistable vars
+    updated in-place — here they thread through ``apply``'s new_state)."""
+    with name_scope(name or "batch_norm"):
+        x = _to_nhwc(input, data_format)
+        c = x.shape[-1]
+        scale = create_parameter([c], "float32", name="scale", attr=param_attr, default_initializer=init_mod.Constant(1.0))
+        bias = create_parameter([c], "float32", name="bias", attr=bias_attr, default_initializer=init_mod.Constant(0.0))
+        mean = create_state("moving_mean", [c], "float32", init=lambda s, d: jnp.zeros(s, d))
+        var = create_state("moving_variance", [c], "float32", init=lambda s, d: jnp.ones(s, d))
+        training = framework.is_training() if is_test is None else (not is_test)
+        if training:
+            y, new_mean, new_var, _, _ = on.batch_norm_train(x, scale, bias, mean, var, momentum, epsilon)
+            update_state("moving_mean", new_mean)
+            update_state("moving_variance", new_var)
+        else:
+            y = on.batch_norm_infer(x, scale, bias, mean, var, epsilon)
+        return _from_nhwc(_act(y, act), data_format)
+
+
+def layer_norm(
+    input: jax.Array,
+    scale: bool = True,
+    shift: bool = True,
+    begin_norm_axis: int = 1,
+    epsilon: float = 1e-5,
+    param_attr=None,
+    bias_attr=None,
+    name: Optional[str] = None,
+) -> jax.Array:
+    with name_scope(name or "layer_norm"):
+        norm_shape = input.shape[begin_norm_axis:]
+        dim = 1
+        for s in norm_shape:
+            dim *= s
+        g = (
+            create_parameter([dim], "float32", name="scale", attr=param_attr, default_initializer=init_mod.Constant(1.0))
+            if scale
+            else None
+        )
+        b = (
+            create_parameter([dim], "float32", name="bias", attr=bias_attr, default_initializer=init_mod.Constant(0.0))
+            if shift
+            else None
+        )
+        flat = input.reshape(input.shape[:begin_norm_axis] + (dim,))
+        out = on.layer_norm(flat, g, b, begin_norm_axis=-1, epsilon=epsilon)
+        return out.reshape(input.shape)
+
+
+def dropout(x: jax.Array, dropout_prob: float, is_test: Optional[bool] = None, name=None) -> jax.Array:
+    training = framework.is_training() if is_test is None else (not is_test)
+    return on.dropout(x, dropout_prob, is_test=not training)
+
+
+def prelu(x: jax.Array, mode: str = "all", param_attr=None, name=None) -> jax.Array:
+    with name_scope(name or "prelu"):
+        enforce_in(mode, ["all", "channel", "element"], "prelu mode")
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            shape = [x.shape[-1]]
+        else:
+            shape = list(x.shape[1:])
+        alpha = create_parameter(shape, x.dtype, name="alpha", attr=param_attr, default_initializer=init_mod.Constant(0.25))
+        return on.prelu(x, alpha)
+
+
+def dynamic_lstm(
+    input: jax.Array,
+    size: int,
+    lengths: Optional[jax.Array] = None,
+    param_attr=None,
+    bias_attr=None,
+    is_reverse: bool = False,
+    name: Optional[str] = None,
+):
+    """LSTM over padded [B, T, D] (reference ``dynamic_lstm`` layer; here
+    ``size`` is the hidden size H, weights [D,4H]/[H,4H]). Returns
+    (hidden [B,T,H], (h_final, c_final))."""
+    with name_scope(name or "lstm"):
+        d = input.shape[-1]
+        w_ih = create_parameter([d, 4 * size], input.dtype, name="w_ih", attr=param_attr)
+        w_hh = create_parameter([size, 4 * size], input.dtype, name="w_hh", attr=param_attr)
+        b = (
+            create_parameter([4 * size], input.dtype, name="b", attr=bias_attr, default_initializer=init_mod.Constant(0.0))
+            if bias_attr is not False
+            else None
+        )
+        outs, final = orn.dynamic_lstm(input, w_ih, w_hh, b, lengths=lengths, reverse=is_reverse)
+        return outs, final
+
+
+def dynamic_gru(
+    input: jax.Array,
+    size: int,
+    lengths: Optional[jax.Array] = None,
+    param_attr=None,
+    bias_attr=None,
+    is_reverse: bool = False,
+    name: Optional[str] = None,
+):
+    with name_scope(name or "gru"):
+        d = input.shape[-1]
+        w_ih = create_parameter([d, 3 * size], input.dtype, name="w_ih", attr=param_attr)
+        w_hh = create_parameter([size, 3 * size], input.dtype, name="w_hh", attr=param_attr)
+        b = (
+            create_parameter([3 * size], input.dtype, name="b", attr=bias_attr, default_initializer=init_mod.Constant(0.0))
+            if bias_attr is not False
+            else None
+        )
+        return orn.dynamic_gru(input, w_ih, w_hh, b, lengths=lengths, reverse=is_reverse)
+
+
+def sequence_conv(
+    input: jax.Array,
+    lengths: jax.Array,
+    num_filters: int,
+    filter_size: int = 3,
+    param_attr=None,
+    bias_attr=None,
+    act: Optional[str] = None,
+    name: Optional[str] = None,
+) -> jax.Array:
+    with name_scope(name or "sequence_conv"):
+        d = input.shape[-1]
+        w = create_parameter([filter_size * d, num_filters], input.dtype, name="w", attr=param_attr)
+        out = oseq.sequence_conv(input, lengths, w, filter_size)
+        if bias_attr is not False:
+            b = create_parameter([num_filters], input.dtype, name="b", attr=bias_attr, default_initializer=init_mod.Constant(0.0))
+            out = out + b
+        return _act(out, act)
+
+
+def data(name: str, shape: Sequence[int], dtype="float32", lod_level: int = 0):
+    """Compatibility no-op: under tracing, inputs are just function args.
+    Returns a ShapeDtypeStruct usable for documentation/feeding order."""
+    from paddle_tpu.core import dtypes as _d
+
+    return jax.ShapeDtypeStruct(tuple(s for s in shape), _d.convert(dtype))
+
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,138 @@
+"""Learning-rate schedules.
+
+Reference: ``python/paddle/fluid/layers/learning_rate_scheduler.py`` —
+exponential/natural_exp/inverse_time/polynomial/piecewise/noam decays, built
+there as graph ops reading a global-step variable. TPU-native: pure functions
+of an int32 step array, evaluated inside the compiled update step (the step
+counter lives in optimizer state).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class LRScheduler:
+    def __call__(self, step: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+
+class Constant(LRScheduler):
+    def __init__(self, learning_rate: float):
+        self.lr = float(learning_rate)
+
+    def __call__(self, step):
+        return jnp.asarray(self.lr, jnp.float32)
+
+
+class ExponentialDecay(LRScheduler):
+    def __init__(self, learning_rate: float, decay_steps: int, decay_rate: float, staircase: bool = False):
+        self.lr, self.decay_steps, self.decay_rate, self.staircase = learning_rate, decay_steps, decay_rate, staircase
+
+    def __call__(self, step):
+        exp = step.astype(jnp.float32) / self.decay_steps
+        if self.staircase:
+            exp = jnp.floor(exp)
+        return self.lr * jnp.power(self.decay_rate, exp)
+
+
+class NaturalExpDecay(LRScheduler):
+    def __init__(self, learning_rate: float, decay_steps: int, decay_rate: float, staircase: bool = False):
+        self.lr, self.decay_steps, self.decay_rate, self.staircase = learning_rate, decay_steps, decay_rate, staircase
+
+    def __call__(self, step):
+        exp = step.astype(jnp.float32) / self.decay_steps
+        if self.staircase:
+            exp = jnp.floor(exp)
+        return self.lr * jnp.exp(-self.decay_rate * exp)
+
+
+class InverseTimeDecay(LRScheduler):
+    def __init__(self, learning_rate: float, decay_steps: int, decay_rate: float, staircase: bool = False):
+        self.lr, self.decay_steps, self.decay_rate, self.staircase = learning_rate, decay_steps, decay_rate, staircase
+
+    def __call__(self, step):
+        frac = step.astype(jnp.float32) / self.decay_steps
+        if self.staircase:
+            frac = jnp.floor(frac)
+        return self.lr / (1.0 + self.decay_rate * frac)
+
+
+class PolynomialDecay(LRScheduler):
+    def __init__(self, learning_rate: float, decay_steps: int, end_learning_rate: float = 1e-4, power: float = 1.0, cycle: bool = False):
+        self.lr, self.decay_steps, self.end_lr, self.power, self.cycle = learning_rate, decay_steps, end_learning_rate, power, cycle
+
+    def __call__(self, step):
+        s = step.astype(jnp.float32)
+        if self.cycle:
+            mult = jnp.ceil(jnp.maximum(s / self.decay_steps, 1.0))
+            decay_steps = self.decay_steps * mult
+        else:
+            decay_steps = jnp.asarray(float(self.decay_steps))
+            s = jnp.minimum(s, decay_steps)
+        return (self.lr - self.end_lr) * jnp.power(1 - s / decay_steps, self.power) + self.end_lr
+
+
+class PiecewiseDecay(LRScheduler):
+    def __init__(self, boundaries: Sequence[int], values: Sequence[float]):
+        assert len(values) == len(boundaries) + 1
+        self.boundaries = [int(b) for b in boundaries]
+        self.values = [float(v) for v in values]
+
+    def __call__(self, step):
+        lr = jnp.asarray(self.values[0], jnp.float32)
+        for b, v in zip(self.boundaries, self.values[1:]):
+            lr = jnp.where(step >= b, v, lr)
+        return lr
+
+
+class NoamDecay(LRScheduler):
+    """Transformer schedule (reference noam_decay): d^-0.5 * min(s^-0.5, s*w^-1.5)."""
+
+    def __init__(self, d_model: int, warmup_steps: int, learning_rate: float = 1.0):
+        self.d_model, self.warmup, self.lr = d_model, warmup_steps, learning_rate
+
+    def __call__(self, step):
+        s = jnp.maximum(step.astype(jnp.float32), 1.0)
+        return self.lr * (self.d_model ** -0.5) * jnp.minimum(s ** -0.5, s * (self.warmup ** -1.5))
+
+
+class CosineDecay(LRScheduler):
+    def __init__(self, learning_rate: float, decay_steps: int, alpha: float = 0.0):
+        self.lr, self.decay_steps, self.alpha = learning_rate, decay_steps, alpha
+
+    def __call__(self, step):
+        frac = jnp.clip(step.astype(jnp.float32) / self.decay_steps, 0.0, 1.0)
+        cosine = 0.5 * (1 + jnp.cos(math.pi * frac))
+        return self.lr * ((1 - self.alpha) * cosine + self.alpha)
+
+
+class LinearWarmup(LRScheduler):
+    def __init__(self, inner: LRScheduler, warmup_steps: int, start_lr: float = 0.0):
+        self.inner, self.warmup, self.start_lr = inner, warmup_steps, start_lr
+
+    def __call__(self, step):
+        s = step.astype(jnp.float32)
+        target = self.inner(step)
+        warm = self.start_lr + (target - self.start_lr) * jnp.minimum(s / self.warmup, 1.0)
+        return jnp.where(step < self.warmup, warm, target)
+
+
+# fluid-style lowercase aliases
+exponential_decay = ExponentialDecay
+natural_exp_decay = NaturalExpDecay
+inverse_time_decay = InverseTimeDecay
+polynomial_decay = PolynomialDecay
+piecewise_decay = PiecewiseDecay
+noam_decay = NoamDecay
+cosine_decay = CosineDecay
+
+
+def resolve(lr) -> LRScheduler:
+    if isinstance(lr, LRScheduler):
+        return lr
+    return Constant(float(lr))

@@ -1,0 +1,25 @@
+"""Functional op library.
+
+TPU-native replacement for the reference operator zoo
+(``paddle/fluid/operators/`` — ~250 op families × CPU/CUDA kernels, §2.1 of
+SURVEY.md). Here every op is a pure jax.numpy/lax composition; XLA fuses and
+tiles them onto MXU/VPU, so there is no kernel registry, no OpKernelType
+dispatch (reference ``framework/op_registry.h:38-150``), and no per-op data
+transform (``operator.cc:750``). Pallas kernels (``paddle_tpu.ops.pallas``)
+are used only where XLA underperforms.
+"""
+
+from paddle_tpu.ops.math import *  # noqa: F401,F403
+from paddle_tpu.ops.nn import *  # noqa: F401,F403
+from paddle_tpu.ops import math, nn, rnn, sequence, attention  # noqa: F401
+
+from paddle_tpu.ops import math as _math
+from paddle_tpu.ops import nn as _nn
+
+__all__ = list(getattr(_math, "__all__", [])) + list(getattr(_nn, "__all__", [])) + [
+    "math",
+    "nn",
+    "rnn",
+    "sequence",
+    "attention",
+]

@@ -1,0 +1,68 @@
+"""Attention ops.
+
+Reference: attention exists only as composed ops
+(``python/paddle/fluid/nets.py:332`` scaled_dot_product_attention; the
+Transformer model in ``benchmark/fluid/models/machine_translation.py``).
+TPU-native: one fused-friendly function XLA lowers well; a Pallas
+flash-attention kernel (``paddle_tpu.ops.pallas_attention``) takes over for
+long sequences.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["scaled_dot_product_attention", "split_heads", "combine_heads", "causal_mask"]
+
+
+def causal_mask(t_q: int, t_k: int, dtype=jnp.float32) -> jax.Array:
+    """[Tq, Tk] additive mask, -inf above the diagonal."""
+    i = jnp.arange(t_q)[:, None]
+    j = jnp.arange(t_k)[None, :]
+    return jnp.where(j <= i + (t_k - t_q), 0.0, -jnp.inf).astype(dtype)
+
+
+def split_heads(x: jax.Array, num_heads: int) -> jax.Array:
+    """[B, T, H*D] → [B, num_heads, T, D]."""
+    b, t, hd = x.shape
+    return x.reshape(b, t, num_heads, hd // num_heads).transpose(0, 2, 1, 3)
+
+
+def combine_heads(x: jax.Array) -> jax.Array:
+    """[B, N, T, D] → [B, T, N*D]."""
+    b, n, t, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, n * d)
+
+
+def scaled_dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    dropout_rate: float = 0.0,
+    is_test: bool = True,
+    dropout_key=None,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Attention over [..., T, D] tensors (head dims lead). ``mask`` is an
+    additive mask broadcastable to [..., Tq, Tk] (0 = keep, -inf = drop).
+
+    Softmax in fp32; QK^T and PV matmuls accumulate fp32 on the MXU.
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.matmul(q, jnp.swapaxes(k, -1, -2), preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if mask is not None:
+        logits = logits + mask.astype(jnp.float32)
+    weights = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0 and not is_test:
+        from paddle_tpu.ops.nn import dropout as _dropout
+
+        weights = _dropout(weights, dropout_rate, is_test=False, key=dropout_key)
+    out = jnp.matmul(weights.astype(v.dtype), v, preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)

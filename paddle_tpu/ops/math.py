@@ -1,0 +1,607 @@
+"""Math ops: elementwise, matmul, reductions, shape manipulation, random.
+
+Reference: the dense math op families of ``paddle/fluid/operators/`` —
+elementwise_{add,sub,mul,div,min,max,pow} (broadcast over a trailing axis
+via the ``axis`` attr), activations (``activation_op.cc``), ``matmul_op``/
+``mul_op``, reduce_* ops, ``top_k_op``, ``argsort_op``, gather/scatter/
+concat/split/reshape/transpose/stack, clip, random ops. All are thin, typed
+compositions over jnp/lax — XLA owns fusion and MXU tiling.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "elementwise_add",
+    "elementwise_sub",
+    "elementwise_mul",
+    "elementwise_div",
+    "elementwise_min",
+    "elementwise_max",
+    "elementwise_pow",
+    "relu",
+    "relu6",
+    "sigmoid",
+    "tanh",
+    "softplus",
+    "softsign",
+    "sqrt",
+    "square",
+    "exp",
+    "log",
+    "abs",
+    "floor",
+    "ceil",
+    "round",
+    "reciprocal",
+    "gelu",
+    "leaky_relu",
+    "elu",
+    "hard_sigmoid",
+    "swish",
+    "prelu_fn",
+    "pow",
+    "scale",
+    "clip",
+    "clip_by_norm",
+    "matmul",
+    "mul",
+    "dot",
+    "sum",
+    "reduce_sum",
+    "reduce_mean",
+    "reduce_max",
+    "reduce_min",
+    "reduce_prod",
+    "cumsum",
+    "argmax",
+    "argmin",
+    "argsort",
+    "topk",
+    "cast",
+    "concat",
+    "split",
+    "stack",
+    "unstack",
+    "reshape",
+    "flatten",
+    "squeeze",
+    "unsqueeze",
+    "transpose",
+    "expand",
+    "tile",
+    "slice",
+    "gather",
+    "gather_nd",
+    "scatter",
+    "scatter_add",
+    "pad",
+    "crop",
+    "reverse",
+    "shape",
+    "fill_constant",
+    "zeros",
+    "ones",
+    "zeros_like",
+    "ones_like",
+    "arange",
+    "linspace",
+    "uniform_random",
+    "gaussian_random",
+    "truncated_gaussian_random",
+    "sampling_id",
+    "isfinite",
+    "equal",
+    "not_equal",
+    "less_than",
+    "less_equal",
+    "greater_than",
+    "greater_equal",
+    "logical_and",
+    "logical_or",
+    "logical_not",
+    "logical_xor",
+    "where",
+    "maximum",
+    "minimum",
+    "mean",
+    "increment",
+    "sign",
+    "sin",
+    "cos",
+]
+
+
+def _broadcast_axis(x: jax.Array, y: jax.Array, axis: int) -> jax.Array:
+    """Fluid elementwise broadcast semantics: y's shape matches a contiguous
+    sub-range of x's dims starting at ``axis`` (reference
+    ``operators/elementwise_op_function.h``). axis=-1 means trailing align
+    (numpy broadcasting)."""
+    if axis == -1 or x.ndim == y.ndim:
+        return y
+    trailing = x.ndim - axis - y.ndim
+    return y.reshape(y.shape + (1,) * trailing)
+
+
+def _elementwise(fn):
+    def op(x, y, axis: int = -1):
+        return fn(x, _broadcast_axis(x, jnp.asarray(y), axis))
+
+    return op
+
+
+elementwise_add = _elementwise(jnp.add)
+elementwise_sub = _elementwise(jnp.subtract)
+elementwise_mul = _elementwise(jnp.multiply)
+elementwise_div = _elementwise(jnp.divide)
+elementwise_min = _elementwise(jnp.minimum)
+elementwise_max = _elementwise(jnp.maximum)
+elementwise_pow = _elementwise(jnp.power)
+
+
+# -- activations (reference operators/activation_op.cc) ----------------------
+
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+def relu6(x):
+    return jnp.clip(x, 0, 6)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+def softsign(x):
+    return x / (1 + jnp.abs(x))
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def abs(x):  # noqa: A001 - fluid op name
+    return jnp.abs(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def round(x):  # noqa: A001
+    return jnp.round(x)
+
+
+def reciprocal(x):
+    return 1.0 / x
+
+
+def gelu(x, approximate: bool = True):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def leaky_relu(x, alpha: float = 0.02):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def elu(x, alpha: float = 1.0):
+    return jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1))
+
+
+def hard_sigmoid(x, slope: float = 0.2, offset: float = 0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def swish(x, beta: float = 1.0):
+    return x * jax.nn.sigmoid(beta * x)
+
+
+def prelu_fn(x, alpha):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def pow(x, factor):  # noqa: A001
+    return jnp.power(x, factor)
+
+
+def scale(x, scale: float = 1.0, bias: float = 0.0, bias_after_scale: bool = True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def clip(x, min, max):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+def clip_by_norm(x, max_norm: float):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return x * jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+# -- matmul family (MXU) ----------------------------------------------------
+
+def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False, alpha: float = 1.0):
+    """Batched matmul (reference ``operators/matmul_op.cc`` semantics).
+    Compute in the input dtype (bf16 hits the MXU natively), accumulate fp32
+    via preferred_element_type."""
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y, preferred_element_type=jnp.float32)
+    if alpha != 1.0:
+        out = out * alpha
+    return out.astype(x.dtype if x.dtype == y.dtype else jnp.result_type(x, y))
+
+
+def mul(x, y, x_num_col_dims: int = 1, y_num_col_dims: int = 1):
+    """Reference ``mul_op``: flatten x to 2-D at x_num_col_dims, y at
+    y_num_col_dims, then matmul; restore leading dims."""
+    x_shape = x.shape
+    x2 = x.reshape((int(jnp.prod(jnp.array(x_shape[:x_num_col_dims]))), -1)) if x.ndim > 2 else x
+    y2 = y.reshape((-1, int(jnp.prod(jnp.array(y.shape[y_num_col_dims:]))))) if y.ndim > 2 else y
+    out = jnp.matmul(x2, y2, preferred_element_type=jnp.float32).astype(x.dtype)
+    lead = x_shape[:x_num_col_dims]
+    return out.reshape(lead + y.shape[y_num_col_dims:]) if x.ndim > 2 or y.ndim > 2 else out
+
+
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1, keepdims=True)
+
+
+# -- reductions -------------------------------------------------------------
+
+def _reduce(fn, x, dim=None, keep_dim: bool = False):
+    axis = tuple(dim) if isinstance(dim, (list, tuple)) else dim
+    return fn(x, axis=axis, keepdims=keep_dim)
+
+
+def reduce_sum(x, dim=None, keep_dim=False):
+    return _reduce(jnp.sum, x, dim, keep_dim)
+
+
+def reduce_mean(x, dim=None, keep_dim=False):
+    return _reduce(jnp.mean, x, dim, keep_dim)
+
+
+def reduce_max(x, dim=None, keep_dim=False):
+    return _reduce(jnp.max, x, dim, keep_dim)
+
+
+def reduce_min(x, dim=None, keep_dim=False):
+    return _reduce(jnp.min, x, dim, keep_dim)
+
+
+def reduce_prod(x, dim=None, keep_dim=False):
+    return _reduce(jnp.prod, x, dim, keep_dim)
+
+
+def sum(xs):  # noqa: A001 - fluid sum op adds a list of tensors
+    if isinstance(xs, (list, tuple)):
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out
+    return jnp.sum(xs)
+
+
+def mean(x):
+    return jnp.mean(x)
+
+
+def cumsum(x, axis: int = -1, exclusive: bool = False, reverse: bool = False):
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out
+
+
+def argmax(x, axis: int = -1):
+    return jnp.argmax(x, axis=axis)
+
+
+def argmin(x, axis: int = -1):
+    return jnp.argmin(x, axis=axis)
+
+
+def argsort(x, axis: int = -1, descending: bool = False):
+    """Reference ``argsort_op``: returns (sorted, indices)."""
+    idx = jnp.argsort(-x if descending else x, axis=axis)
+    return jnp.take_along_axis(x, idx, axis=axis), idx
+
+
+def topk(x, k: int):
+    """Reference ``top_k_op``: (values, indices) over the last axis."""
+    return lax.top_k(x, k)
+
+
+# -- shape / data movement --------------------------------------------------
+
+def cast(x, dtype):
+    from paddle_tpu.core import dtypes as _d
+
+    return x.astype(_d.convert(dtype))
+
+
+def concat(xs: Sequence[jax.Array], axis: int = 0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def split(x, num_or_sections: Union[int, Sequence[int]], dim: int = 0):
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=dim)
+    sizes = list(num_or_sections)
+    indices = []
+    acc = 0
+    for s in sizes[:-1]:
+        acc += s
+        indices.append(acc)
+    return jnp.split(x, indices, axis=dim)
+
+
+def stack(xs, axis: int = 0):
+    return jnp.stack(xs, axis=axis)
+
+
+def unstack(x, axis: int = 0):
+    return [jnp.squeeze(p, axis=axis) for p in jnp.split(x, x.shape[axis], axis=axis)]
+
+
+def reshape(x, shape: Sequence[int]):
+    return jnp.reshape(x, tuple(shape))
+
+
+def flatten(x, axis: int = 1):
+    lead = 1
+    for s in x.shape[:axis]:
+        lead *= s
+    return x.reshape((lead, -1))
+
+
+def squeeze(x, axes: Optional[Sequence[int]] = None):
+    return jnp.squeeze(x, axis=tuple(axes) if axes else None)
+
+
+def unsqueeze(x, axes: Sequence[int]):
+    for a in sorted(axes):
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+def transpose(x, perm: Sequence[int]):
+    return jnp.transpose(x, tuple(perm))
+
+
+def expand(x, expand_times: Sequence[int]):
+    return jnp.tile(x, tuple(expand_times))
+
+
+def tile(x, reps):
+    return jnp.tile(x, reps)
+
+
+def slice(x, axes: Sequence[int], starts: Sequence[int], ends: Sequence[int]):  # noqa: A001
+    import builtins
+
+    slicer = [builtins.slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        slicer[a] = builtins.slice(s, e)
+    return x[tuple(slicer)]
+
+
+def gather(x, index, axis: int = 0):
+    return jnp.take(x, index, axis=axis)
+
+
+def gather_nd(x, index):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def scatter(x, index, updates):
+    """Overwrite rows of x at index (reference ``scatter_op`` overwrite mode)."""
+    return x.at[index].set(updates)
+
+
+def scatter_add(x, index, updates):
+    return x.at[index].add(updates)
+
+
+def pad(x, paddings: Sequence[int], pad_value: float = 0.0):
+    """Reference ``pad_op``: paddings is [before0, after0, before1, after1, ...]."""
+    cfg = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    return jnp.pad(x, cfg, constant_values=pad_value)
+
+
+def crop(x, offsets: Sequence[int], shape: Sequence[int]):
+    return lax.dynamic_slice(x, tuple(offsets), tuple(shape))
+
+
+def reverse(x, axis):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    for a in axes:
+        x = jnp.flip(x, a)
+    return x
+
+
+def shape(x):
+    return jnp.asarray(x.shape, dtype=jnp.int32)
+
+
+def increment(x, value: float = 1.0):
+    return x + value
+
+
+# -- creation ---------------------------------------------------------------
+
+def fill_constant(shape: Sequence[int], dtype, value):
+    from paddle_tpu.core import dtypes as _d
+
+    return jnp.full(tuple(shape), value, dtype=_d.convert(dtype))
+
+
+def zeros(shape, dtype="float32"):
+    return fill_constant(shape, dtype, 0)
+
+
+def ones(shape, dtype="float32"):
+    return fill_constant(shape, dtype, 1)
+
+
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+def ones_like(x):
+    return jnp.ones_like(x)
+
+
+def arange(start, end=None, step=1, dtype="int64"):
+    from paddle_tpu.core import dtypes as _d
+
+    return jnp.arange(start, end, step, dtype=_d.convert(dtype))
+
+
+def linspace(start, stop, num, dtype="float32"):
+    from paddle_tpu.core import dtypes as _d
+
+    return jnp.linspace(start, stop, num, dtype=_d.convert(dtype))
+
+
+# -- random (reference uniform_random_op / gaussian_random_op / ...) --------
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, key=None):  # noqa: A002
+    from paddle_tpu import framework
+    from paddle_tpu.core import dtypes as _d
+
+    key = key if key is not None else framework.next_rng_key()
+    return jax.random.uniform(key, tuple(shape), dtype=_d.convert(dtype), minval=min, maxval=max)
+
+
+def gaussian_random(shape, dtype="float32", mean=0.0, std=1.0, key=None):
+    from paddle_tpu import framework
+    from paddle_tpu.core import dtypes as _d
+
+    key = key if key is not None else framework.next_rng_key()
+    return mean + std * jax.random.normal(key, tuple(shape), dtype=_d.convert(dtype))
+
+
+def truncated_gaussian_random(shape, dtype="float32", mean=0.0, std=1.0, key=None):
+    from paddle_tpu import framework
+    from paddle_tpu.core import dtypes as _d
+
+    key = key if key is not None else framework.next_rng_key()
+    return mean + std * jax.random.truncated_normal(key, -2.0, 2.0, tuple(shape), dtype=_d.convert(dtype))
+
+
+def sampling_id(probs, key=None):
+    """Sample one category id per row from a probability matrix
+    (reference ``sampling_id_op``)."""
+    from paddle_tpu import framework
+
+    key = key if key is not None else framework.next_rng_key()
+    return jax.random.categorical(key, jnp.log(jnp.maximum(probs, 1e-20)), axis=-1)
+
+
+# -- comparison / logical ---------------------------------------------------
+
+def isfinite(x):
+    return jnp.all(jnp.isfinite(x))
+
+
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+def where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+def minimum(x, y):
+    return jnp.minimum(x, y)

@@ -1,0 +1,495 @@
+"""Neural-net ops: conv, pooling, normalization, losses, dropout, metrics.
+
+Reference kernels replaced here: ``operators/conv_op.cc`` (+cudnn/im2col
+paths), ``pool_op.cc``, ``batch_norm_op.cc``, ``layer_norm_op.cc``,
+``softmax_op.cc`` (+cudnn), ``cross_entropy_op.cc``,
+``softmax_with_cross_entropy_op.cc``, ``dropout_op.cc``, ``lrn_op.cc``,
+``one_hot_op.cc``, ``accuracy_op.cc``, ``smooth_l1_loss_op.cc``, etc.
+
+TPU-first conventions:
+- images are NHWC (XLA's preferred TPU layout; the reference is NCHW). The
+  layer API accepts ``data_format`` for compat but defaults to NHWC.
+- convs/matmuls run with fp32 accumulation (``preferred_element_type``) so
+  bf16 inputs hit the MXU natively with fp32 partials.
+- losses reduce in fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "conv2d",
+    "conv2d_transpose",
+    "depthwise_conv2d",
+    "pool2d",
+    "adaptive_pool2d",
+    "batch_norm_infer",
+    "batch_norm_train",
+    "layer_norm",
+    "group_norm",
+    "lrn",
+    "softmax",
+    "log_softmax",
+    "cross_entropy",
+    "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits",
+    "square_error_cost",
+    "smooth_l1",
+    "huber_loss",
+    "kldiv_loss",
+    "log_loss",
+    "margin_rank_loss",
+    "dropout",
+    "one_hot",
+    "label_smooth",
+    "accuracy",
+    "embedding_lookup",
+    "embedding_grad_dense",
+    "prelu",
+    "pixel_shuffle",
+    "pad2d",
+    "resize_nearest",
+    "resize_bilinear",
+    "cos_sim",
+    "l2_normalize",
+    "matmul_bias",
+]
+
+_IntOrPair = Union[int, Sequence[int]]
+
+
+def _pair(v: _IntOrPair) -> Tuple[int, int]:
+    if isinstance(v, (list, tuple)):
+        return int(v[0]), int(v[1])
+    return int(v), int(v)
+
+
+def _conv_padding(padding: Union[str, _IntOrPair]) -> Union[str, Sequence[Tuple[int, int]]]:
+    if isinstance(padding, str):
+        return padding.upper()
+    ph, pw = _pair(padding)
+    return [(ph, ph), (pw, pw)]
+
+
+_NHWC_SPEC = ("NHWC", "HWIO", "NHWC")
+
+
+def conv2d(
+    x: jax.Array,
+    weight: jax.Array,
+    stride: _IntOrPair = 1,
+    padding: Union[str, _IntOrPair] = 0,
+    dilation: _IntOrPair = 1,
+    groups: int = 1,
+) -> jax.Array:
+    """2-D convolution, NHWC activations × HWIO weights.
+
+    Replaces ``operators/conv_op.cc`` (+ ``conv_cudnn_op.cu`` / im2col+gemm
+    ``operators/math/im2col.cc``): one lax.conv_general_dilated that XLA maps
+    straight onto the MXU — no algo selection, no workspace management.
+    """
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, _NHWC_SPEC)
+    out = lax.conv_general_dilated(
+        x,
+        weight,
+        window_strides=_pair(stride),
+        padding=_conv_padding(padding),
+        rhs_dilation=_pair(dilation),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(x.dtype)
+
+
+def depthwise_conv2d(x, weight, stride=1, padding=0, dilation=1):
+    """Depthwise conv (reference ``operators/math/depthwise_conv.cu``):
+    groups == channels. weight is HWI1 → HWIO with O=channel_multiplier*C."""
+    channels = x.shape[-1]
+    return conv2d(x, weight, stride, padding, dilation, groups=channels)
+
+
+def conv2d_transpose(
+    x,
+    weight,
+    stride: _IntOrPair = 1,
+    padding: _IntOrPair = 0,
+    output_padding: _IntOrPair = 0,
+) -> jax.Array:
+    """Transposed conv (reference ``conv_transpose_op.cc``). weight HWIO with
+    I=in_channels of x, O=out_channels."""
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    oph, opw = _pair(output_padding)
+    kh, kw = weight.shape[0], weight.shape[1]
+    pads = [
+        (kh - 1 - ph, kh - 1 - ph + oph),
+        (kw - 1 - pw, kw - 1 - pw + opw),
+    ]
+    # gradient-of-conv formulation: dilate inputs by stride, flip kernel
+    # spatially (weight is [h, w, in, out], so channels already line up)
+    w_flipped = jnp.flip(weight, (0, 1))
+    dn = lax.conv_dimension_numbers(x.shape, w_flipped.shape, _NHWC_SPEC)
+    out = lax.conv_general_dilated(
+        x,
+        w_flipped,
+        window_strides=(1, 1),
+        padding=pads,
+        lhs_dilation=(sh, sw),
+        dimension_numbers=dn,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(x.dtype)
+
+
+def pool2d(
+    x,
+    pool_size: _IntOrPair = 2,
+    pool_type: str = "max",
+    pool_stride: _IntOrPair = 1,
+    pool_padding: _IntOrPair = 0,
+    ceil_mode: bool = False,
+    exclusive: bool = True,
+    global_pooling: bool = False,
+):
+    """Max/avg pooling over NHWC (reference ``pool_op.cc`` semantics incl.
+    ``exclusive`` average counting)."""
+    if global_pooling:
+        pool_size = (x.shape[1], x.shape[2])
+        pool_padding = 0
+    kh, kw = _pair(pool_size)
+    sh, sw = _pair(pool_stride)
+    ph, pw = _pair(pool_padding)
+    dims = (1, kh, kw, 1)
+    strides = (1, sh, sw, 1)
+    if ceil_mode:
+        # pad the right/bottom enough that ceil-division windows are complete
+        def extra(size, k, s, p):
+            out = -(-(size + 2 * p - k) // s) + 1  # ceil
+            needed = (out - 1) * s + k - (size + 2 * p)
+            return max(0, needed)
+
+        eh = extra(x.shape[1], kh, sh, ph)
+        ew = extra(x.shape[2], kw, sw, pw)
+    else:
+        eh = ew = 0
+    pads = ((0, 0), (ph, ph + eh), (pw, pw + ew), (0, 0))
+
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        padded = jnp.pad(x, pads, constant_values=init)
+        return lax.reduce_window(padded, init, lax.max, dims, strides, "VALID")
+    if pool_type == "avg":
+        padded = jnp.pad(x.astype(jnp.float32), pads, constant_values=0.0)
+        summed = lax.reduce_window(padded, 0.0, lax.add, dims, strides, "VALID")
+        if exclusive and (ph or pw or eh or ew):
+            ones = jnp.pad(jnp.ones(x.shape[1:3], jnp.float32), pads[1:3], constant_values=0.0)
+            counts = lax.reduce_window(ones, 0.0, lax.add, (kh, kw), (sh, sw), "VALID")
+            out = summed / counts[None, :, :, None]
+        else:
+            out = summed / float(kh * kw)
+        return out.astype(x.dtype)
+    raise ValueError(f"pool_type must be 'max' or 'avg', got {pool_type!r}")
+
+
+def adaptive_pool2d(x, output_size: _IntOrPair, pool_type: str = "avg"):
+    oh, ow = _pair(output_size)
+    h, w = x.shape[1], x.shape[2]
+    if h % oh == 0 and w % ow == 0:
+        return pool2d(x, (h // oh, w // ow), pool_type, (h // oh, w // ow))
+    raise NotImplementedError("adaptive_pool2d requires divisible sizes on TPU (static shapes)")
+
+
+# -- normalization ----------------------------------------------------------
+
+def batch_norm_train(
+    x, scale, bias, moving_mean, moving_var, momentum: float = 0.9, epsilon: float = 1e-5
+):
+    """Training-mode BN over all but the channel (last) axis. Returns
+    (y, new_moving_mean, new_moving_var, batch_mean, batch_var) — the
+    functional split of the reference's in-place stat update
+    (``operators/batch_norm_op.cc``)."""
+    axes = tuple(range(x.ndim - 1))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
+    inv = lax.rsqrt(var + epsilon)
+    y = (xf - mean) * inv * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    new_mean = momentum * moving_mean + (1 - momentum) * mean
+    new_var = momentum * moving_var + (1 - momentum) * var
+    return y.astype(x.dtype), new_mean, new_var, mean, var
+
+
+def batch_norm_infer(x, scale, bias, moving_mean, moving_var, epsilon: float = 1e-5):
+    xf = x.astype(jnp.float32)
+    inv = lax.rsqrt(moving_var + epsilon)
+    y = (xf - moving_mean) * inv * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def layer_norm(x, scale=None, bias=None, begin_norm_axis: int = -1, epsilon: float = 1e-5):
+    """Reference ``layer_norm_op.cc``: normalize over dims
+    [begin_norm_axis, rank)."""
+    if begin_norm_axis < 0:
+        begin_norm_axis = x.ndim + begin_norm_axis
+    axes = tuple(range(begin_norm_axis, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + epsilon)
+    if scale is not None:
+        y = y * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def group_norm(x, scale=None, bias=None, groups: int = 32, epsilon: float = 1e-5):
+    n = x.shape[0]
+    c = x.shape[-1]
+    spatial = x.shape[1:-1]
+    xf = x.astype(jnp.float32).reshape((n,) + spatial + (groups, c // groups))
+    axes = tuple(range(1, xf.ndim - 2)) + (xf.ndim - 1,)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = ((xf - mean) * lax.rsqrt(var + epsilon)).reshape(x.shape)
+    if scale is not None:
+        y = y * scale
+    if bias is not None:
+        y = y + bias
+    return y.astype(x.dtype)
+
+
+def lrn(x, n: int = 5, k: float = 1.0, alpha: float = 1e-4, beta: float = 0.75):
+    """Local response norm across channels, NHWC (reference ``lrn_op.cc``)."""
+    xf = x.astype(jnp.float32)
+    sq = jnp.square(xf)
+    half = n // 2
+    padded = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (half, half)))
+    window = lax.reduce_window(padded, 0.0, lax.add, (1, 1, 1, n), (1, 1, 1, 1), "VALID")
+    return (xf / jnp.power(k + alpha * window, beta)).astype(x.dtype)
+
+
+def l2_normalize(x, axis: int = -1, epsilon: float = 1e-12):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    return x / jnp.maximum(norm, epsilon)
+
+
+# -- softmax / losses -------------------------------------------------------
+
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(x.astype(jnp.float32), axis=axis).astype(x.dtype)
+
+
+def log_softmax(x, axis: int = -1):
+    return jax.nn.log_softmax(x.astype(jnp.float32), axis=axis).astype(x.dtype)
+
+
+def cross_entropy(probs, label, soft_label: bool = False, ignore_index: int = -100, axis: int = -1):
+    """NLL on probabilities (reference ``cross_entropy_op.cc``): input is a
+    probability distribution (post-softmax); label is int ids or soft dist.
+    Returns per-example loss with a trailing 1 dim (fluid convention)."""
+    pf = jnp.maximum(probs.astype(jnp.float32), 1e-10)
+    if soft_label:
+        loss = -jnp.sum(label.astype(jnp.float32) * jnp.log(pf), axis=axis, keepdims=True)
+    else:
+        lab = label.squeeze(-1) if (label.ndim == probs.ndim and label.shape[-1] == 1) else label
+        picked = jnp.take_along_axis(jnp.log(pf), lab[..., None].astype(jnp.int32), axis=axis)
+        loss = -picked
+        mask = (lab != ignore_index)[..., None]
+        loss = jnp.where(mask, loss, 0.0)
+    return loss
+
+
+def softmax_with_cross_entropy(
+    logits, label, soft_label: bool = False, ignore_index: int = -100, return_softmax: bool = False
+):
+    """Fused, numerically-stable version (reference
+    ``softmax_with_cross_entropy_op.cc``)."""
+    lf = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(lf, axis=-1)
+    if soft_label:
+        loss = -jnp.sum(label.astype(jnp.float32) * logp, axis=-1, keepdims=True)
+    else:
+        lab = label.squeeze(-1) if (label.ndim == logits.ndim and label.shape[-1] == 1) else label
+        picked = jnp.take_along_axis(logp, lab[..., None].astype(jnp.int32), axis=-1)
+        loss = -picked
+        loss = jnp.where((lab != ignore_index)[..., None], loss, 0.0)
+    if return_softmax:
+        return loss, jnp.exp(logp).astype(logits.dtype)
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label):
+    xf = x.astype(jnp.float32)
+    lf = label.astype(jnp.float32)
+    return (jnp.maximum(xf, 0) - xf * lf + jnp.log1p(jnp.exp(-jnp.abs(xf)))).astype(jnp.float32)
+
+
+def square_error_cost(input, label):
+    d = input.astype(jnp.float32) - label.astype(jnp.float32)
+    return jnp.square(d)
+
+
+def smooth_l1(x, y, sigma: float = 1.0):
+    """Reference ``smooth_l1_loss_op.cc``: per-example summed smooth-L1."""
+    s2 = sigma * sigma
+    d = jnp.abs(x.astype(jnp.float32) - y.astype(jnp.float32))
+    loss = jnp.where(d < 1.0 / s2, 0.5 * s2 * jnp.square(d), d - 0.5 / s2)
+    return jnp.sum(loss.reshape(loss.shape[0], -1), axis=1, keepdims=True)
+
+
+def huber_loss(x, y, delta: float = 1.0):
+    d = jnp.abs(x.astype(jnp.float32) - y.astype(jnp.float32))
+    return jnp.where(d <= delta, 0.5 * jnp.square(d), delta * (d - 0.5 * delta))
+
+
+def kldiv_loss(x, target):
+    """x is log-probabilities, target probabilities."""
+    tf = target.astype(jnp.float32)
+    return tf * (jnp.log(jnp.maximum(tf, 1e-10)) - x.astype(jnp.float32))
+
+
+def log_loss(input, label, epsilon: float = 1e-4):
+    p = input.astype(jnp.float32)
+    lf = label.astype(jnp.float32)
+    return -lf * jnp.log(p + epsilon) - (1 - lf) * jnp.log(1 - p + epsilon)
+
+
+def margin_rank_loss(label, left, right, margin: float = 0.1):
+    out = jnp.maximum(0.0, -label * (left - right) + margin)
+    return out
+
+
+def cos_sim(x, y):
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    return jnp.sum(x * y, axis=-1, keepdims=True) / jnp.maximum(xn * yn, 1e-12)
+
+
+# -- dropout / misc ---------------------------------------------------------
+
+def dropout(x, dropout_prob: float, is_test: bool = False, key=None, upscale_in_train: bool = True):
+    """Reference ``dropout_op.cc``. ``upscale_in_train`` matches the
+    'upscale_in_train' dropout_implementation (modern default)."""
+    if is_test:
+        return x if upscale_in_train else x * (1.0 - dropout_prob)
+    if dropout_prob == 0.0:
+        return x
+    from paddle_tpu import framework
+
+    key = key if key is not None else framework.next_rng_key()
+    keep = 1.0 - dropout_prob
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if upscale_in_train:
+        return jnp.where(mask, x / keep, 0).astype(x.dtype)
+    return jnp.where(mask, x, 0).astype(x.dtype)
+
+
+def one_hot(x, depth: int, dtype="float32"):
+    from paddle_tpu.core import dtypes as _d
+
+    ids = x.squeeze(-1) if (x.ndim >= 2 and x.shape[-1] == 1) else x
+    return jax.nn.one_hot(ids, depth, dtype=_d.convert(dtype))
+
+
+def label_smooth(label, epsilon: float = 0.1):
+    k = label.shape[-1]
+    return (1 - epsilon) * label + epsilon / k
+
+
+def accuracy(logits_or_topk, label, k: int = 1):
+    """Reference ``accuracy_op.cc``: fraction of rows whose top-k contains
+    the label."""
+    lab = label.squeeze(-1) if (label.ndim >= 2 and label.shape[-1] == 1) else label
+    _, idx = lax.top_k(logits_or_topk, k)
+    correct = jnp.any(idx == lab[..., None], axis=-1)
+    return jnp.mean(correct.astype(jnp.float32))
+
+
+def embedding_lookup(table, ids, padding_idx: Optional[int] = None):
+    """Reference ``lookup_table_op.cc``. ids may carry a trailing 1 dim
+    (LoD-style); padding_idx rows produce zeros."""
+    ids2 = ids.squeeze(-1) if (ids.ndim >= 2 and ids.shape[-1] == 1) else ids
+    out = jnp.take(table, ids2.astype(jnp.int32), axis=0)
+    if padding_idx is not None:
+        out = jnp.where((ids2 == padding_idx)[..., None], 0.0, out)
+    return out
+
+
+def embedding_grad_dense(table_shape, ids, grad_out):
+    """Dense embedding gradient via scatter-add (segment-sum). The reference
+    emitted SelectedRows sparse grads (``lookup_table_op.cc`` grad kernel);
+    on TPU a dense scatter-add compiles to an efficient sorted segment sum.
+    Provided for custom-update paths; jax.grad of embedding_lookup produces
+    the same."""
+    ids2 = ids.reshape(-1).astype(jnp.int32)
+    g = grad_out.reshape(-1, table_shape[-1])
+    return jnp.zeros(table_shape, g.dtype).at[ids2].add(g)
+
+
+def prelu(x, alpha, mode: str = "all"):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def pixel_shuffle(x, upscale_factor: int):
+    n, h, w, c = x.shape
+    r = upscale_factor
+    oc = c // (r * r)
+    x = x.reshape(n, h, w, r, r, oc)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h * r, w * r, oc)
+
+
+def pad2d(x, paddings: Sequence[int], mode: str = "constant", pad_value: float = 0.0):
+    """NHWC spatial padding: paddings = [top, bottom, left, right]."""
+    cfg = ((0, 0), (paddings[0], paddings[1]), (paddings[2], paddings[3]), (0, 0))
+    if mode == "constant":
+        return jnp.pad(x, cfg, constant_values=pad_value)
+    jnp_mode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return jnp.pad(x, cfg, mode=jnp_mode)
+
+
+def resize_nearest(x, out_shape: Tuple[int, int]):
+    n, h, w, c = x.shape
+    oh, ow = out_shape
+    rows = (jnp.arange(oh) * h // oh).astype(jnp.int32)
+    cols = (jnp.arange(ow) * w // ow).astype(jnp.int32)
+    return x[:, rows][:, :, cols]
+
+
+def resize_bilinear(x, out_shape: Tuple[int, int], align_corners: bool = False):
+    n, h, w, c = x.shape
+    oh, ow = out_shape
+    if not align_corners:
+        return jax.image.resize(x, (n, oh, ow, c), method="bilinear")
+    # align_corners=True (the fluid default): corner pixels map exactly,
+    # sample positions i * (in-1)/(out-1)
+    def coords(out_size, in_size):
+        if out_size == 1:
+            return jnp.zeros((1,), jnp.float32)
+        return jnp.arange(out_size, dtype=jnp.float32) * ((in_size - 1) / (out_size - 1))
+
+    ys, xs = coords(oh, h), coords(ow, w)
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    wy = (ys - y0.astype(jnp.float32))[None, :, None, None]
+    wx = (xs - x0.astype(jnp.float32))[None, None, :, None]
+    xf = x.astype(jnp.float32)
+    top = xf[:, y0][:, :, x0] * (1 - wx) + xf[:, y0][:, :, x1] * wx
+    bot = xf[:, y1][:, :, x0] * (1 - wx) + xf[:, y1][:, :, x1] * wx
+    return (top * (1 - wy) + bot * wy).astype(x.dtype)
+
+
+def matmul_bias(x, w, b=None):
+    out = jnp.matmul(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
+    if b is not None:
+        out = out + b
+    return out

@@ -1,0 +1,162 @@
+"""Recurrent ops: LSTM/GRU cells and time-major scans.
+
+Reference: ``operators/lstm_op.cc`` / ``gru_op.cc`` /
+``operators/math/lstm_compute.cc`` (fused gate math) and the dynamic-RNN
+machinery (``recurrent_op.cc``, per-step scopes). TPU-native: the recurrence
+is a ``lax.scan`` over a padded [T, B, ...] tensor with a length mask — one
+compiled loop, no per-step scope creation. The gate matmuls are batched so
+each scan step is one MXU-shaped [B, H] × [H, 4H] matmul.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class LSTMState(NamedTuple):
+    h: jax.Array
+    c: jax.Array
+
+
+def lstm_cell(
+    x_proj: jax.Array,
+    state: LSTMState,
+    w_hh: jax.Array,
+    bias: Optional[jax.Array] = None,
+    forget_bias: float = 0.0,
+) -> LSTMState:
+    """One LSTM step. ``x_proj`` = x @ W_ih (precomputed outside the scan so
+    the input projection is one big [T*B, 4H] matmul). Gate order i,f,c,o
+    (reference lstm_compute gate layout)."""
+    h, c = state
+    gates = x_proj + jnp.matmul(h, w_hh, preferred_element_type=jnp.float32).astype(x_proj.dtype)
+    if bias is not None:
+        gates = gates + bias
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f + forget_bias)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    new_c = f * c + i * g
+    new_h = o * jnp.tanh(new_c)
+    return LSTMState(new_h, new_c)
+
+
+def gru_cell(x_proj: jax.Array, h: jax.Array, w_hh: jax.Array, bias=None) -> jax.Array:
+    """One GRU step (reference ``gru_op.cc`` gate math). x_proj: [B, 3H],
+    w_hh: [H, 3H] with gate order u (update), r (reset), c (candidate).
+    ``bias`` [3H] is added to the input projection (callers that pre-add it,
+    like dynamic_gru, pass None)."""
+    if bias is not None:
+        x_proj = x_proj + bias
+    hsize = h.shape[-1]
+    h_proj = jnp.matmul(h, w_hh[:, : 2 * hsize], preferred_element_type=jnp.float32).astype(h.dtype)
+    xu, xr, xc = jnp.split(x_proj, 3, axis=-1)
+    hu, hr = jnp.split(h_proj, 2, axis=-1)
+    u = jax.nn.sigmoid(xu + hu)
+    r = jax.nn.sigmoid(xr + hr)
+    hc = jnp.matmul(r * h, w_hh[:, 2 * hsize :], preferred_element_type=jnp.float32).astype(h.dtype)
+    c = jnp.tanh(xc + hc)
+    return u * h + (1.0 - u) * c
+
+
+def dynamic_lstm(
+    x: jax.Array,
+    w_ih: jax.Array,
+    w_hh: jax.Array,
+    bias: Optional[jax.Array] = None,
+    lengths: Optional[jax.Array] = None,
+    init_state: Optional[LSTMState] = None,
+    reverse: bool = False,
+    time_major: bool = False,
+) -> Tuple[jax.Array, LSTMState]:
+    """Full-sequence LSTM over padded batch [B, T, D] (or [T, B, D] when
+    time_major). Replaces ``dynamic_lstm``'s LoD-packed execution with a
+    masked scan: steps past a row's length carry state through unchanged, so
+    the final state matches the variable-length semantics exactly.
+
+    Returns (outputs [B, T, H], final LSTMState).
+    """
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)  # [T, B, D]
+    t, b, _ = x.shape
+    hsize = w_hh.shape[0]
+    if init_state is None:
+        init_state = LSTMState(
+            jnp.zeros((b, hsize), x.dtype), jnp.zeros((b, hsize), x.dtype)
+        )
+    x_proj = jnp.matmul(x, w_ih, preferred_element_type=jnp.float32).astype(x.dtype)  # [T, B, 4H]
+    if reverse:
+        x_proj = jnp.flip(x_proj, 0)
+
+    steps = jnp.arange(t)
+    if reverse and lengths is not None:
+        # when scanning the flipped sequence, step s touches original index t-1-s;
+        # valid iff t-1-s < length  ⇔  s >= t - length
+        valid_fn = lambda s: (t - 1 - s) < lengths  # noqa: E731
+    elif lengths is not None:
+        valid_fn = lambda s: s < lengths  # noqa: E731
+    else:
+        valid_fn = None
+
+    def step(state, inp):
+        s, xp = inp
+        new = lstm_cell(xp, state, w_hh, bias)
+        if valid_fn is not None:
+            m = valid_fn(s)[:, None]
+            new = LSTMState(
+                jnp.where(m, new.h, state.h), jnp.where(m, new.c, state.c)
+            )
+        return new, new.h
+
+    final, outs = lax.scan(step, init_state, (steps, x_proj))
+    if reverse:
+        outs = jnp.flip(outs, 0)
+    if lengths is not None:
+        mask = (jnp.arange(t)[:, None] < lengths[None, :])[..., None]
+        outs = jnp.where(mask, outs, 0.0)
+    if not time_major:
+        outs = jnp.swapaxes(outs, 0, 1)
+    return outs, final
+
+
+def dynamic_gru(
+    x: jax.Array,
+    w_ih: jax.Array,
+    w_hh: jax.Array,
+    bias=None,
+    lengths: Optional[jax.Array] = None,
+    init_h: Optional[jax.Array] = None,
+    reverse: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Full-sequence GRU over padded [B, T, D]."""
+    x = jnp.swapaxes(x, 0, 1)
+    t, b, _ = x.shape
+    hsize = w_hh.shape[0]
+    h0 = init_h if init_h is not None else jnp.zeros((b, hsize), x.dtype)
+    x_proj = jnp.matmul(x, w_ih, preferred_element_type=jnp.float32).astype(x.dtype)
+    if bias is not None:
+        x_proj = x_proj + bias
+    if reverse:
+        x_proj = jnp.flip(x_proj, 0)
+    steps = jnp.arange(t)
+
+    def step(h, inp):
+        s, xp = inp
+        new_h = gru_cell(xp, h, w_hh)
+        if lengths is not None:
+            valid = ((t - 1 - s) < lengths) if reverse else (s < lengths)
+            new_h = jnp.where(valid[:, None], new_h, h)
+        return new_h, new_h
+
+    final, outs = lax.scan(step, h0, (steps, x_proj))
+    if reverse:
+        outs = jnp.flip(outs, 0)
+    if lengths is not None:
+        mask = (jnp.arange(t)[:, None] < lengths[None, :])[..., None]
+        outs = jnp.where(mask, outs, 0.0)
+    return jnp.swapaxes(outs, 0, 1), final

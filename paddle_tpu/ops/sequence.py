@@ -1,0 +1,169 @@
+"""Variable-length sequence ops over padded-batch + lengths representation.
+
+Reference: the LoDTensor sequence-op family —
+``sequence_pool_op.cc``, ``sequence_softmax_op.cc``, ``sequence_expand_op.cc``,
+``sequence_concat_op.cc``, ``sequence_slice_op.cc``, ``sequence_erase_op.cc``,
+``sequence_enumerate_op.cc``, ``sequence_pad_op.cc``, ``sequence_conv`` etc.,
+all driven by LoD offset vectors (``framework/lod_tensor.h:60-106``).
+
+TPU-native representation (see ``paddle_tpu.tensor.ragged.SeqBatch``): a
+padded dense tensor [B, T, ...] plus an int32 ``lengths`` [B] vector; masks
+are derived as ``arange(T) < lengths[:, None]``. XLA requires static shapes,
+so ops compute over the padded buffer and mask — semantically identical to
+LoD-packed results for every op here, with padding waste traded for MXU-
+friendly dense compute.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "length_mask",
+    "sequence_pool",
+    "sequence_softmax",
+    "sequence_expand",
+    "sequence_reverse",
+    "sequence_pad",
+    "sequence_unpad_mask",
+    "sequence_last_step",
+    "sequence_first_step",
+    "sequence_conv",
+    "sequence_erase",
+]
+
+
+def length_mask(lengths: jax.Array, max_len: int, dtype=jnp.bool_) -> jax.Array:
+    """[B, T] validity mask from lengths."""
+    return (jnp.arange(max_len)[None, :] < lengths[:, None]).astype(dtype)
+
+
+def sequence_pool(x: jax.Array, lengths: jax.Array, pool_type: str = "sum") -> jax.Array:
+    """Pool [B, T, D] over valid timesteps → [B, D].
+    pool_types: sum/average/max/last/first/sqrt (reference sequence_pool)."""
+    t = x.shape[1]
+    mask = length_mask(lengths, t)[..., None]  # [B, T, 1]
+    xf = x.astype(jnp.float32)
+    if pool_type == "sum":
+        out = jnp.sum(jnp.where(mask, xf, 0.0), axis=1)
+    elif pool_type in ("average", "avg", "mean"):
+        out = jnp.sum(jnp.where(mask, xf, 0.0), axis=1) / jnp.maximum(
+            lengths[:, None].astype(jnp.float32), 1.0
+        )
+    elif pool_type == "sqrt":
+        out = jnp.sum(jnp.where(mask, xf, 0.0), axis=1) / jnp.sqrt(
+            jnp.maximum(lengths[:, None].astype(jnp.float32), 1.0)
+        )
+    elif pool_type == "max":
+        out = jnp.max(jnp.where(mask, xf, -jnp.inf), axis=1)
+        out = jnp.where(lengths[:, None] > 0, out, 0.0)
+    elif pool_type == "last":
+        idx = jnp.maximum(lengths - 1, 0)
+        out = jnp.take_along_axis(xf, idx[:, None, None], axis=1).squeeze(1)
+        out = jnp.where(lengths[:, None] > 0, out, 0.0)
+    elif pool_type == "first":
+        out = jnp.where(lengths[:, None] > 0, xf[:, 0], 0.0)
+    else:
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+    return out.astype(x.dtype)
+
+
+def sequence_first_step(x, lengths):
+    return sequence_pool(x, lengths, "first")
+
+
+def sequence_last_step(x, lengths):
+    return sequence_pool(x, lengths, "last")
+
+
+def sequence_softmax(x: jax.Array, lengths: jax.Array) -> jax.Array:
+    """Softmax within each row's valid prefix, zeros on padding."""
+    t = x.shape[1]
+    mask = length_mask(lengths, t)
+    while mask.ndim < x.ndim:
+        mask = mask[..., None]
+    xf = jnp.where(mask, x.astype(jnp.float32), -jnp.inf)
+    out = jax.nn.softmax(xf, axis=1)
+    return jnp.where(mask, out, 0.0).astype(x.dtype)
+
+
+def sequence_expand(x: jax.Array, lengths: jax.Array, t: int) -> jax.Array:
+    """Broadcast per-sequence vectors [B, D] along time → [B, T, D] masked by
+    lengths (the padded-batch analogue of reference sequence_expand)."""
+    out = jnp.broadcast_to(x[:, None, :], (x.shape[0], t, x.shape[-1]))
+    mask = length_mask(lengths, t)[..., None]
+    return jnp.where(mask, out, 0.0).astype(x.dtype)
+
+
+def sequence_reverse(x: jax.Array, lengths: jax.Array) -> jax.Array:
+    """Reverse each row's valid prefix in place, keep padding at the tail
+    (reference ``sequence_reverse_op.cc``)."""
+    t = x.shape[1]
+    pos = jnp.arange(t)[None, :]
+    src = jnp.where(pos < lengths[:, None], lengths[:, None] - 1 - pos, pos)
+    return jnp.take_along_axis(x, src[..., None] if x.ndim == 3 else src, axis=1)
+
+
+def sequence_pad(rows: list, max_len: int, pad_value=0.0):
+    """Host-side helper: list of [Ti, D] numpy arrays → (padded [B,T,D], lengths)."""
+    import numpy as np
+
+    b = len(rows)
+    d = rows[0].shape[-1] if rows[0].ndim > 1 else 1
+    out = np.full((b, max_len, d), pad_value, dtype=np.asarray(rows[0]).dtype)
+    lengths = np.zeros((b,), np.int32)
+    for i, r in enumerate(rows):
+        r = np.asarray(r).reshape(-1, d)
+        n = min(len(r), max_len)
+        out[i, :n] = r[:n]
+        lengths[i] = n
+    return out, lengths
+
+
+def sequence_unpad_mask(x: jax.Array, lengths: jax.Array) -> jax.Array:
+    """Zero out padding (the in-graph stand-in for unpad; true unpad is a
+    host-side op since it produces ragged shapes)."""
+    mask = length_mask(lengths, x.shape[1])
+    while mask.ndim < x.ndim:
+        mask = mask[..., None]
+    return jnp.where(mask, x, 0.0)
+
+
+def sequence_conv(x: jax.Array, lengths: jax.Array, weight: jax.Array, context_length: int, context_start: Optional[int] = None) -> jax.Array:
+    """Sequence convolution (reference ``sequence_conv_op.cc``): a sliding
+    window of ``context_length`` steps (centered unless context_start given)
+    projected by ``weight`` [context_length * D, H]. Implemented as gather of
+    shifted copies + one matmul (im2col-free, MXU-friendly)."""
+    b, t, d = x.shape
+    start = context_start if context_start is not None else -(context_length // 2)
+    xm = sequence_unpad_mask(x, lengths)
+    cols = []
+    for off in range(start, start + context_length):
+        if off < 0:
+            shifted = jnp.pad(xm[:, : t + off], ((0, 0), (-off, 0), (0, 0)))
+        elif off > 0:
+            shifted = jnp.pad(xm[:, off:], ((0, 0), (0, off), (0, 0)))
+        else:
+            shifted = xm
+        cols.append(shifted)
+    stacked = jnp.concatenate(cols, axis=-1)  # [B, T, ctx*D]
+    out = jnp.matmul(stacked, weight, preferred_element_type=jnp.float32).astype(x.dtype)
+    return sequence_unpad_mask(out, lengths)
+
+
+def sequence_erase(x: jax.Array, lengths: jax.Array, tokens: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Remove listed token ids from each row, compacting left (reference
+    ``sequence_erase_op.cc``). Works on int id matrices [B, T]. Returns
+    (new_ids, new_lengths); vacated tail positions are 0."""
+    t = x.shape[1]
+    valid = length_mask(lengths, t)
+    keep = valid & ~jnp.isin(x, tokens)
+    # stable compaction: sort positions by (not keep, position)
+    order = jnp.argsort(jnp.where(keep, jnp.arange(t)[None, :], t + jnp.arange(t)[None, :]), axis=1)
+    compacted = jnp.take_along_axis(jnp.where(keep, x, 0), order, axis=1)
+    new_len = jnp.sum(keep.astype(jnp.int32), axis=1)
+    compacted = jnp.where(length_mask(new_len, t), compacted, 0)
+    return compacted, new_len

@@ -1,0 +1,46 @@
+"""Parallelism: device meshes, shardings, collectives, data/model parallel.
+
+TPU-native replacement for the reference multi-device stack —
+``framework/parallel_executor.cc:134`` (ParallelExecutor),
+``framework/details/multi_devices_graph_pass.cc:286`` (SSA graph builder),
+``platform/nccl_helper.h:81`` (NCCLContextMap) and the gen_nccl_id gRPC
+bootstrap (``operators/gen_nccl_id_op.cc:31``).
+
+Here parallelism is declarative: a ``jax.sharding.Mesh`` over ICI/DCN, param/
+batch shardings as NamedSharding annotations, and XLA-compiled collectives
+(psum/all_gather/reduce_scatter/ppermute) instead of scheduled op handles.
+Multi-host bootstrap is ``jax.distributed.initialize`` (the JAX coordination
+service) instead of ncclUniqueId exchange over gRPC.
+"""
+
+from paddle_tpu.parallel.mesh import (
+    make_mesh,
+    default_mesh,
+    initialize_distributed,
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+)
+from paddle_tpu.parallel import collective
+from paddle_tpu.parallel.sharding import (
+    param_shardings,
+    replicated,
+    batch_sharding,
+    shard_variables,
+)
+from paddle_tpu.parallel.data_parallel import DataParallel
+
+__all__ = [
+    "make_mesh",
+    "default_mesh",
+    "initialize_distributed",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "SEQ_AXIS",
+    "collective",
+    "param_shardings",
+    "replicated",
+    "batch_sharding",
+    "shard_variables",
+    "DataParallel",
+]

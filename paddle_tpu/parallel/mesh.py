@@ -1,0 +1,100 @@
+"""Device mesh construction and multi-host initialization.
+
+Reference: ``platform/nccl_helper.h:81-126`` (NCCLContextMap: per-device
+comms, ncclCommInitAll single-process / ncclCommInitRank multi-node with
+nranks = num_trainers × local_devices) and the env-var cluster wiring
+(``trainer.py:229-295`` PADDLE_TRAINER_ID etc.).
+
+TPU-native: one ``jax.sharding.Mesh`` names the parallelism axes; XLA routes
+collectives over ICI within a slice and DCN across slices based on the mesh's
+device layout. ``jax.distributed.initialize`` (coordination service) replaces
+the ncclUniqueId gRPC broadcast.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from paddle_tpu.core import logging as ptlog
+from paddle_tpu.core.enforce import enforce
+
+# Canonical axis names (used by layers' default sharding rules)
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bootstrap (replaces gen_nccl_id_op + NCCLContextMap
+    InitRank). Reads PADDLE_* env vars for drop-in parity with the reference
+    cluster wiring, falling back to JAX's own env autodetection."""
+    coordinator_address = coordinator_address or os.environ.get("PADDLE_COORDINATOR_ADDR")
+    num_processes = num_processes or _env_int("PADDLE_TRAINERS")
+    process_id = process_id if process_id is not None else _env_int("PADDLE_TRAINER_ID")
+    kwargs = {}
+    if coordinator_address:
+        kwargs = dict(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    jax.distributed.initialize(**kwargs)
+    ptlog.info(
+        "distributed initialized: process %d/%d, %d local / %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )
+
+
+def _env_int(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v is not None else None
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None, **axis_sizes: int) -> Mesh:
+    """Build a Mesh from axis name → size. Use -1 for one axis to absorb all
+    remaining devices. Example: ``make_mesh(data=-1)`` or
+    ``make_mesh(data=2, model=4)``.
+
+    Device order follows jax.devices() (ICI-contiguous on TPU): the LAST mesh
+    axis varies fastest, so put the most communication-heavy axis (model/seq)
+    last to keep its collectives on the shortest ICI paths — the analogue of
+    the reference's choice to put ring allreduce on the fastest interconnect.
+    """
+    sizes = dict(axes or {})
+    sizes.update(axis_sizes)
+    enforce(sizes, "make_mesh needs at least one axis")
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    unknown = [k for k, v in sizes.items() if v == -1]
+    enforce(len(unknown) <= 1, "only one axis may be -1")
+    known = int(np.prod([v for v in sizes.values() if v != -1]))
+    if unknown:
+        enforce(n % known == 0, f"{n} devices not divisible by {known}")
+        sizes[unknown[0]] = n // known
+    total = int(np.prod(list(sizes.values())))
+    enforce(
+        total == n,
+        f"mesh wants {total} devices ({sizes}) but {n} are available",
+    )
+    arr = np.array(devices).reshape(tuple(sizes.values()))
+    return Mesh(arr, tuple(sizes.keys()))
+
+
+def default_mesh() -> Mesh:
+    """All local devices on a single data axis (pure DP — the reference
+    ParallelExecutor default)."""
+    return make_mesh({DATA_AXIS: -1})

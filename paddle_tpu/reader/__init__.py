@@ -1,0 +1,260 @@
+"""Functional reader combinators + host→device pipeline.
+
+Reference: ``python/paddle/reader/decorator.py:36-338`` (map_readers/shuffle/
+chain/compose/buffered/firstn/xmap_readers/multiprocess_reader) and the C++
+reader op chain (``paddle/fluid/operators/reader/`` — shuffle/batch/
+double-buffer decorated readers over a blocking queue).
+
+TPU-native: the combinator API is preserved verbatim (a reader is a zero-arg
+callable returning a generator); the C++ double-buffer device prefetcher maps
+to :class:`DevicePrefetcher` which overlaps host batching with device compute
+by keeping N batches in flight on the accelerator.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue as queue_mod
+import random
+import threading
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.reader.feeder import DataFeeder  # noqa: F401
+
+Reader = Callable[[], Iterator[Any]]
+
+__all__ = [
+    "map_readers",
+    "shuffle",
+    "chain",
+    "compose",
+    "buffered",
+    "firstn",
+    "xmap_readers",
+    "batch",
+    "cache",
+    "DataFeeder",
+    "DevicePrefetcher",
+]
+
+
+def map_readers(func: Callable, *readers: Reader) -> Reader:
+    """Apply func to the zipped outputs of several readers
+    (reference decorator.py:36)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for items in zip(*rs):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader: Reader, buf_size: int, seed: Optional[int] = None) -> Reader:
+    """Buffered shuffle (reference decorator.py shuffle)."""
+
+    def shuffled():
+        rng = random.Random(seed)
+        buf: List[Any] = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            rng.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return shuffled
+
+
+def chain(*readers: Reader) -> Reader:
+    def reader():
+        for r in readers:
+            for item in r():
+                yield item
+
+    return reader
+
+
+def compose(*readers: Reader, check_alignment: bool = True) -> Reader:
+    """Zip outputs of several readers into flattened tuples
+    (reference decorator.py compose)."""
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        for items in zip(*rs):
+            yield sum((make_tuple(i) for i in items), ())
+
+    return reader
+
+
+def buffered(reader: Reader, size: int) -> Reader:
+    """Background-thread prefetch buffer (reference decorator.py buffered)."""
+
+    end = object()
+
+    def buffered_reader():
+        q: queue_mod.Queue = queue_mod.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for item in reader():
+                    q.put(item)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                break
+            yield item
+
+    return buffered_reader
+
+
+def firstn(reader: Reader, n: int) -> Reader:
+    def reader_n():
+        return itertools.islice(reader(), n)
+
+    return reader_n
+
+
+def xmap_readers(mapper: Callable, reader: Reader, process_num: int, buffer_size: int, order: bool = False) -> Reader:
+    """Multithreaded map over a reader (reference decorator.py:338
+    xmap_readers). order=True preserves input order."""
+
+    end = object()
+
+    def xreader():
+        in_q: queue_mod.Queue = queue_mod.Queue(buffer_size)
+        out_q: queue_mod.Queue = queue_mod.Queue(buffer_size)
+
+        def feed():
+            for i, item in enumerate(reader()):
+                in_q.put((i, item))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                got = in_q.get()
+                if got is end:
+                    out_q.put(end)
+                    return
+                i, item = got
+                out_q.put((i, mapper(item)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True) for _ in range(process_num)]
+        for w in workers:
+            w.start()
+
+        finished = 0
+        if order:
+            pending = {}
+            next_i = 0
+            while finished < process_num:
+                got = out_q.get()
+                if got is end:
+                    finished += 1
+                    continue
+                i, val = got
+                pending[i] = val
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while finished < process_num:
+                got = out_q.get()
+                if got is end:
+                    finished += 1
+                    continue
+                yield got[1]
+
+    return xreader
+
+
+def batch(reader: Reader, batch_size: int, drop_last: bool = True) -> Reader:
+    """Group samples into lists of batch_size (reference paddle.batch).
+    drop_last defaults True on TPU: static shapes make ragged final batches
+    recompile — the reference's data_balance handled them dynamically."""
+
+    def batch_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
+
+
+def cache(reader: Reader) -> Reader:
+    """Materialize once, replay from memory."""
+    data: List[Any] = []
+    filled = [False]
+
+    def cached():
+        if not filled[0]:
+            for item in reader():
+                data.append(item)
+                yield item
+            filled[0] = True
+        else:
+            for item in data:
+                yield item
+
+    return cached
+
+
+class DevicePrefetcher:
+    """Async host→device double buffer (reference
+    ``operators/reader/buffered_reader.cc`` double_buffer: dedicated thread +
+    pinned→device copies). Wraps an iterator of pytrees of numpy arrays;
+    keeps ``depth`` batches transferred ahead of compute."""
+
+    def __init__(self, it: Iterable, device=None, depth: Optional[int] = None):
+        from paddle_tpu.core import config as cfg
+
+        self._it = iter(it)
+        self._device = device
+        self._depth = depth or cfg.flags().prefetch_depth
+        self._q: queue_mod.Queue = queue_mod.Queue(maxsize=self._depth)
+        self._end = object()
+        self._thread = threading.Thread(target=self._fill, daemon=True)
+        self._thread.start()
+
+    def _fill(self):
+        import jax
+
+        try:
+            for item in self._it:
+                dev_item = jax.device_put(item, self._device)
+                self._q.put(dev_item)
+        finally:
+            self._q.put(self._end)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._end:
+            raise StopIteration
+        return item

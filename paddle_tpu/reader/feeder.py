@@ -1,0 +1,54 @@
+"""DataFeeder: python samples → batched device-ready numpy arrays.
+
+Reference: ``python/paddle/fluid/data_feeder.py:292`` (DataFeeder converts
+per-sample tuples into LoDTensors per feed target, inferring batch layout).
+TPU-native: produces dense numpy batches (and (padded, lengths) pairs for
+ragged slots) ready for jit arguments; no LoD — see
+``paddle_tpu.tensor.ragged``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class FeedSpec:
+    """Describes one feed slot: name, per-sample shape (None = ragged lead
+    dim), dtype."""
+
+    def __init__(self, name: str, shape: Sequence[Optional[int]], dtype="float32", ragged: bool = False, max_len: Optional[int] = None):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype) if not isinstance(dtype, np.dtype) else dtype
+        self.ragged = ragged
+        self.max_len = max_len
+
+
+class DataFeeder:
+    def __init__(self, feed_list: Sequence[FeedSpec]):
+        self.specs = list(feed_list)
+
+    def feed(self, samples: Sequence[Sequence[Any]]) -> Dict[str, np.ndarray]:
+        """samples: list of per-sample tuples aligned with specs. Returns
+        name → batched array; ragged slots produce name and name_len."""
+        out: Dict[str, np.ndarray] = {}
+        for i, spec in enumerate(self.specs):
+            column = [s[i] for s in samples]
+            if spec.ragged:
+                from paddle_tpu.ops.sequence import sequence_pad
+
+                max_len = spec.max_len or max(len(np.atleast_1d(c)) for c in column)
+                rows = [np.asarray(c, dtype=spec.dtype) for c in column]
+                if rows[0].ndim == 1:
+                    rows = [r[:, None] for r in rows]
+                padded, lengths = sequence_pad(rows, max_len)
+                if spec.shape and spec.shape[-1] == 1 and padded.shape[-1] == 1:
+                    pass
+                out[spec.name] = padded.astype(spec.dtype)
+                out[spec.name + "_len"] = lengths
+            else:
+                arr = np.stack([np.asarray(c, dtype=spec.dtype).reshape(spec.shape) for c in column])
+                out[spec.name] = arr
+        return out

@@ -1,0 +1,27 @@
+"""Test config: force an 8-device virtual CPU platform so multi-chip sharding
+paths are exercised without TPU hardware (the analogue of the reference's
+fake in-process device lists in op-handle tests,
+``details/broadcast_op_handle_test.cc``).
+
+Note: this container's sitecustomize imports+configures jax (axon TPU
+platform) at interpreter startup, so setting JAX_PLATFORMS via os.environ here
+is too late — we update jax.config directly, which works because backends
+initialize lazily on first use.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(1234)

@@ -1,0 +1,65 @@
+"""OpTest harness: numeric-gradient checking for the functional op library.
+
+Replicates the reference's single most important test pattern —
+``python/paddle/fluid/tests/unittests/op_test.py``: forward outputs checked
+on every available place (here: CPU against numpy references supplied by the
+test), analytic gradients (jax.grad) checked against central-difference
+numeric gradients (reference get_numeric_gradient, op_test.py:43-120).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def numeric_gradient(fn: Callable, args: Sequence[np.ndarray], argnum: int = 0, delta: float = 5e-3) -> np.ndarray:
+    """Central-difference dL/darg where L = sum(fn(*args))."""
+    args = [np.asarray(a, np.float64) for a in args]
+    x = args[argnum]
+    grad = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+
+    def loss_at(v, i):
+        old = flat[i]
+        flat[i] = v
+        out = np.asarray(fn(*[jnp.asarray(a, jnp.float32) for a in args]), np.float64)
+        flat[i] = old
+        return out.sum()
+
+    for i in range(flat.size):
+        gflat[i] = (loss_at(flat[i] + delta, i) - loss_at(flat[i] - delta, i)) / (2 * delta)
+    return grad
+
+
+def check_grad(
+    fn: Callable,
+    args: Sequence[np.ndarray],
+    argnums: Sequence[int] = (0,),
+    delta: float = 5e-3,
+    rtol: float = 5e-2,
+    atol: float = 5e-3,
+):
+    """Compare jax.grad of sum(fn) against numeric gradients (the
+    check_grad_with_place analogue)."""
+    jargs = [jnp.asarray(a, jnp.float32) for a in args]
+
+    for argnum in argnums:
+        analytic = jax.grad(lambda *a: jnp.sum(fn(*a)).astype(jnp.float32), argnums=argnum)(*jargs)
+        numeric = numeric_gradient(fn, args, argnum=argnum, delta=delta)
+        np.testing.assert_allclose(
+            np.asarray(analytic, np.float64),
+            numeric,
+            rtol=rtol,
+            atol=atol,
+            err_msg=f"gradient mismatch for arg {argnum} of {getattr(fn, '__name__', fn)}",
+        )
+
+
+def check_output(fn: Callable, args: Sequence[np.ndarray], expected: np.ndarray, rtol=1e-5, atol=1e-6):
+    out = np.asarray(fn(*[jnp.asarray(a) for a in args]))
+    np.testing.assert_allclose(out, expected, rtol=rtol, atol=atol)

@@ -1,0 +1,130 @@
+"""Framework core tests: param creation, naming, state threading, scopes.
+
+Mirrors the reference's C++ framework unit tests (scope_test.cc,
+operator_test.cc, var_type_inference_test.cc) at the abstraction that exists
+here: the transform/param-store."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def test_param_creation_and_apply_consistency():
+    def net(x):
+        return layers.fc(x, 16, act="relu", name="fc1")
+
+    model = pt.build(net)
+    x = jnp.ones((4, 8))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    assert set(variables.params) == {"fc1/w", "fc1/b"}
+    assert variables.params["fc1/w"].shape == (8, 16)
+    out, new_state = model.apply(variables, x)
+    assert out.shape == (4, 16)
+    assert new_state == {}
+
+
+def test_duplicate_layer_names_uniquified():
+    def net(x):
+        for _ in range(3):
+            x = layers.fc(x, 8)
+        return x
+
+    model = pt.build(net)
+    variables = model.init(jax.random.PRNGKey(0), jnp.ones((2, 8)))
+    assert {n for n in variables.params if n.endswith("/w")} == {"fc/w", "fc_1/w", "fc_2/w"}
+
+
+def test_name_scope_nesting():
+    def net(x):
+        with pt.name_scope("block"):
+            x = layers.fc(x, 8, name="inner")
+        with pt.name_scope("block"):
+            x = layers.fc(x, 8, name="inner")
+        return x
+
+    model = pt.build(net)
+    variables = model.init(jax.random.PRNGKey(0), jnp.ones((2, 8)))
+    names = sorted(variables.params)
+    assert "block/inner/w" in names
+    assert "block_1/inner/w" in names
+
+
+def test_state_threading_batch_norm():
+    def net(x):
+        return layers.batch_norm(x, name="bn")
+
+    model = pt.build(net)
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 4, 4, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    assert "bn/moving_mean" in variables.state
+    out, new_state = model.apply(variables, x, is_train=True)
+    # moving stats must move in train mode...
+    assert not np.allclose(new_state["bn/moving_mean"], variables.state["bn/moving_mean"])
+    # ...and stay fixed in eval mode
+    out2, state2 = model.apply(variables, x, is_train=False)
+    np.testing.assert_array_equal(state2["bn/moving_mean"], variables.state["bn/moving_mean"])
+
+
+def test_missing_param_raises():
+    def net(x):
+        return layers.fc(x, 4)
+
+    model = pt.build(net)
+    variables = model.init(jax.random.PRNGKey(0), jnp.ones((2, 4)))
+    bad = {k: v for k, v in variables.params.items() if not k.endswith("/b")}
+    with pytest.raises(pt.EnforceError):
+        model.apply((bad, {}), jnp.ones((2, 4)))
+
+
+def test_apply_is_jittable_and_pure():
+    def net(x):
+        h = layers.fc(x, 32, act="tanh")
+        return layers.fc(h, 2)
+
+    model = pt.build(net)
+    x = jnp.ones((4, 8))
+    variables = model.init(jax.random.PRNGKey(0), x)
+
+    @jax.jit
+    def fwd(params, x):
+        out, _ = model.apply((params, {}), x)
+        return out
+
+    out1 = fwd(variables.params, x)
+    out2, _ = model.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), rtol=1e-6)
+
+
+def test_dropout_needs_rng_and_is_train_gated():
+    def net(x):
+        return layers.dropout(x, 0.5)
+
+    model = pt.build(net)
+    x = jnp.ones((128,))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    out_eval, _ = model.apply(variables, x, is_train=False)
+    np.testing.assert_array_equal(np.asarray(out_eval), np.ones(128))
+    out_train, _ = model.apply(variables, x, rng=jax.random.PRNGKey(1), is_train=True)
+    assert np.any(np.asarray(out_train) == 0.0)
+    with pytest.raises(pt.EnforceError):
+        model.apply(variables, x, is_train=True)  # no rng provided
+
+
+def test_param_info_records_metadata():
+    reg = pt.regularizer.L2Decay(1e-4)
+
+    def net(x):
+        return layers.fc(
+            x, 4, param_attr=pt.framework.ParamAttr(regularizer=reg, learning_rate=0.5)
+        )
+
+    model = pt.build(net)
+    model.init(jax.random.PRNGKey(0), jnp.ones((2, 4)))
+    info = model.param_info["fc/w"]
+    assert info.regularizer is reg
+    assert info.learning_rate == 0.5
+    assert model.param_info["fc/b"].regularizer is None

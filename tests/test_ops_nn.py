@@ -1,0 +1,222 @@
+"""Per-op tests for nn ops: forward vs numpy references + numeric grads.
+
+Mirrors reference tests test_conv2d_op.py, test_pool2d_op.py,
+test_batch_norm_op.py, test_softmax_op.py, test_cross_entropy_op.py, etc.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import nn as on
+from op_test import check_grad, check_output
+
+
+def ref_conv2d_nhwc(x, w, stride=1, pad=0):
+    """Direct-loop conv reference (numpy)."""
+    n, h, wd, cin = x.shape
+    kh, kw, _, cout = w.shape
+    xp = np.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, oh, ow, cout))
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, i * stride : i * stride + kh, j * stride : j * stride + kw, :]
+            out[:, i, j, :] = np.tensordot(patch, w, axes=([1, 2, 3], [0, 1, 2]))
+    return out
+
+
+def test_conv2d_forward(rng):
+    x = rng.randn(2, 8, 8, 3).astype(np.float32)
+    w = rng.randn(3, 3, 3, 4).astype(np.float32)
+    expected = ref_conv2d_nhwc(x, w, stride=2, pad=1)
+    check_output(lambda a, b: on.conv2d(a, b, stride=2, padding=1), [x, w], expected, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_grad(rng):
+    x = rng.randn(1, 5, 5, 2).astype(np.float32) * 0.5
+    w = rng.randn(3, 3, 2, 2).astype(np.float32) * 0.5
+    check_grad(lambda a, b: on.conv2d(a, b, stride=1, padding=1), [x, w], argnums=(0, 1))
+
+
+def test_depthwise_conv2d(rng):
+    x = rng.randn(1, 6, 6, 4).astype(np.float32)
+    w = rng.randn(3, 3, 1, 4).astype(np.float32)
+    out = on.depthwise_conv2d(x, w, stride=1, padding=1)
+    assert out.shape == (1, 6, 6, 4)
+    # depthwise = grouped conv with groups=C; check channel 0 against direct conv
+    ref = ref_conv2d_nhwc(x[..., :1], w[:, :, :, :1], stride=1, pad=1)
+    np.testing.assert_allclose(np.asarray(out)[..., 0], ref[..., 0], rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_transpose_shape_and_grad(rng):
+    x = rng.randn(1, 4, 4, 3).astype(np.float32) * 0.5
+    w = rng.randn(2, 2, 3, 5).astype(np.float32) * 0.5
+    out = on.conv2d_transpose(x, w, stride=2, padding=0)
+    assert out.shape == (1, 8, 8, 5)
+    check_grad(lambda a, b: on.conv2d_transpose(a, b, stride=2), [x, w], argnums=(0, 1))
+
+
+def test_conv2d_transpose_is_conv_adjoint(rng):
+    """conv2d_transpose(dy, W.swap(2,3)) must equal the vjp of conv2d wrt x —
+    the defining property of the deconvolution (reference
+    conv_transpose_op.cc implements it literally as the conv grad kernel)."""
+    x = rng.randn(2, 6, 6, 3).astype(np.float32)
+    w = rng.randn(3, 3, 3, 4).astype(np.float32)
+    for stride, pad in [(1, 0), (2, 1), (2, 0)]:
+        y, vjp = jax.vjp(lambda a: on.conv2d(a, jnp.asarray(w), stride=stride, padding=pad), jnp.asarray(x))
+        dy = rng.randn(*y.shape).astype(np.float32)
+        (dx,) = vjp(jnp.asarray(dy))
+        # conv floors its output size; output_padding recovers the remainder
+        opad = (x.shape[1] + 2 * pad - w.shape[0]) % stride
+        via_transpose = on.conv2d_transpose(
+            jnp.asarray(dy), jnp.asarray(w.swapaxes(2, 3)), stride=stride, padding=pad,
+            output_padding=opad,
+        )
+        np.testing.assert_allclose(np.asarray(dx), np.asarray(via_transpose), rtol=1e-4, atol=1e-4)
+
+
+def test_pool2d_max_forward(rng):
+    x = rng.randn(2, 6, 6, 3).astype(np.float32)
+    out = on.pool2d(x, 2, "max", 2)
+    expected = x.reshape(2, 3, 2, 3, 2, 3).max(axis=(2, 4))
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6)
+
+
+def test_pool2d_avg_exclusive_padding(rng):
+    x = np.ones((1, 4, 4, 1), np.float32)
+    out = on.pool2d(x, 3, "avg", 1, pool_padding=1, exclusive=True)
+    # exclusive avg counts only valid cells → all ones
+    np.testing.assert_allclose(np.asarray(out), np.ones_like(np.asarray(out)), rtol=1e-6)
+
+
+def test_pool2d_global(rng):
+    x = rng.randn(2, 5, 7, 3).astype(np.float32)
+    out = on.pool2d(x, pool_type="avg", global_pooling=True)
+    np.testing.assert_allclose(np.asarray(out).squeeze((1, 2)), x.mean(axis=(1, 2)), rtol=1e-5)
+
+
+def test_batch_norm_train_and_infer(rng):
+    x = rng.randn(8, 4, 4, 3).astype(np.float32)
+    scale = np.ones(3, np.float32)
+    bias = np.zeros(3, np.float32)
+    mean0 = np.zeros(3, np.float32)
+    var0 = np.ones(3, np.float32)
+    y, new_mean, new_var, bmean, bvar = on.batch_norm_train(
+        jnp.asarray(x), jnp.asarray(scale), jnp.asarray(bias), jnp.asarray(mean0), jnp.asarray(var0)
+    )
+    np.testing.assert_allclose(np.asarray(bmean), x.mean(axis=(0, 1, 2)), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(y).mean(axis=(0, 1, 2)), np.zeros(3), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(y).std(axis=(0, 1, 2)), np.ones(3), atol=1e-3)
+    # infer mode with batch stats reproduces train output
+    y_inf = on.batch_norm_infer(jnp.asarray(x), scale, bias, bmean, bvar)
+    np.testing.assert_allclose(np.asarray(y_inf), np.asarray(y), rtol=1e-4, atol=1e-4)
+
+
+def test_layer_norm_forward_grad(rng):
+    x = rng.randn(4, 10).astype(np.float32)
+    g = rng.rand(10).astype(np.float32) + 0.5
+    b = rng.randn(10).astype(np.float32)
+    out = on.layer_norm(jnp.asarray(x), jnp.asarray(g), jnp.asarray(b))
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    expected = (x - mean) / np.sqrt(var + 1e-5) * g + b
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4, atol=1e-4)
+    check_grad(lambda a: on.layer_norm(a, jnp.asarray(g), jnp.asarray(b)), [x], rtol=7e-2, atol=7e-3)
+
+
+def test_softmax_cross_entropy_consistency(rng):
+    logits = rng.randn(6, 10).astype(np.float32)
+    labels = rng.randint(0, 10, (6, 1)).astype(np.int64)
+    fused = on.softmax_with_cross_entropy(jnp.asarray(logits), jnp.asarray(labels))
+    composed = on.cross_entropy(on.softmax(jnp.asarray(logits)), jnp.asarray(labels))
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(composed), rtol=1e-4, atol=1e-5)
+    # soft label branch
+    soft = np.exp(rng.randn(6, 10))
+    soft = (soft / soft.sum(-1, keepdims=True)).astype(np.float32)
+    fused_soft = on.softmax_with_cross_entropy(jnp.asarray(logits), jnp.asarray(soft), soft_label=True)
+    expected = -(soft * np.log(jax.nn.softmax(logits, axis=-1))).sum(-1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(fused_soft), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_with_cross_entropy_grad(rng):
+    logits = rng.randn(4, 5).astype(np.float32)
+    labels = rng.randint(0, 5, (4, 1)).astype(np.int64)
+    check_grad(lambda l: on.softmax_with_cross_entropy(l, jnp.asarray(labels)), [logits])
+
+
+def test_sigmoid_cross_entropy(rng):
+    x = rng.randn(5, 3).astype(np.float32)
+    lab = rng.rand(5, 3).astype(np.float32)
+    out = on.sigmoid_cross_entropy_with_logits(jnp.asarray(x), jnp.asarray(lab))
+    p = 1 / (1 + np.exp(-x))
+    expected = -(lab * np.log(p) + (1 - lab) * np.log(1 - p))
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_accuracy():
+    logits = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], np.float32)
+    labels = np.array([[1], [0], [0]], np.int64)
+    acc = on.accuracy(jnp.asarray(logits), jnp.asarray(labels))
+    np.testing.assert_allclose(float(acc), 2.0 / 3.0, rtol=1e-6)
+
+
+def test_one_hot_and_label_smooth():
+    ids = np.array([[1], [3]], np.int64)
+    oh = np.asarray(on.one_hot(jnp.asarray(ids), 4))
+    assert oh.shape == (2, 4)
+    np.testing.assert_array_equal(oh.argmax(-1), [1, 3])
+    sm = np.asarray(on.label_smooth(jnp.asarray(oh), 0.1))
+    np.testing.assert_allclose(sm.sum(-1), np.ones(2), rtol=1e-6)
+    assert sm.min() > 0
+
+
+def test_embedding_lookup_and_grad(rng):
+    table = rng.randn(10, 4).astype(np.float32)
+    ids = np.array([[1], [3], [1]], np.int64)
+    out = on.embedding_lookup(jnp.asarray(table), jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(out), table[[1, 3, 1]], rtol=1e-6)
+    # grad wrt table: scatter-add of upstream ones; row 1 used twice
+    g = jax.grad(lambda t: jnp.sum(on.embedding_lookup(t, jnp.asarray(ids))))(jnp.asarray(table))
+    g = np.asarray(g)
+    assert g[1].sum() == pytest.approx(8.0)  # 2 uses × 4 dims
+    assert g[3].sum() == pytest.approx(4.0)
+    assert g[0].sum() == 0.0
+
+
+def test_embedding_padding_idx(rng):
+    table = rng.randn(5, 3).astype(np.float32)
+    ids = np.array([[0], [2]], np.int64)
+    out = np.asarray(on.embedding_lookup(jnp.asarray(table), jnp.asarray(ids), padding_idx=0))
+    np.testing.assert_array_equal(out[0], np.zeros(3))
+
+
+def test_dropout_scaling(rng):
+    x = np.ones((10000,), np.float32)
+    out = np.asarray(on.dropout(jnp.asarray(x), 0.3, is_test=False, key=jax.random.PRNGKey(0)))
+    kept = out != 0
+    assert abs(kept.mean() - 0.7) < 0.03
+    np.testing.assert_allclose(out[kept], 1 / 0.7, rtol=1e-5)
+
+
+def test_lrn_matches_direct(rng):
+    x = rng.randn(1, 2, 2, 8).astype(np.float32)
+    out = np.asarray(on.lrn(jnp.asarray(x), n=5, k=1.0, alpha=1e-4, beta=0.75))
+    # direct per-channel computation
+    expected = np.zeros_like(x)
+    for c in range(8):
+        lo, hi = max(0, c - 2), min(8, c + 3)
+        denom = (1.0 + 1e-4 * (x[..., lo:hi] ** 2).sum(-1)) ** 0.75
+        expected[..., c] = x[..., c] / denom
+    np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_smooth_l1(rng):
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(3, 4).astype(np.float32)
+    out = np.asarray(on.smooth_l1(jnp.asarray(x), jnp.asarray(y)))
+    d = np.abs(x - y)
+    ref = np.where(d < 1, 0.5 * d * d, d - 0.5).sum(-1, keepdims=True)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)

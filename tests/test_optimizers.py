@@ -1,0 +1,184 @@
+"""Optimizer tests: update-rule math vs hand-rolled numpy references, and a
+tiny end-to-end quadratic minimization per optimizer.
+
+Mirrors reference test_sgd_op.py / test_adam_op.py / test_momentum_op.py etc.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import optimizer as opt_mod
+from paddle_tpu import lr_scheduler as lrs
+from paddle_tpu.framework import Variables
+
+
+def one_step(opt, p0, g0):
+    params = {"w": jnp.asarray(p0)}
+    grads = {"w": jnp.asarray(g0)}
+    st = opt.create_state(params)
+    new_params, new_st = opt.apply_gradients(params, grads, st, {})
+    return np.asarray(new_params["w"]), new_st
+
+
+def test_sgd_step():
+    p, _ = one_step(opt_mod.SGD(0.1), np.array([1.0, 2.0], np.float32), np.array([0.5, -1.0], np.float32))
+    np.testing.assert_allclose(p, [0.95, 2.1], rtol=1e-6)
+
+
+def test_momentum_step():
+    opt = opt_mod.Momentum(0.1, momentum=0.9)
+    params = {"w": jnp.asarray(np.array([1.0], np.float32))}
+    st = opt.create_state(params)
+    g = {"w": jnp.asarray(np.array([1.0], np.float32))}
+    p1, st = opt.apply_gradients(params, g, st, {})
+    p2, st = opt.apply_gradients(p1, g, st, {})
+    # v1 = 1, p1 = 1-0.1; v2 = 0.9+1=1.9, p2 = p1 - 0.19
+    np.testing.assert_allclose(np.asarray(p1["w"]), [0.9], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(p2["w"]), [0.71], rtol=1e-6)
+
+
+def test_adam_matches_reference_formula():
+    beta1, beta2, eps, lr = 0.9, 0.999, 1e-8, 0.01
+    opt = opt_mod.Adam(lr, beta1, beta2, eps)
+    p = np.array([0.5, -0.3], np.float32)
+    g = np.array([0.2, 0.1], np.float32)
+    new_p, _ = one_step(opt, p, g)
+    m = (1 - beta1) * g
+    v = (1 - beta2) * g * g
+    lr_t = lr * np.sqrt(1 - beta2) / (1 - beta1)
+    expected = p - lr_t * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(new_p, expected, rtol=1e-5)
+
+
+def test_adagrad():
+    opt = opt_mod.Adagrad(0.1, epsilon=1e-6)
+    p = np.array([1.0], np.float32)
+    g = np.array([2.0], np.float32)
+    new_p, _ = one_step(opt, p, g)
+    np.testing.assert_allclose(new_p, p - 0.1 * 2.0 / (2.0 + 1e-6), rtol=1e-5)
+
+
+def test_rmsprop():
+    opt = opt_mod.RMSProp(0.1, rho=0.9, epsilon=1e-6)
+    p = np.array([1.0], np.float32)
+    g = np.array([1.0], np.float32)
+    new_p, _ = one_step(opt, p, g)
+    ms = 0.1
+    np.testing.assert_allclose(new_p, p - 0.1 * 1.0 / np.sqrt(ms + 1e-6), rtol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "opt_factory",
+    [
+        lambda: opt_mod.SGD(0.2),
+        lambda: opt_mod.Momentum(0.05, 0.9),
+        lambda: opt_mod.Adagrad(0.5),
+        lambda: opt_mod.Adam(0.2),
+        lambda: opt_mod.Adamax(0.2),
+        lambda: opt_mod.DecayedAdagrad(0.5),
+        lambda: opt_mod.Adadelta(learning_rate=5.0),
+        lambda: opt_mod.RMSProp(0.1),
+        lambda: opt_mod.Ftrl(0.5),
+    ],
+)
+def test_optimizers_reduce_quadratic(opt_factory):
+    """Every optimizer must reduce f(w) = ||w - target||^2."""
+    target = jnp.asarray([1.0, -2.0, 3.0])
+    params = {"w": jnp.zeros(3)}
+    opt = opt_factory()
+    st = opt.create_state(params)
+
+    def loss(p):
+        return jnp.sum(jnp.square(p["w"] - target))
+
+    l0 = float(loss(params))
+    for _ in range(60):
+        g = jax.grad(loss)(params)
+        params, st = opt.apply_gradients(params, g, st, {})
+    assert float(loss(params)) < 0.5 * l0
+
+
+def test_lr_mult_and_trainable_respected():
+    def net(x):
+        a = pt.layers.fc(x, 1, name="a", bias_attr=False,
+                         param_attr=pt.framework.ParamAttr(learning_rate=0.0))
+        b = pt.layers.fc(x, 1, name="frozen", bias_attr=False,
+                         param_attr=pt.framework.ParamAttr(trainable=False))
+        return jnp.mean(a + b)
+
+    model = pt.build(net)
+    x = jnp.ones((2, 3))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    opt = opt_mod.SGD(1.0)
+    step = opt.minimize(model)
+    out = step(variables, opt.create_state(variables.params), x)
+    # lr-mult 0 → param unchanged; trainable False → untouched
+    np.testing.assert_allclose(
+        np.asarray(out.variables.params["a/w"]), np.asarray(variables.params["a/w"]), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.variables.params["frozen/w"]), np.asarray(variables.params["frozen/w"]), rtol=1e-6
+    )
+
+
+def test_regularization_applied():
+    opt = opt_mod.SGD(1.0, regularization=pt.regularizer.L2Decay(0.1))
+    p = np.array([2.0], np.float32)
+    g = np.array([0.0], np.float32)
+    new_p, _ = one_step(opt, p, g)
+    np.testing.assert_allclose(new_p, [2.0 - 0.1 * 2.0], rtol=1e-6)
+
+
+def test_global_norm_clip():
+    clipper = pt.clip.GradientClipByGlobalNorm(1.0)
+    grads = {"a": jnp.asarray([3.0]), "b": jnp.asarray([4.0])}
+    clipped = clipper(grads)
+    norm = np.sqrt(sum(float(jnp.sum(v**2)) for v in clipped.values()))
+    np.testing.assert_allclose(norm, 1.0, rtol=1e-5)
+
+
+def test_lr_schedulers():
+    step = jnp.asarray(0)
+    assert float(lrs.Constant(0.5)(step)) == 0.5
+    pw = lrs.PiecewiseDecay([10, 20], [1.0, 0.1, 0.01])
+    assert float(pw(jnp.asarray(5))) == 1.0
+    assert float(pw(jnp.asarray(15))) == pytest.approx(0.1)
+    assert float(pw(jnp.asarray(25))) == pytest.approx(0.01)
+    noam = lrs.NoamDecay(512, 4000)
+    # increasing during warmup, decreasing after
+    assert float(noam(jnp.asarray(100))) < float(noam(jnp.asarray(4000)))
+    assert float(noam(jnp.asarray(8000))) < float(noam(jnp.asarray(4000)))
+    exp = lrs.ExponentialDecay(1.0, 10, 0.5, staircase=True)
+    assert float(exp(jnp.asarray(9))) == 1.0
+    assert float(exp(jnp.asarray(10))) == pytest.approx(0.5)
+
+
+def test_minimize_trains_linear_regression():
+    """End-to-end minimize() on least squares (the fit_a_line book test in
+    miniature, reference tests/book/test_fit_a_line.py)."""
+    rng = np.random.RandomState(0)
+    true_w = np.array([[2.0], [-3.0]], np.float32)
+    x_data = rng.randn(64, 2).astype(np.float32)
+    y_data = x_data @ true_w + 0.5
+
+    def net(x, y):
+        pred = pt.layers.fc(x, 1, bias_attr=True)
+        loss = jnp.mean(pt.layers.square_error_cost(pred, y))
+        return loss, pred
+
+    model = pt.build(net)
+    x, y = jnp.asarray(x_data), jnp.asarray(y_data)
+    variables = model.init(jax.random.PRNGKey(0), x, y)
+    opt = opt_mod.SGD(0.1)
+    step = jax.jit(opt.minimize(model))
+    st = opt.create_state(variables.params)
+    losses = []
+    for _ in range(100):
+        out = step(variables, st, x, y)
+        variables, st = out.variables, out.opt_state
+        losses.append(float(out.loss))
+    assert losses[-1] < 0.05 * losses[0]
+    np.testing.assert_allclose(np.asarray(variables.params["fc/w"]), true_w, atol=0.2)
