@@ -49,15 +49,6 @@ def _serial_dir(root: str, serial: int) -> str:
     return os.path.join(root, f"checkpoint_{serial}")
 
 
-def _flatten(tree) -> Dict[str, np.ndarray]:
-    flat = {}
-    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
-    for path, leaf in leaves:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
-        flat[key] = np.asarray(leaf)
-    return flat
-
-
 def save_checkpoint(
     root: str,
     tree: Any,

@@ -53,7 +53,9 @@ class Executor:
     ) -> Callable:
         """Compile-cache a function for this executor's device
         (Executor::Prepare parity)."""
-        cache_key = key if key is not None else (id(fn), tuple(donate_argnums), tuple(static_argnums))
+        # key on the function object itself (kept alive by the cache) — an
+        # id() key could collide after GC recycles the address
+        cache_key = key if key is not None else (fn, tuple(donate_argnums), tuple(static_argnums))
         if cache_key not in self._cache:
             if len(self._cache) >= self._max_cache:
                 # FIFO eviction: callers passing fresh closures per step would

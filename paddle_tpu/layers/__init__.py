@@ -312,14 +312,24 @@ def dynamic_lstm(
     param_attr=None,
     bias_attr=None,
     is_reverse: bool = False,
+    proj_input: bool = True,
     name: Optional[str] = None,
 ):
     """LSTM over padded [B, T, D] (reference ``dynamic_lstm`` layer; here
     ``size`` is the hidden size H, weights [D,4H]/[H,4H]). Returns
-    (hidden [B,T,H], (h_final, c_final))."""
+    (hidden [B,T,H], (h_final, c_final)).
+
+    ``proj_input=False`` reproduces fluid semantics exactly: the input must
+    already be fc-projected to [B, T, 4H] and no w_ih is created (reference
+    dynamic_lstm has only recurrent weights — the preceding fc IS the input
+    projection)."""
     with name_scope(name or "lstm"):
         d = input.shape[-1]
-        w_ih = create_parameter([d, 4 * size], input.dtype, name="w_ih", attr=param_attr)
+        if proj_input:
+            w_ih = create_parameter([d, 4 * size], input.dtype, name="w_ih", attr=param_attr)
+        else:
+            enforce(d == 4 * size, f"proj_input=False expects input dim {4*size}, got {d}")
+            w_ih = None
         w_hh = create_parameter([size, 4 * size], input.dtype, name="w_hh", attr=param_attr)
         b = (
             create_parameter([4 * size], input.dtype, name="b", attr=bias_attr, default_initializer=init_mod.Constant(0.0))
@@ -337,11 +347,16 @@ def dynamic_gru(
     param_attr=None,
     bias_attr=None,
     is_reverse: bool = False,
+    proj_input: bool = True,
     name: Optional[str] = None,
 ):
     with name_scope(name or "gru"):
         d = input.shape[-1]
-        w_ih = create_parameter([d, 3 * size], input.dtype, name="w_ih", attr=param_attr)
+        if proj_input:
+            w_ih = create_parameter([d, 3 * size], input.dtype, name="w_ih", attr=param_attr)
+        else:
+            enforce(d == 3 * size, f"proj_input=False expects input dim {3*size}, got {d}")
+            w_ih = None
         w_hh = create_parameter([size, 3 * size], input.dtype, name="w_hh", attr=param_attr)
         b = (
             create_parameter([3 * size], input.dtype, name="b", attr=bias_attr, default_initializer=init_mod.Constant(0.0))
@@ -379,4 +394,23 @@ def data(name: str, shape: Sequence[int], dtype="float32", lod_level: int = 0):
     return jax.ShapeDtypeStruct(tuple(s for s in shape), _d.convert(dtype))
 
 
-__all__ = [n for n in dir() if not n.startswith("_")]
+# explicit export surface: layer fns defined here + the functional ops
+# re-exported above (star-import of ops.math plus the named nn/sequence
+# imports) — NOT modules/typing names
+from paddle_tpu.ops import math as _om_mod
+
+_LOCAL_LAYERS = [
+    "fc", "embedding", "conv2d", "conv2d_transpose", "pool2d", "batch_norm",
+    "layer_norm", "dropout", "prelu", "dynamic_lstm", "dynamic_gru",
+    "sequence_conv", "data",
+]
+_OP_REEXPORTS = [
+    "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits", "square_error_cost", "smooth_l1",
+    "huber_loss", "kldiv_loss", "log_loss", "accuracy", "one_hot",
+    "label_smooth", "l2_normalize", "cos_sim", "lrn", "pad2d",
+    "resize_bilinear", "resize_nearest", "pixel_shuffle",
+    "sequence_pool", "sequence_softmax", "sequence_reverse",
+    "sequence_first_step", "sequence_last_step", "sequence_expand",
+]
+__all__ = _LOCAL_LAYERS + _OP_REEXPORTS + list(_om_mod.__all__)

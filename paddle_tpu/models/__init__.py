@@ -1,0 +1,97 @@
+"""Model zoo — the ``benchmark/fluid/models`` configs rebuilt TPU-first.
+
+Reference: ``benchmark/fluid/models/{mnist,resnet,se_resnext,vgg,
+machine_translation,stacked_dynamic_lstm}.py`` and
+``benchmark/fluid/fluid_benchmark.py:310`` (model registry / get_model
+protocol). Each module here exposes ``get_model(**cfg) -> ModelSpec`` where
+the spec carries a built :class:`paddle_tpu.framework.Model` whose forward
+returns ``(loss, metric_or_logits, ...)``, plus a synthetic-batch generator
+mirroring the reference's fake-data path
+(``fluid_benchmark.py:148-162`` fill-constant feeds).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.framework import Model
+
+__all__ = ["ModelSpec", "get_model", "MODELS"]
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """A runnable benchmark config (get_model protocol)."""
+
+    name: str
+    model: Model
+    # synth_batch(batch_size, rng) -> tuple of numpy arrays fed to model.apply
+    synth_batch: Callable[[int, np.random.RandomState], Tuple[np.ndarray, ...]]
+    optimizer: Callable[[], Any]
+    unit: str = "examples/sec"
+    # elements counted per batch row for throughput (e.g. tokens per sentence)
+    examples_per_row: int = 1
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def get_model(name: str, **cfg) -> ModelSpec:
+    """Look up and instantiate a benchmark model by reference name."""
+    if name not in MODELS:
+        raise KeyError(f"unknown model {name!r}; available: {sorted(MODELS)}")
+    return MODELS[name](**cfg)
+
+
+def _mnist(**cfg):
+    from paddle_tpu.models import mnist
+
+    return mnist.get_model(**cfg)
+
+
+def _resnet(**cfg):
+    from paddle_tpu.models import resnet
+
+    return resnet.get_model(**cfg)
+
+
+def _se_resnext(**cfg):
+    from paddle_tpu.models import se_resnext
+
+    return se_resnext.get_model(**cfg)
+
+
+def _vgg(**cfg):
+    from paddle_tpu.models import vgg
+
+    return vgg.get_model(**cfg)
+
+
+def _transformer(**cfg):
+    from paddle_tpu.models import transformer
+
+    return transformer.get_model(**cfg)
+
+
+def _stacked_dynamic_lstm(**cfg):
+    from paddle_tpu.models import stacked_lstm
+
+    return stacked_lstm.get_model(**cfg)
+
+
+def _machine_translation(**cfg):
+    from paddle_tpu.models import machine_translation
+
+    return machine_translation.get_model(**cfg)
+
+
+MODELS: Dict[str, Callable[..., ModelSpec]] = {
+    "mnist": _mnist,
+    "resnet": _resnet,
+    "se_resnext": _se_resnext,
+    "vgg": _vgg,
+    "transformer": _transformer,
+    "stacked_dynamic_lstm": _stacked_dynamic_lstm,
+    "machine_translation": _machine_translation,
+}

@@ -1,0 +1,119 @@
+"""Seq2seq LSTM with attention (machine_translation config).
+
+Reference: ``benchmark/fluid/models/machine_translation.py`` — WMT16
+encoder-decoder: embedding → fc → dynamic_lstm encoder; decoder DynamicRNN
+with dot-product attention over encoder states, fc softmax per step; Adam.
+The reference's DynamicRNN + LoD sequence walk becomes a ``lax.scan`` over
+padded [B, T] steps with length masks; attention is a batched matmul the MXU
+executes directly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework import create_parameter, name_scope
+from paddle_tpu.models import ModelSpec
+from paddle_tpu.ops import rnn as orn
+from paddle_tpu.ops import sequence as oseq
+
+
+def encoder(src_ids, src_lens, *, vocab_size, emb_dim, hidden_dim):
+    with name_scope("encoder"):
+        emb = layers.embedding(src_ids, size=[vocab_size, emb_dim])
+        # fluid structure: the fc IS the LSTM input projection (reference
+        # machine_translation.py:59-65), dynamic_lstm carries only w_hh
+        proj = layers.fc(emb, size=hidden_dim * 4, num_flatten_dims=2, act=None)
+        out, (h, c) = layers.dynamic_lstm(
+            proj, size=hidden_dim, lengths=src_lens, proj_input=False
+        )
+        return out, (h, c)
+
+
+def attention_step(dec_h, enc_out, enc_mask):
+    """Dot-product attention: scores over encoder steps, masked softmax,
+    context vector (reference simple_attention in machine_translation.py)."""
+    scores = jnp.einsum("bh,bth->bt", dec_h, enc_out)
+    scores = jnp.where(enc_mask, scores, -jnp.inf)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bt,bth->bh", weights, enc_out)
+
+
+def decoder_train(trg_ids, enc_out, enc_mask, init_state, *, vocab_size, emb_dim, hidden_dim):
+    """Teacher-forced decoder: per step, LSTM cell on [emb; context]."""
+    with name_scope("decoder"):
+        emb = layers.embedding(trg_ids, size=[vocab_size, emb_dim])
+        d = emb_dim + hidden_dim
+        w_ih = create_parameter([d, 4 * hidden_dim], emb.dtype, name="w_ih")
+        w_hh = create_parameter([hidden_dim, 4 * hidden_dim], emb.dtype, name="w_hh")
+        b = create_parameter([4 * hidden_dim], emb.dtype, name="b",
+                             default_initializer=pt.initializer.Constant(0.0))
+        w_out = create_parameter([hidden_dim, vocab_size], emb.dtype, name="w_out")
+        b_out = create_parameter([vocab_size], emb.dtype, name="b_out",
+                                 default_initializer=pt.initializer.Constant(0.0))
+
+        def step(carry, x_t):
+            ctx = attention_step(carry.h, enc_out, enc_mask)
+            inp = jnp.concatenate([x_t, ctx], axis=-1)
+            x_proj = jnp.matmul(inp, w_ih, preferred_element_type=jnp.float32).astype(inp.dtype)
+            new = orn.lstm_cell(x_proj, carry, w_hh, b)
+            return new, new.h
+
+        xs = jnp.swapaxes(emb, 0, 1)  # [T, B, E]
+        _, hs = jax.lax.scan(step, orn.LSTMState(*init_state), xs)
+        hs = jnp.swapaxes(hs, 0, 1)  # [B, T, H]
+        logits = jnp.matmul(hs, w_out, preferred_element_type=jnp.float32) + b_out
+        return logits.astype(jnp.float32)
+
+
+def seq_to_seq_net(src_ids, src_lens, trg_ids, labels, trg_lens, *, vocab_size, emb_dim, hidden_dim):
+    enc_out, (h, c) = encoder(src_ids, src_lens, vocab_size=vocab_size, emb_dim=emb_dim, hidden_dim=hidden_dim)
+    enc_mask = oseq.length_mask(src_lens, src_ids.shape[1])
+    logits = decoder_train(
+        trg_ids, enc_out, enc_mask, (h, c),
+        vocab_size=vocab_size, emb_dim=emb_dim, hidden_dim=hidden_dim,
+    )
+    tok_loss = layers.softmax_with_cross_entropy(logits, labels)[..., 0]
+    weight = oseq.length_mask(trg_lens, trg_ids.shape[1]).astype(jnp.float32)
+    n_tok = jnp.maximum(jnp.sum(weight), 1.0)
+    avg_loss = jnp.sum(tok_loss * weight) / n_tok
+    return avg_loss, n_tok, logits
+
+
+def get_model(
+    vocab_size: int = 10000,
+    emb_dim: int = 512,
+    hidden_dim: int = 512,
+    seq_len: int = 50,
+    learning_rate: float = 2e-4,
+    **_unused,
+) -> ModelSpec:
+    model = pt.build(
+        functools.partial(
+            seq_to_seq_net, vocab_size=vocab_size, emb_dim=emb_dim, hidden_dim=hidden_dim
+        ),
+        name="machine_translation",
+    )
+
+    def synth_batch(batch_size: int, rng: np.random.RandomState):
+        src = rng.randint(0, vocab_size, size=(batch_size, seq_len)).astype(np.int32)
+        trg = rng.randint(0, vocab_size, size=(batch_size, seq_len)).astype(np.int32)
+        labels = rng.randint(0, vocab_size, size=(batch_size, seq_len)).astype(np.int32)
+        src_lens = rng.randint(seq_len // 2, seq_len + 1, size=(batch_size,)).astype(np.int32)
+        trg_lens = rng.randint(seq_len // 2, seq_len + 1, size=(batch_size,)).astype(np.int32)
+        return src, src_lens, trg, labels, trg_lens
+
+    return ModelSpec(
+        name="machine_translation",
+        model=model,
+        synth_batch=synth_batch,
+        optimizer=lambda: pt.optimizer.Adam(learning_rate=learning_rate),
+        unit="words/sec",
+        examples_per_row=seq_len,
+    )

@@ -1,0 +1,79 @@
+"""Stacked LSTM sentiment model (stacked_dynamic_lstm).
+
+Reference: ``benchmark/fluid/models/stacked_dynamic_lstm.py`` — IMDB
+sentiment: embedding(512) → stacked fc+LSTM layers → [max,last] pooling →
+fc(2) softmax, Adam(lr=0.002). Variable-length LoD input becomes padded
+[B, T] + lengths; ``lax.scan`` replaces the dynamic_lstm C++ sequence kernel
+(``operators/lstm_op.cc``), and pooling masks pad positions.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.models import ModelSpec
+from paddle_tpu.ops import sequence as oseq
+
+
+def stacked_lstm_net(word_ids, lengths, labels, *, vocab_size, emb_dim, hidden_dim, stacked_num, class_dim):
+    emb = layers.embedding(word_ids, size=[vocab_size, emb_dim])
+    x = layers.fc(emb, size=hidden_dim, num_flatten_dims=2, act="tanh", name="fc0")
+    for i in range(stacked_num):
+        # fluid structure: fc to 4H is the LSTM input projection (dynamic_lstm
+        # itself carries only recurrent weights, proj_input=False)
+        proj = layers.fc(x, size=hidden_dim * 4, num_flatten_dims=2, name=f"fc_{i}")
+        lstm_out, _ = layers.dynamic_lstm(
+            proj, size=hidden_dim, lengths=lengths, proj_input=False, name=f"lstm_{i}"
+        )
+        x = lstm_out
+    max_pool = layers.sequence_pool(x, lengths, pool_type="max")
+    last = layers.sequence_last_step(x, lengths)
+    feat = jnp.concatenate([max_pool, last], axis=-1)
+    logits = layers.fc(feat, size=class_dim)
+    loss = layers.softmax_with_cross_entropy(logits, labels)
+    avg_loss = layers.reduce_mean(loss)
+    acc = layers.accuracy(logits, labels)
+    return avg_loss, acc, logits
+
+
+def get_model(
+    vocab_size: int = 5147,
+    emb_dim: int = 512,
+    hidden_dim: int = 512,
+    stacked_num: int = 3,
+    class_dim: int = 2,
+    seq_len: int = 80,
+    learning_rate: float = 0.002,
+    **_unused,
+) -> ModelSpec:
+    model = pt.build(
+        functools.partial(
+            stacked_lstm_net,
+            vocab_size=vocab_size,
+            emb_dim=emb_dim,
+            hidden_dim=hidden_dim,
+            stacked_num=stacked_num,
+            class_dim=class_dim,
+        ),
+        name="stacked_dynamic_lstm",
+    )
+
+    def synth_batch(batch_size: int, rng: np.random.RandomState):
+        ids = rng.randint(0, vocab_size, size=(batch_size, seq_len)).astype(np.int32)
+        lens = rng.randint(seq_len // 2, seq_len + 1, size=(batch_size,)).astype(np.int32)
+        labels = rng.randint(0, class_dim, size=(batch_size,)).astype(np.int32)
+        return ids, lens, labels
+
+    return ModelSpec(
+        name="stacked_dynamic_lstm",
+        model=model,
+        synth_batch=synth_batch,
+        optimizer=lambda: pt.optimizer.Adam(learning_rate=learning_rate),
+        unit="words/sec",
+        examples_per_row=seq_len,
+    )

@@ -80,6 +80,11 @@ def dynamic_lstm(
     the final state matches the variable-length semantics exactly.
 
     Returns (outputs [B, T, H], final LSTMState).
+
+    ``w_ih=None`` means the input is already projected to [.., 4H] by an
+    upstream fc — fluid ``dynamic_lstm`` semantics ("input projection ...
+    done outside of dynamic_lstm", reference
+    ``benchmark/fluid/models/machine_translation.py:59``).
     """
     if not time_major:
         x = jnp.swapaxes(x, 0, 1)  # [T, B, D]
@@ -89,7 +94,10 @@ def dynamic_lstm(
         init_state = LSTMState(
             jnp.zeros((b, hsize), x.dtype), jnp.zeros((b, hsize), x.dtype)
         )
-    x_proj = jnp.matmul(x, w_ih, preferred_element_type=jnp.float32).astype(x.dtype)  # [T, B, 4H]
+    if w_ih is None:
+        x_proj = x  # pre-projected [T, B, 4H]
+    else:
+        x_proj = jnp.matmul(x, w_ih, preferred_element_type=jnp.float32).astype(x.dtype)  # [T, B, 4H]
     if reverse:
         x_proj = jnp.flip(x_proj, 0)
 
@@ -133,12 +141,16 @@ def dynamic_gru(
     init_h: Optional[jax.Array] = None,
     reverse: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Full-sequence GRU over padded [B, T, D]."""
+    """Full-sequence GRU over padded [B, T, D]. ``w_ih=None`` means the input
+    is already projected to [.., 3H] (fluid dynamic_gru semantics)."""
     x = jnp.swapaxes(x, 0, 1)
     t, b, _ = x.shape
     hsize = w_hh.shape[0]
     h0 = init_h if init_h is not None else jnp.zeros((b, hsize), x.dtype)
-    x_proj = jnp.matmul(x, w_ih, preferred_element_type=jnp.float32).astype(x.dtype)
+    if w_ih is None:
+        x_proj = x
+    else:
+        x_proj = jnp.matmul(x, w_ih, preferred_element_type=jnp.float32).astype(x.dtype)
     if bias is not None:
         x_proj = x_proj + bias
     if reverse:

@@ -25,6 +25,16 @@ from paddle_tpu.reader.feeder import DataFeeder  # noqa: F401
 
 Reader = Callable[[], Iterator[Any]]
 
+
+class _RaisedInProducer:
+    """Wrapper carrying a producer-thread exception across the queue so the
+    consumer re-raises it instead of treating a dead producer as EOF
+    (the reference's reader threads propagate via ExceptionHolder,
+    ``details/exception_holder.h``)."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
 __all__ = [
     "map_readers",
     "shuffle",
@@ -109,8 +119,9 @@ def buffered(reader: Reader, size: int) -> Reader:
             try:
                 for item in reader():
                     q.put(item)
-            finally:
                 q.put(end)
+            except BaseException as e:  # propagate to consumer, don't fake EOF
+                q.put(_RaisedInProducer(e))
 
         t = threading.Thread(target=fill, daemon=True)
         t.start()
@@ -118,6 +129,8 @@ def buffered(reader: Reader, size: int) -> Reader:
             item = q.get()
             if item is end:
                 break
+            if isinstance(item, _RaisedInProducer):
+                raise item.exc
             yield item
 
     return buffered_reader
@@ -247,8 +260,9 @@ class DevicePrefetcher:
             for item in self._it:
                 dev_item = jax.device_put(item, self._device)
                 self._q.put(dev_item)
-        finally:
             self._q.put(self._end)
+        except BaseException as e:  # surface pipeline errors, don't fake EOF
+            self._q.put(_RaisedInProducer(e))
 
     def __iter__(self):
         return self
@@ -257,4 +271,6 @@ class DevicePrefetcher:
         item = self._q.get()
         if item is self._end:
             raise StopIteration
+        if isinstance(item, _RaisedInProducer):
+            raise item.exc
         return item
