@@ -1,0 +1,61 @@
+"""Benchmark entry — prints ONE JSON line with the headline metric.
+
+Headline config: ResNet-50 training throughput (images/sec) on synthetic
+224×224 data, the ``benchmark/fluid`` ResNet config (reference
+``benchmark/fluid/models/resnet.py``, metric printed as examples/sec at
+``fluid_benchmark.py:295-301``). ``vs_baseline`` is measured against the
+strongest published in-tree reference number for ResNet-50 training:
+84.08 img/s (2-socket Xeon 6148, ``benchmark/IntelOptimizedPaddle.md:41-45``;
+no GPU Fluid ResNet-50 number is published in-tree — see BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_PER_SEC = 84.08  # ResNet-50 train bs256, 2S Xeon 6148 (in-tree)
+
+
+def main(batch_size: int = 64, warmup: int = 2, iters: int = 10) -> dict:
+    import jax
+
+    from paddle_tpu import models
+
+    spec = models.get_model("resnet", dataset="flowers", depth=50, class_dim=1000)
+    rng = np.random.RandomState(0)
+    batch = spec.synth_batch(batch_size, rng)
+    variables = spec.model.init(0, *batch)
+    opt = spec.optimizer()
+    opt_state = opt.create_state(variables.params)
+    step_fn = jax.jit(opt.minimize(spec.model), donate_argnums=(0, 1))
+    dev_batch = tuple(jax.device_put(b) for b in batch)
+
+    v, o = variables, opt_state
+    for _ in range(warmup):
+        out = step_fn(v, o, *dev_batch)
+        v, o = out.variables, out.opt_state
+    jax.block_until_ready(out.loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step_fn(v, o, *dev_batch)
+        v, o = out.variables, out.opt_state
+    jax.block_until_ready(out.loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch_size * iters / dt
+    result = {
+        "metric": "resnet50_train_images_per_sec",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+    }
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -33,9 +33,8 @@ from paddle_tpu.framework import ParamAttr, create_parameter, name_scope
 from paddle_tpu.models import ModelSpec
 from paddle_tpu.ops import attention as oattn
 
-# logical mesh-axis names used in sharding annotations; the parallel package
-# maps them onto a physical mesh (absent axes are ignored → fully replicated)
-TP = "tp"
+# canonical tensor-parallel mesh axis; absent from a mesh → replicated
+from paddle_tpu.parallel.mesh import MODEL_AXIS as TP
 
 
 def _proj(x, size, *, shard_out: bool, name: str, bias: bool = True):

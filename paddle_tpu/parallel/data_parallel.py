@@ -45,13 +45,19 @@ class DataParallel:
         batch_axis: str = mesh_mod.DATA_AXIS,
         loss_index: int = 0,
         donate: bool = True,
+        batch_specs: Optional[Sequence[Optional[P]]] = None,
     ):
+        """``batch_specs``: optional per-batch-arg PartitionSpecs overriding
+        the default leading-dim data sharding — e.g. shard the sequence dim of
+        token inputs over the ``seq`` axis: ``P('data', 'seq')`` (sequence
+        parallelism; the activation sharding the reference never had)."""
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh if mesh is not None else mesh_mod.default_mesh()
         self.batch_axis = batch_axis
         self.loss_index = loss_index
         self.donate = donate
+        self.batch_specs = tuple(batch_specs) if batch_specs is not None else None
         self._step_fn = None
         self._eval_fn = None
         enforce(
@@ -79,23 +85,39 @@ class DataParallel:
         return variables, opt_state
 
     def _batch_shardings(self, batch: Sequence[Any]):
+        if self.batch_specs is not None:
+            enforce(
+                len(self.batch_specs) == len(batch),
+                f"batch_specs has {len(self.batch_specs)} entries for {len(batch)} batch args",
+            )
+            return tuple(
+                NamedSharding(self.mesh, spec if spec is not None else P())
+                for spec in self.batch_specs
+            )
         return tuple(
             NamedSharding(self.mesh, P(self.batch_axis, *([None] * (jax.numpy.ndim(b) - 1))))
             for b in batch
         )
 
     def put_batch(self, *batch):
-        """Shard a global host batch across the data axis (the per-device
-        feed split of ParallelExecutor.run, parallel_executor.py:173)."""
-        n = self.mesh.shape[self.batch_axis]
-        for b in batch:
-            enforce(
-                jax.numpy.shape(b)[0] % n == 0,
-                f"global batch dim {jax.numpy.shape(b)[0]} must be divisible by "
-                f"the {self.batch_axis!r} mesh axis size {n} (static shapes: "
-                "drop or pad the last partial batch)",
-            )
+        """Shard a global host batch across the mesh (the per-device feed
+        split of ParallelExecutor.run, parallel_executor.py:173). Validates
+        each arg dim against the mesh-axis sizes its spec shards it over."""
         shards = self._batch_shardings(batch)
+        for b, s in zip(batch, shards):
+            shape = jax.numpy.shape(b)
+            for dim, axes in enumerate(s.spec[: len(shape)]):
+                if axes is None:
+                    continue
+                size = 1
+                for a in (axes if isinstance(axes, tuple) else (axes,)):
+                    size *= self.mesh.shape[a]
+                enforce(
+                    shape[dim] % size == 0,
+                    f"batch arg dim {dim} of size {shape[dim]} not divisible by "
+                    f"mesh axes {axes} (size {size}) (static shapes: drop or "
+                    "pad the last partial batch)",
+                )
         return tuple(jax.device_put(b, s) for b, s in zip(batch, shards))
 
     # -- compiled steps -----------------------------------------------------

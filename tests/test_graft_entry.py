@@ -1,0 +1,34 @@
+"""Driver-contract tests: entry() must trace under jit; dryrun_multichip
+must compile+run the sharded train step on the virtual 8-device mesh."""
+
+import sys
+import os
+
+import jax
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import __graft_entry__ as graft
+
+
+def test_entry_traces():
+    fn, args = graft.entry()
+    shapes = jax.eval_shape(fn, *args)  # trace-only: no compile/execute
+    loss_shape, logits_shape = shapes
+    assert loss_shape.shape == ()
+
+
+def test_dryrun_multichip_8():
+    assert len(jax.devices()) == 8
+    graft.dryrun_multichip(8)
+
+
+def test_factorize():
+    assert graft._factorize(8, 3) == [2, 2, 2]
+    assert graft._factorize(4, 3) == [2, 2, 1]
+    assert graft._factorize(1, 3) == [1, 1, 1]
+    assert graft._factorize(16, 3) == [4, 2, 2]
+    # odd factors fold into dp only (tp/sp must divide power-of-two dims)
+    assert graft._factorize(27, 3) == [27, 1, 1]
+    assert graft._factorize(12, 3) == [6, 2, 1]
