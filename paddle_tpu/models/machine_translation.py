@@ -45,18 +45,28 @@ def attention_step(dec_h, enc_out, enc_mask):
     return jnp.einsum("bt,bth->bh", weights, enc_out)
 
 
+def _decoder_params(vocab_size, emb_dim, hidden_dim, dtype):
+    """Decoder parameter set, created once so the train and beam-decode graphs
+    share names AND initializers (must be called inside name_scope('decoder'),
+    right after the target embedding)."""
+    d = emb_dim + hidden_dim
+    w_ih = create_parameter([d, 4 * hidden_dim], dtype, name="w_ih")
+    w_hh = create_parameter([hidden_dim, 4 * hidden_dim], dtype, name="w_hh")
+    b = create_parameter([4 * hidden_dim], dtype, name="b",
+                         default_initializer=pt.initializer.Constant(0.0))
+    w_out = create_parameter([hidden_dim, vocab_size], dtype, name="w_out")
+    b_out = create_parameter([vocab_size], dtype, name="b_out",
+                             default_initializer=pt.initializer.Constant(0.0))
+    return w_ih, w_hh, b, w_out, b_out
+
+
 def decoder_train(trg_ids, enc_out, enc_mask, init_state, *, vocab_size, emb_dim, hidden_dim):
     """Teacher-forced decoder: per step, LSTM cell on [emb; context]."""
     with name_scope("decoder"):
         emb = layers.embedding(trg_ids, size=[vocab_size, emb_dim])
-        d = emb_dim + hidden_dim
-        w_ih = create_parameter([d, 4 * hidden_dim], emb.dtype, name="w_ih")
-        w_hh = create_parameter([hidden_dim, 4 * hidden_dim], emb.dtype, name="w_hh")
-        b = create_parameter([4 * hidden_dim], emb.dtype, name="b",
-                             default_initializer=pt.initializer.Constant(0.0))
-        w_out = create_parameter([hidden_dim, vocab_size], emb.dtype, name="w_out")
-        b_out = create_parameter([vocab_size], emb.dtype, name="b_out",
-                                 default_initializer=pt.initializer.Constant(0.0))
+        w_ih, w_hh, b, w_out, b_out = _decoder_params(
+            vocab_size, emb_dim, hidden_dim, emb.dtype
+        )
 
         def step(carry, x_t):
             ctx = attention_step(carry.h, enc_out, enc_mask)
@@ -70,6 +80,52 @@ def decoder_train(trg_ids, enc_out, enc_mask, init_state, *, vocab_size, emb_dim
         hs = jnp.swapaxes(hs, 0, 1)  # [B, T, H]
         logits = jnp.matmul(hs, w_out, preferred_element_type=jnp.float32) + b_out
         return logits.astype(jnp.float32)
+
+
+def seq_to_seq_infer(
+    src_ids, src_lens, *, vocab_size, emb_dim, hidden_dim,
+    beam_size, max_len, bos_id, eos_id,
+):
+    """Beam-search decode (reference ``machine_translation.py`` decode() built
+    on beam_search/beam_search_decode ops). Parameter creation order mirrors
+    :func:`seq_to_seq_net` exactly so the trained params resolve by name."""
+    from paddle_tpu.ops import control_flow as ocf
+
+    enc_out, (h, c) = encoder(
+        src_ids, src_lens, vocab_size=vocab_size, emb_dim=emb_dim, hidden_dim=hidden_dim
+    )
+    enc_mask = oseq.length_mask(src_lens, src_ids.shape[1])
+    with name_scope("decoder"):
+        with name_scope("embedding"):
+            table = create_parameter([vocab_size, emb_dim], enc_out.dtype, name="w")
+        w_ih, w_hh, b, w_out, b_out = _decoder_params(
+            vocab_size, emb_dim, hidden_dim, enc_out.dtype
+        )
+
+    # enc_out/enc_mask are beam-invariant: tile once and close over them so
+    # the beam gather only permutes the (small) LSTM state, not [B*K, T, H]
+    enc_out_k = jnp.repeat(enc_out, beam_size, axis=0)
+    enc_mask_k = jnp.repeat(enc_mask, beam_size, axis=0)
+
+    def step_fn(state, tokens):
+        emb = table[tokens]
+        ctx = attention_step(state.h, enc_out_k, enc_mask_k)
+        inp = jnp.concatenate([emb, ctx], axis=-1)
+        x_proj = jnp.matmul(inp, w_ih, preferred_element_type=jnp.float32).astype(inp.dtype)
+        new = orn.lstm_cell(x_proj, state, w_hh, b)
+        logits = jnp.matmul(new.h, w_out, preferred_element_type=jnp.float32) + b_out
+        return new, jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    return ocf.beam_search(
+        step_fn,
+        orn.LSTMState(h, c),
+        batch_size=src_ids.shape[0],
+        beam_size=beam_size,
+        vocab_size=vocab_size,
+        max_len=max_len,
+        bos_id=bos_id,
+        eos_id=eos_id,
+    )
 
 
 def seq_to_seq_net(src_ids, src_lens, trg_ids, labels, trg_lens, *, vocab_size, emb_dim, hidden_dim):
@@ -109,6 +165,16 @@ def get_model(
         trg_lens = rng.randint(seq_len // 2, seq_len + 1, size=(batch_size,)).astype(np.int32)
         return src, src_lens, trg, labels, trg_lens
 
+    def make_infer_model(beam_size: int = 4, max_len: int = 32, bos_id: int = 0, eos_id: int = 1):
+        return pt.build(
+            functools.partial(
+                seq_to_seq_infer,
+                vocab_size=vocab_size, emb_dim=emb_dim, hidden_dim=hidden_dim,
+                beam_size=beam_size, max_len=max_len, bos_id=bos_id, eos_id=eos_id,
+            ),
+            name="machine_translation_infer",
+        )
+
     return ModelSpec(
         name="machine_translation",
         model=model,
@@ -116,4 +182,5 @@ def get_model(
         optimizer=lambda: pt.optimizer.Adam(learning_rate=learning_rate),
         unit="words/sec",
         examples_per_row=seq_len,
+        extra={"make_infer_model": make_infer_model},
     )

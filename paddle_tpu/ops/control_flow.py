@@ -1,0 +1,362 @@
+"""Control-flow ops — compiler-friendly replacements for Fluid's sub-block ops.
+
+Reference: ``paddle/fluid/operators/while_op.cc:36`` (While + StepScopes),
+``operators/recurrent_op.cc`` (dynamic RNN over per-step scopes),
+``operators/conditional_block_op.cc``, ``python/paddle/fluid/layers/control_flow.py``
+(While/Switch/IfElse/StaticRNN/DynamicRNN/array ops/lod_rank_table), and the
+beam-search ops (``operators/beam_search_op.cc``, ``beam_search_decode_op.cc``).
+
+TPU-native design: the reference runs sub-blocks through a nested Executor with
+a stack of step scopes; under XLA everything must be a traced, statically-shaped
+program, so these map onto ``lax.while_loop`` / ``lax.cond`` / ``lax.switch`` /
+``lax.scan``. Step "scopes" become scan carries; LoDTensorArray becomes a
+preallocated tensor written with ``lax.dynamic_update_index_in_dim``; variable
+length is carried as explicit length masks (see ``ops/sequence.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.enforce import enforce
+
+__all__ = [
+    "while_loop",
+    "cond",
+    "switch_case",
+    "case",
+    "TensorArray",
+    "create_array",
+    "array_write",
+    "array_read",
+    "array_length",
+    "static_rnn",
+    "dynamic_rnn",
+    "rank_by_length",
+    "beam_search",
+    "greedy_search",
+    "BeamState",
+]
+
+# ---------------------------------------------------------------------------
+# Structured control flow (While / IfElse / Switch)
+# ---------------------------------------------------------------------------
+
+
+def while_loop(cond: Callable, body: Callable, loop_vars):
+    """``fluid.layers.While`` parity (reference ``while_op.cc:36``): run
+    ``body`` until ``cond`` is False. ``loop_vars`` is any pytree; ``cond``
+    must return a scalar bool traced value."""
+    return jax.lax.while_loop(cond, body, loop_vars)
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, *operands):
+    """``fluid.layers.IfElse``/``conditional_block_op`` parity: evaluate one of
+    two branches. Both branches are traced (XLA requirement) and must return
+    identically-shaped pytrees."""
+    return jax.lax.cond(pred, true_fn, false_fn, *operands)
+
+
+def switch_case(branch_index, branch_fns: Sequence[Callable], *operands):
+    """``fluid.layers.Switch`` parity via ``lax.switch``: select branch by
+    integer index (clamped into range, matching lax semantics)."""
+    return jax.lax.switch(branch_index, list(branch_fns), *operands)
+
+
+def case(pred_fn_pairs: Sequence[Tuple[Any, Callable]], default: Callable, *operands):
+    """Fluid ``Switch`` block semantics: the FIRST true predicate's branch runs
+    (reference ``layers/control_flow.py`` Switch). Lowered to a chain of
+    ``lax.cond`` so only the taken branch executes (and differentiates)."""
+    pairs = list(pred_fn_pairs)
+    enforce(len(pairs) > 0, "case needs at least one (pred, fn) pair")
+
+    def make(i: int) -> Callable:
+        if i == len(pairs):
+            return default
+        pred, fn = pairs[i]
+        rest = make(i + 1)
+        return lambda *ops: jax.lax.cond(pred, fn, rest, *ops)
+
+    return make(0)(*operands)
+
+
+# ---------------------------------------------------------------------------
+# TensorArray (LoDTensorArray replacement)
+# ---------------------------------------------------------------------------
+
+
+class TensorArray(NamedTuple):
+    """Fixed-capacity tensor array usable inside jit/scan.
+
+    Replaces LoDTensorArray + array_read/array_write/array_length ops
+    (reference ``operators/tensor_array_read_write_op.cc``,
+    ``layers/control_flow.py`` array_write/array_read). XLA requires static
+    shapes, so capacity is fixed at creation; ``size`` tracks the logical
+    write frontier like the reference's array length variable.
+    """
+
+    data: jax.Array  # [capacity, *item_shape]
+    size: jax.Array  # scalar int32
+
+    @staticmethod
+    def create(capacity: int, item_shape: Sequence[int], dtype=jnp.float32) -> "TensorArray":
+        return TensorArray(
+            data=jnp.zeros((capacity, *item_shape), dtype),
+            size=jnp.zeros((), jnp.int32),
+        )
+
+    def write(self, index, value) -> "TensorArray":
+        data = jax.lax.dynamic_update_index_in_dim(self.data, value, index, 0)
+        new_size = jnp.maximum(self.size, jnp.asarray(index, jnp.int32) + 1)
+        return TensorArray(data=data, size=new_size)
+
+    def append(self, value) -> "TensorArray":
+        return self.write(self.size, value)
+
+    def read(self, index) -> jax.Array:
+        return jax.lax.dynamic_index_in_dim(self.data, index, 0, keepdims=False)
+
+    def stack(self) -> jax.Array:
+        """All written entries (up to capacity; logical length is ``size``)."""
+        return self.data
+
+    def length(self) -> jax.Array:
+        return self.size
+
+
+def create_array(capacity: int, item_shape: Sequence[int], dtype=jnp.float32) -> TensorArray:
+    return TensorArray.create(capacity, item_shape, dtype)
+
+
+def array_write(arr: TensorArray, index, value) -> TensorArray:
+    return arr.write(index, value)
+
+
+def array_read(arr: TensorArray, index) -> jax.Array:
+    return arr.read(index)
+
+
+def array_length(arr: TensorArray) -> jax.Array:
+    return arr.length()
+
+
+# ---------------------------------------------------------------------------
+# RNN builders (StaticRNN / DynamicRNN replacements)
+# ---------------------------------------------------------------------------
+
+
+def static_rnn(
+    step_fn: Callable,
+    inputs,
+    init_state,
+    time_major: bool = False,
+):
+    """``fluid.layers.StaticRNN`` parity: run ``step_fn(state, x_t) ->
+    (new_state, y_t)`` over the time axis of ``inputs`` (axis 1 unless
+    ``time_major``). Returns ``(final_state, outputs)`` with outputs stacked
+    on the same time axis. Lowered to one ``lax.scan`` — a single fused XLA
+    loop instead of the reference's per-step scope creation
+    (``recurrent_op.cc:25-40``)."""
+    swap = lambda t: jnp.swapaxes(t, 0, 1)
+    xs = inputs if time_major else jax.tree_util.tree_map(swap, inputs)
+    final_state, ys = jax.lax.scan(step_fn, init_state, xs)
+    if not time_major:
+        ys = jax.tree_util.tree_map(swap, ys)
+    return final_state, ys
+
+
+def dynamic_rnn(
+    step_fn: Callable,
+    inputs,
+    lengths: jax.Array,
+    init_state,
+    time_major: bool = False,
+):
+    """``fluid.layers.DynamicRNN`` parity for padded batches: like
+    :func:`static_rnn` but rows stop evolving after their ``lengths`` — the
+    carried state for a finished row is frozen (the reference shrinks the
+    batch per step via lod_rank_table + shrink_rnn_memory,
+    ``layers/control_flow.py``; with static XLA shapes we mask instead).
+    Outputs past a row's length are zeroed.
+
+    Masking applies to state/output leaves whose leading dim equals the batch
+    size; leaves without a batch dim (e.g. a scalar step counter in the carry)
+    are updated unconditionally."""
+    swap = lambda t: jnp.swapaxes(t, 0, 1)
+    xs = inputs if time_major else jax.tree_util.tree_map(swap, inputs)
+    batch = int(lengths.shape[0])
+
+    def masked_step(carry, inp):
+        t, state = carry
+        new_state, y = step_fn(state, inp)
+        alive = (t < lengths)  # [B]
+
+        def keep(new, old):
+            if new.ndim == 0 or new.shape[0] != batch:
+                return new
+            mask = alive.reshape((-1,) + (1,) * (new.ndim - 1))
+            return jnp.where(mask, new, old)
+
+        def zero_done(v):
+            if v.ndim == 0 or v.shape[0] != batch:
+                return v
+            mask = alive.reshape((-1,) + (1,) * (v.ndim - 1))
+            return jnp.where(mask, v, jnp.zeros_like(v))
+
+        state = jax.tree_util.tree_map(keep, new_state, state)
+        y = jax.tree_util.tree_map(zero_done, y)
+        return (t + 1, state), y
+
+    (_, final_state), ys = jax.lax.scan(masked_step, (jnp.zeros((), jnp.int32), init_state), xs)
+    if not time_major:
+        ys = jax.tree_util.tree_map(swap, ys)
+    return final_state, ys
+
+
+def rank_by_length(lengths: jax.Array):
+    """``lod_rank_table`` + ``reorder_lod_tensor_by_rank`` parity
+    (reference ``layers/control_flow.py`` lod_rank_table,
+    ``operators/reorder_lod_tensor_by_rank_op.cc``): returns
+    ``(order, inverse)`` where ``order`` sorts rows by descending length and
+    ``inverse`` undoes it."""
+    order = jnp.argsort(-lengths, stable=True)
+    inverse = jnp.argsort(order, stable=True)
+    return order, inverse
+
+
+# ---------------------------------------------------------------------------
+# Beam search
+# ---------------------------------------------------------------------------
+
+NEG_INF = -1.0e9
+
+
+class BeamState(NamedTuple):
+    carry: Any  # model carry, leaves shaped [B*K, ...]
+    tokens: jax.Array  # [B, K] last emitted token
+    scores: jax.Array  # [B, K] cumulative log-prob
+    finished: jax.Array  # [B, K] bool
+
+
+def _gather_beams(tree, beam_indices: jax.Array, batch_size: int, beam_size: int):
+    """Reindex [B*K, ...] leaves by per-batch beam indices [B, K]."""
+
+    def gather(leaf):
+        shaped = leaf.reshape((batch_size, beam_size) + leaf.shape[1:])
+        out = jnp.take_along_axis(
+            shaped,
+            beam_indices.reshape((batch_size, beam_size) + (1,) * (leaf.ndim - 1)),
+            axis=1,
+        )
+        return out.reshape((batch_size * beam_size,) + leaf.shape[1:])
+
+    return jax.tree_util.tree_map(gather, tree)
+
+
+def beam_search(
+    step_fn: Callable,
+    init_carry,
+    *,
+    batch_size: int,
+    beam_size: int,
+    vocab_size: int,
+    max_len: int,
+    bos_id: int,
+    eos_id: int,
+    length_penalty_alpha: float = 0.0,
+):
+    """Batched beam search (reference ``operators/beam_search_op.cc`` grow +
+    ``beam_search_decode_op.cc`` backtrace, driven by a While block in
+    ``layers/control_flow.py``; here one ``lax.scan`` over ``max_len`` steps).
+
+    ``step_fn(carry, tokens[B*K]) -> (new_carry, log_probs[B*K, V])`` is the
+    per-step decoder. ``init_carry`` leaves are [B, ...] and are tiled across
+    beams. Returns ``(sequences [B, K, max_len], scores [B, K])`` sorted
+    best-first per batch row.
+    """
+
+    def tile(leaf):
+        return jnp.repeat(leaf, beam_size, axis=0)
+
+    carry = jax.tree_util.tree_map(tile, init_carry)
+    tokens = jnp.full((batch_size, beam_size), bos_id, jnp.int32)
+    # only beam 0 is live initially so the K identical copies don't crowd
+    # the frontier (standard trick; reference seeds one prefix per source)
+    scores = jnp.tile(
+        jnp.array([0.0] + [NEG_INF] * (beam_size - 1), jnp.float32), (batch_size, 1)
+    )
+    finished = jnp.zeros((batch_size, beam_size), bool)
+    state = BeamState(carry, tokens, scores, finished)
+
+    def step(state: BeamState, _):
+        new_carry, log_probs = step_fn(state.carry, state.tokens.reshape(-1))
+        log_probs = log_probs.reshape(batch_size, beam_size, vocab_size)
+        # finished beams may only emit eos at zero cost
+        eos_only = jnp.full((vocab_size,), NEG_INF, jnp.float32).at[eos_id].set(0.0)
+        log_probs = jnp.where(state.finished[..., None], eos_only, log_probs)
+        total = state.scores[..., None] + log_probs  # [B, K, V]
+        flat = total.reshape(batch_size, beam_size * vocab_size)
+        top_scores, top_idx = jax.lax.top_k(flat, beam_size)  # [B, K]
+        src_beam = top_idx // vocab_size
+        new_tokens = (top_idx % vocab_size).astype(jnp.int32)
+        carry2 = _gather_beams(new_carry, src_beam, batch_size, beam_size)
+        was_finished = jnp.take_along_axis(state.finished, src_beam, axis=1)
+        now_finished = was_finished | (new_tokens == eos_id)
+        new_state = BeamState(carry2, new_tokens, top_scores, now_finished)
+        return new_state, (new_tokens, src_beam)
+
+    final, (tok_hist, ptr_hist) = jax.lax.scan(step, state, None, length=max_len)
+
+    # backtrace (beam_search_decode): walk backpointers from the last step
+    def back(beam_idx, hist):
+        tok_t, ptr_t = hist
+        toks = jnp.take_along_axis(tok_t, beam_idx, axis=1)  # [B, K]
+        prev = jnp.take_along_axis(ptr_t, beam_idx, axis=1)
+        return prev, toks
+
+    last_idx = jnp.tile(jnp.arange(beam_size)[None, :], (batch_size, 1))
+    _, rev_tokens = jax.lax.scan(
+        back, last_idx, (tok_hist, ptr_hist), reverse=True
+    )  # [T, B, K]
+    sequences = jnp.transpose(rev_tokens, (1, 2, 0))  # [B, K, T]
+
+    scores = final.scores
+    if length_penalty_alpha:
+        lengths = jnp.sum((sequences != eos_id).astype(jnp.float32), axis=-1) + 1.0
+        penalty = ((5.0 + lengths) / 6.0) ** length_penalty_alpha
+        scores = scores / penalty
+    order = jnp.argsort(-scores, axis=1)
+    sequences = jnp.take_along_axis(sequences, order[..., None], axis=1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    return sequences, scores
+
+
+def greedy_search(
+    step_fn: Callable,
+    init_carry,
+    *,
+    batch_size: int,
+    max_len: int,
+    bos_id: int,
+    eos_id: int,
+):
+    """Greedy decode — beam_size=1 fast path (the reference's beam_search with
+    beam_size=1 / argmax sampling in ``layers/control_flow.py`` DynamicRNN
+    decode examples)."""
+
+    tokens = jnp.full((batch_size,), bos_id, jnp.int32)
+    finished = jnp.zeros((batch_size,), bool)
+
+    def step(state, _):
+        carry, tok, fin = state
+        carry, log_probs = step_fn(carry, tok)
+        nxt = jnp.argmax(log_probs, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(fin, eos_id, nxt)
+        fin = fin | (nxt == eos_id)
+        return (carry, nxt, fin), nxt
+
+    _, toks = jax.lax.scan(step, (init_carry, tokens, finished), None, length=max_len)
+    return jnp.swapaxes(toks, 0, 1)  # [B, T]

@@ -1,0 +1,199 @@
+"""Control-flow op tests (reference analogues: test_while_op.py,
+test_switch.py, test_array_read_write_op.py, test_dynrnn_static_input.py,
+test_beam_search_op.py / test_beam_search_decode_op.py in
+python/paddle/fluid/tests/unittests/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import control_flow as cf
+
+
+def test_while_loop_counter():
+    def cond(s):
+        i, acc = s
+        return i < 10
+
+    def body(s):
+        i, acc = s
+        return i + 1, acc + i
+
+    i, acc = jax.jit(lambda: cf.while_loop(cond, body, (0, 0)))()
+    assert int(i) == 10 and int(acc) == sum(range(10))
+
+
+def test_cond_and_switch():
+    f = jax.jit(lambda p, x: cf.cond(p, lambda v: v * 2, lambda v: v - 1, x))
+    assert float(f(True, 3.0)) == 6.0
+    assert float(f(False, 3.0)) == 2.0
+
+    g = jax.jit(
+        lambda i, x: cf.switch_case(i, [lambda v: v, lambda v: v * 10, lambda v: -v], x)
+    )
+    assert float(g(1, 2.0)) == 20.0
+    assert float(g(2, 2.0)) == -2.0
+
+
+def test_case_first_true_wins():
+    def run(x):
+        return cf.case(
+            [(x > 10.0, lambda v: v * 100.0), (x > 0.0, lambda v: v * 2.0)],
+            lambda v: jnp.zeros_like(v),
+            x,
+        )
+
+    assert float(jax.jit(run)(20.0)) == 2000.0  # first pred true
+    assert float(jax.jit(run)(5.0)) == 10.0  # second pred true
+    assert float(jax.jit(run)(-1.0)) == 0.0  # default
+
+
+def test_tensor_array_roundtrip():
+    def run():
+        arr = cf.create_array(4, (2,), jnp.float32)
+        arr = cf.array_write(arr, 0, jnp.array([1.0, 2.0]))
+        arr = arr.append(jnp.array([3.0, 4.0]))
+        return cf.array_read(arr, 1), cf.array_length(arr), arr.stack()
+
+    item, n, stacked = jax.jit(run)()
+    np.testing.assert_allclose(np.asarray(item), [3.0, 4.0])
+    assert int(n) == 2
+    assert stacked.shape == (4, 2)
+
+
+def test_static_rnn_matches_loop(rng):
+    B, T, D = 3, 5, 4
+    xs = rng.randn(B, T, D).astype(np.float32)
+
+    def step(h, x):
+        h = jnp.tanh(h + x)
+        return h, h * 2.0
+
+    h0 = jnp.zeros((B, D))
+    final, ys = cf.static_rnn(step, jnp.asarray(xs), h0)
+
+    h_ref = np.zeros((B, D), np.float32)
+    ys_ref = []
+    for t in range(T):
+        h_ref = np.tanh(h_ref + xs[:, t])
+        ys_ref.append(h_ref * 2.0)
+    np.testing.assert_allclose(np.asarray(final), h_ref, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ys), np.stack(ys_ref, 1), rtol=1e-5)
+
+
+def test_dynamic_rnn_freezes_after_length(rng):
+    B, T, D = 2, 6, 3
+    xs = rng.randn(B, T, D).astype(np.float32)
+    lengths = jnp.array([3, 6], jnp.int32)
+
+    def step(h, x):
+        h = h + x
+        return h, h
+
+    final, ys = cf.dynamic_rnn(step, jnp.asarray(xs), lengths, jnp.zeros((B, D)))
+    # row 0 state = sum of first 3 steps only
+    np.testing.assert_allclose(np.asarray(final)[0], xs[0, :3].sum(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(final)[1], xs[1].sum(0), rtol=1e-5)
+    # outputs past the length are zeroed
+    assert np.all(np.asarray(ys)[0, 3:] == 0.0)
+
+
+def test_rank_by_length_roundtrip():
+    lengths = jnp.array([2, 9, 5], jnp.int32)
+    order, inverse = cf.rank_by_length(lengths)
+    sorted_lens = np.asarray(lengths)[np.asarray(order)]
+    assert list(sorted_lens) == [9, 5, 2]
+    np.testing.assert_array_equal(
+        np.asarray(order)[np.asarray(inverse)], np.arange(3)
+    )
+
+
+def _brute_force_beam(log_probs_per_step, bos, eos):
+    """Enumerate all sequences for a position-dependent (carry-free) unigram
+    model and return the best total log-prob."""
+    T, V = log_probs_per_step.shape
+    import itertools
+
+    best = -np.inf
+    for seq in itertools.product(range(V), repeat=T):
+        score, done = 0.0, False
+        for t, s in enumerate(seq):
+            if done:
+                if s != eos:
+                    score = -np.inf
+                    break
+                continue
+            score += log_probs_per_step[t, s]
+            if s == eos:
+                done = True
+        best = max(best, score)
+    return best
+
+
+def test_beam_search_finds_optimal_sequence(rng):
+    B, V, T, K = 2, 5, 3, 4
+    eos = 1
+    table = rng.randn(B, T, V).astype(np.float32)
+    table = np.log(np.exp(table) / np.exp(table).sum(-1, keepdims=True))
+    table_j = jnp.asarray(table)
+
+    def step_fn(carry, tokens):
+        t, b_idx = carry
+        lp = table_j[b_idx, jnp.minimum(t, T - 1)]
+        return (t + 1, b_idx), lp
+
+    b_idx = jnp.repeat(jnp.arange(B), 1)  # [B]; beam_search tiles to B*K
+    seqs, scores = jax.jit(
+        lambda: cf.beam_search(
+            step_fn,
+            (jnp.zeros((B,), jnp.int32), b_idx),
+            batch_size=B, beam_size=K, vocab_size=V,
+            max_len=T, bos_id=0, eos_id=eos,
+        )
+    )()
+    assert seqs.shape == (B, K, T)
+    for b in range(B):
+        expected = _brute_force_beam(table[b], 0, eos)
+        np.testing.assert_allclose(float(scores[b, 0]), expected, rtol=1e-4)
+
+
+def test_greedy_search_stops_at_eos():
+    V, B, T = 4, 2, 5
+    eos = 3
+    # model that always prefers token 2 then eos after step 1
+    lp0 = np.full((B, V), -10.0, np.float32)
+    lp0[:, 2] = 0.0
+    lp1 = np.full((B, V), -10.0, np.float32)
+    lp1[:, eos] = 0.0
+    tables = jnp.asarray(np.stack([lp0, lp1] + [lp1] * (T - 2)))
+
+    def step_fn(t, tokens):
+        return t + 1, tables[jnp.minimum(t, T - 1)]
+
+    toks = jax.jit(
+        lambda: cf.greedy_search(
+            step_fn, jnp.zeros((), jnp.int32), batch_size=B, max_len=T,
+            bos_id=0, eos_id=eos,
+        )
+    )()
+    out = np.asarray(toks)
+    np.testing.assert_array_equal(out[:, 0], [2, 2])
+    assert np.all(out[:, 1:] == eos)
+
+
+def test_machine_translation_beam_decode_runs(rng):
+    from paddle_tpu import models
+
+    spec = models.get_model(
+        "machine_translation", vocab_size=64, emb_dim=16, hidden_dim=16, seq_len=8
+    )
+    batch = spec.synth_batch(2, rng)
+    variables = spec.model.init(0, *batch)
+    infer = spec.extra["make_infer_model"](beam_size=3, max_len=6)
+    src, src_lens = batch[0], batch[1]
+    (seqs, scores), _ = infer.apply(variables, jnp.asarray(src), jnp.asarray(src_lens))
+    assert seqs.shape == (2, 3, 6)
+    s = np.asarray(scores)
+    assert np.all(np.isfinite(s[:, 0]))
+    # best-first ordering
+    assert np.all(np.diff(s, axis=1) <= 1e-6)
