@@ -68,3 +68,8 @@ def default_policy() -> Policy:
     from paddle_tpu.core import config
 
     return MIXED_BF16 if config.flags().use_bf16_compute else FP32
+
+
+# Log-space masking sentinel shared by control-flow/loss dynamic programs —
+# finite (unlike -inf) so 0*NEG_INF stays 0 under autodiff where-chains.
+NEG_INF = -1.0e9

@@ -12,15 +12,18 @@ are used only where XLA underperforms.
 from paddle_tpu.ops.math import *  # noqa: F401,F403
 from paddle_tpu.ops.nn import *  # noqa: F401,F403
 from paddle_tpu.ops.control_flow import *  # noqa: F401,F403
-from paddle_tpu.ops import math, nn, rnn, sequence, attention, control_flow  # noqa: F401
+from paddle_tpu.ops.losses import *  # noqa: F401,F403
+from paddle_tpu.ops import math, nn, rnn, sequence, attention, control_flow, losses  # noqa: F401
 
 from paddle_tpu.ops import math as _math
 from paddle_tpu.ops import nn as _nn
 from paddle_tpu.ops import control_flow as _cf
+from paddle_tpu.ops import losses as _losses
 
 __all__ = (
     list(getattr(_math, "__all__", []))
     + list(getattr(_nn, "__all__", []))
     + list(_cf.__all__)
-    + ["math", "nn", "rnn", "sequence", "attention", "control_flow"]
+    + list(_losses.__all__)
+    + ["math", "nn", "rnn", "sequence", "attention", "control_flow", "losses"]
 )

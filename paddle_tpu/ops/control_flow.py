@@ -231,7 +231,7 @@ def rank_by_length(lengths: jax.Array):
 # Beam search
 # ---------------------------------------------------------------------------
 
-NEG_INF = -1.0e9
+from paddle_tpu.core.dtypes import NEG_INF  # noqa: E402
 
 
 class BeamState(NamedTuple):

@@ -1,0 +1,184 @@
+"""CRF / CTC / edit-distance op tests, checked against brute-force
+enumeration (reference analogues: test_linear_chain_crf_op.py,
+test_crf_decoding_op.py, test_warpctc_op.py, test_ctc_align_op.py,
+test_edit_distance_op.py)."""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import losses
+
+
+def _crf_path_score(em, tags, start, end, trans):
+    s = start[tags[0]] + em[0, tags[0]]
+    for t in range(1, len(tags)):
+        s += trans[tags[t - 1], tags[t]] + em[t, tags[t]]
+    return s + end[tags[-1]]
+
+
+def _brute_crf(em, labels, length, transition):
+    start, end, trans = transition[0], transition[1], transition[2:]
+    K = em.shape[1]
+    gold = _crf_path_score(em[:length], labels[:length], start, end, trans)
+    z = -np.inf
+    for tags in itertools.product(range(K), repeat=length):
+        z = np.logaddexp(z, _crf_path_score(em[:length], list(tags), start, end, trans))
+    return z - gold
+
+
+def test_linear_chain_crf_vs_brute_force(rng):
+    B, T, K = 3, 5, 3
+    em = rng.randn(B, T, K).astype(np.float32)
+    labels = rng.randint(0, K, (B, T)).astype(np.int32)
+    lengths = np.array([5, 3, 4], np.int32)
+    transition = rng.randn(K + 2, K).astype(np.float32)
+
+    nll = jax.jit(losses.linear_chain_crf)(
+        jnp.asarray(em), jnp.asarray(labels), jnp.asarray(lengths), jnp.asarray(transition)
+    )
+    for b in range(B):
+        expected = _brute_crf(em[b], labels[b], lengths[b], transition)
+        np.testing.assert_allclose(float(nll[b]), expected, rtol=1e-4)
+
+
+def test_crf_grads_are_finite(rng):
+    B, T, K = 2, 4, 3
+    em = jnp.asarray(rng.randn(B, T, K).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, K, (B, T)).astype(np.int32))
+    lengths = jnp.array([4, 2], jnp.int32)
+    transition = jnp.asarray(rng.randn(K + 2, K).astype(np.float32))
+
+    loss = lambda e, tr: jnp.mean(losses.linear_chain_crf(e, labels, lengths, tr))
+    g_em, g_tr = jax.grad(loss, argnums=(0, 1))(em, transition)
+    assert np.all(np.isfinite(np.asarray(g_em)))
+    assert np.all(np.isfinite(np.asarray(g_tr)))
+
+
+def test_crf_decoding_vs_brute_force(rng):
+    B, T, K = 3, 5, 3
+    em = rng.randn(B, T, K).astype(np.float32)
+    lengths = np.array([5, 3, 4], np.int32)
+    transition = rng.randn(K + 2, K).astype(np.float32)
+    start, end, trans = transition[0], transition[1], transition[2:]
+
+    tags, scores = jax.jit(losses.crf_decoding)(
+        jnp.asarray(em), jnp.asarray(lengths), jnp.asarray(transition)
+    )
+    for b in range(B):
+        L = lengths[b]
+        best, best_tags = -np.inf, None
+        for cand in itertools.product(range(K), repeat=int(L)):
+            s = _crf_path_score(em[b, :L], list(cand), start, end, trans)
+            if s > best:
+                best, best_tags = s, cand
+        np.testing.assert_allclose(float(scores[b]), best, rtol=1e-4)
+        assert tuple(np.asarray(tags)[b, :L]) == best_tags
+        assert np.all(np.asarray(tags)[b, L:] == 0)
+
+
+def _collapse(path, blank):
+    out, prev = [], None
+    for p in path:
+        if p != prev and p != blank:
+            out.append(p)
+        prev = p
+    return tuple(out)
+
+
+def _brute_ctc(log_probs, label, T, blank):
+    """Sum probability over all length-T paths collapsing to label."""
+    V = log_probs.shape[1]
+    total = -np.inf
+    for path in itertools.product(range(V), repeat=T):
+        if _collapse(path, blank) == tuple(label):
+            s = sum(log_probs[t, path[t]] for t in range(T))
+            total = np.logaddexp(total, s)
+    return -total
+
+
+def test_ctc_loss_vs_brute_force(rng):
+    B, T, V, L = 3, 4, 3, 2
+    blank = 0
+    logits = rng.randn(B, T, V).astype(np.float32)
+    log_probs = np.log(np.exp(logits) / np.exp(logits).sum(-1, keepdims=True))
+    labels = np.array([[1, 2], [2, 2], [1, 0]], np.int32)
+    label_lengths = np.array([2, 2, 1], np.int32)
+    input_lengths = np.array([4, 4, 3], np.int32)
+
+    nll = jax.jit(losses.ctc_loss)(
+        jnp.asarray(log_probs), jnp.asarray(labels),
+        jnp.asarray(input_lengths), jnp.asarray(label_lengths), blank,
+    )
+    for b in range(B):
+        expected = _brute_ctc(
+            log_probs[b], labels[b, : label_lengths[b]], int(input_lengths[b]), blank
+        )
+        np.testing.assert_allclose(float(nll[b]), expected, rtol=1e-4)
+
+
+def test_ctc_loss_empty_label(rng):
+    # all-blank target: NLL = -sum_t log p(blank) exactly (no log(2) inflation)
+    T, V = 3, 3
+    logits = rng.randn(1, T, V).astype(np.float32)
+    lp = np.log(np.exp(logits) / np.exp(logits).sum(-1, keepdims=True))
+    nll = jax.jit(losses.ctc_loss)(
+        jnp.asarray(lp), jnp.zeros((1, 2), jnp.int32),
+        jnp.array([T], jnp.int32), jnp.array([0], jnp.int32),
+    )
+    np.testing.assert_allclose(float(nll[0]), -lp[0, :, 0].sum(), rtol=1e-5)
+
+
+def test_ctc_loss_grads_finite(rng):
+    B, T, V, L = 2, 5, 4, 2
+    logits = jnp.asarray(rng.randn(B, T, V).astype(np.float32))
+    labels = jnp.asarray(rng.randint(1, V, (B, L)).astype(np.int32))
+    ilen = jnp.array([5, 4], jnp.int32)
+    llen = jnp.array([2, 1], jnp.int32)
+
+    def loss(lg):
+        lp = jax.nn.log_softmax(lg, axis=-1)
+        return jnp.mean(losses.ctc_loss(lp, labels, ilen, llen))
+
+    g = jax.grad(loss)(logits)
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_ctc_greedy_decode():
+    # path: [1 1 0 2 2] -> collapse -> [1 2]
+    T, V = 5, 3
+    lp = np.full((1, T, V), -10.0, np.float32)
+    for t, v in enumerate([1, 1, 0, 2, 2]):
+        lp[0, t, v] = 0.0
+    toks, lens = jax.jit(losses.ctc_greedy_decode)(
+        jnp.asarray(lp), jnp.array([5], jnp.int32)
+    )
+    assert int(lens[0]) == 2
+    np.testing.assert_array_equal(np.asarray(toks)[0, :2], [1, 2])
+    assert np.all(np.asarray(toks)[0, 2:] == -1)
+
+
+def test_edit_distance():
+    # kitten -> sitting = 3
+    def enc(s):
+        return [ord(c) for c in s]
+
+    hyp = np.zeros((2, 6), np.int32)
+    ref = np.zeros((2, 7), np.int32)
+    hyp[0, :6] = enc("kitten")
+    ref[0, :7] = enc("sitting")
+    hyp[1, :3] = enc("abc")
+    ref[1, :3] = enc("abc")
+    d = jax.jit(losses.edit_distance)(
+        jnp.asarray(hyp), jnp.array([6, 3], jnp.int32),
+        jnp.asarray(ref), jnp.array([7, 3], jnp.int32),
+    )
+    np.testing.assert_allclose(np.asarray(d), [3.0, 0.0])
+
+    dn = jax.jit(lambda *a: losses.edit_distance(*a, normalized=True))(
+        jnp.asarray(hyp), jnp.array([6, 3], jnp.int32),
+        jnp.asarray(ref), jnp.array([7, 3], jnp.int32),
+    )
+    np.testing.assert_allclose(np.asarray(dn), [3.0 / 7.0, 0.0], rtol=1e-6)
