@@ -43,6 +43,8 @@ from paddle_tpu import io
 from paddle_tpu import checkpoint
 from paddle_tpu import parallel
 from paddle_tpu.parallel import DataParallel
+from paddle_tpu import trainer
+from paddle_tpu.trainer import Trainer, CheckpointConfig
 
 CPUPlace = config.CPUPlace
 TPUPlace = config.TPUPlace
@@ -76,6 +78,9 @@ __all__ = [
     "checkpoint",
     "parallel",
     "DataParallel",
+    "trainer",
+    "Trainer",
+    "CheckpointConfig",
     "CPUPlace",
     "TPUPlace",
 ]

@@ -13,17 +13,43 @@ from paddle_tpu.ops.math import *  # noqa: F401,F403
 from paddle_tpu.ops.nn import *  # noqa: F401,F403
 from paddle_tpu.ops.control_flow import *  # noqa: F401,F403
 from paddle_tpu.ops.losses import *  # noqa: F401,F403
-from paddle_tpu.ops import math, nn, rnn, sequence, attention, control_flow, losses  # noqa: F401
+from paddle_tpu.ops.detection import *  # noqa: F401,F403
+from paddle_tpu.ops.quant import *  # noqa: F401,F403
+from paddle_tpu.ops import (  # noqa: F401
+    math,
+    nn,
+    rnn,
+    sequence,
+    attention,
+    control_flow,
+    losses,
+    detection,
+    quant,
+)
 
 from paddle_tpu.ops import math as _math
 from paddle_tpu.ops import nn as _nn
 from paddle_tpu.ops import control_flow as _cf
 from paddle_tpu.ops import losses as _losses
+from paddle_tpu.ops import detection as _det
+from paddle_tpu.ops import quant as _quant
 
 __all__ = (
     list(getattr(_math, "__all__", []))
     + list(getattr(_nn, "__all__", []))
     + list(_cf.__all__)
     + list(_losses.__all__)
-    + ["math", "nn", "rnn", "sequence", "attention", "control_flow", "losses"]
+    + list(_det.__all__)
+    + list(_quant.__all__)
+    + [
+        "math",
+        "nn",
+        "rnn",
+        "sequence",
+        "attention",
+        "control_flow",
+        "losses",
+        "detection",
+        "quant",
+    ]
 )

@@ -1,0 +1,324 @@
+"""Detection-model ops (SSD/RPN family).
+
+Reference: ``paddle/fluid/operators/detection/`` — prior_box_op, anchor_
+generator_op, box_coder_op, iou_similarity_op, bipartite_match_op,
+multiclass_nms_op, target_assign_op. The reference kernels are per-box CPU
+loops / CUDA threads over dynamic-size outputs; TPU-native versions are
+fixed-shape vectorized tensor programs: matching and NMS are bounded
+iterative selections (``lax.fori_loop`` with static trip counts) that emit
+padded outputs + validity counts instead of LoD-sized results, so everything
+stays jit-compatible.
+
+Boxes are [x_min, y_min, x_max, y_max] (normalized), matching the reference's
+layout (``bbox_util.h``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import NEG_INF
+
+__all__ = [
+    "prior_box",
+    "anchor_generator",
+    "box_coder",
+    "iou_similarity",
+    "bipartite_match",
+    "nms",
+    "multiclass_nms",
+    "target_assign",
+    "box_clip",
+]
+
+
+def prior_box(
+    feature_shape: Tuple[int, int],
+    image_shape: Tuple[int, int],
+    min_sizes: Sequence[float],
+    max_sizes: Sequence[float] = (),
+    aspect_ratios: Sequence[float] = (1.0,),
+    variance: Sequence[float] = (0.1, 0.1, 0.2, 0.2),
+    flip: bool = False,
+    clip: bool = False,
+    step: Tuple[float, float] = (0.0, 0.0),
+    offset: float = 0.5,
+) -> Tuple[jax.Array, jax.Array]:
+    """SSD prior boxes (reference ``prior_box_op.h:46-150``): per feature-map
+    cell emit one box per (min_size × aspect_ratio) plus one per max_size
+    (geometric mean size). Returns (boxes [H, W, P, 4], variances same
+    shape)."""
+    H, W = feature_shape
+    img_h, img_w = image_shape
+    step_h = step[0] or img_h / H
+    step_w = step[1] or img_w / W
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    widths, heights = [], []
+    for ms in min_sizes:
+        for ar in ars:
+            widths.append(ms * (ar ** 0.5))
+            heights.append(ms / (ar ** 0.5))
+    for ms, mx in zip(min_sizes, max_sizes):
+        s = (ms * mx) ** 0.5
+        widths.append(s)
+        heights.append(s)
+    P = len(widths)
+    w_half = jnp.asarray(widths, jnp.float32) / (2.0 * img_w)  # [P]
+    h_half = jnp.asarray(heights, jnp.float32) / (2.0 * img_h)
+
+    cx = ((jnp.arange(W, dtype=jnp.float32) + offset) * step_w) / img_w  # [W]
+    cy = ((jnp.arange(H, dtype=jnp.float32) + offset) * step_h) / img_h  # [H]
+    cx = jnp.broadcast_to(cx[None, :, None], (H, W, P))
+    cy = jnp.broadcast_to(cy[:, None, None], (H, W, P))
+    boxes = jnp.stack(
+        [cx - w_half, cy - h_half, cx + w_half, cy + h_half], axis=-1
+    )  # [H, W, P, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    variances = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), boxes.shape)
+    return boxes, variances
+
+
+def anchor_generator(
+    feature_shape: Tuple[int, int],
+    anchor_sizes: Sequence[float] = (64.0, 128.0, 256.0, 512.0),
+    aspect_ratios: Sequence[float] = (0.5, 1.0, 2.0),
+    variance: Sequence[float] = (0.1, 0.1, 0.2, 0.2),
+    stride: Tuple[float, float] = (16.0, 16.0),
+    offset: float = 0.5,
+) -> Tuple[jax.Array, jax.Array]:
+    """RPN anchors in input-image coordinates (reference
+    ``anchor_generator_op.h``): per cell, |sizes|×|ratios| anchors. Returns
+    (anchors [H, W, A, 4], variances same shape)."""
+    H, W = feature_shape
+    ws, hs = [], []
+    for ar in aspect_ratios:
+        for size in anchor_sizes:
+            area = size * size
+            w = (area / ar) ** 0.5
+            ws.append(w)
+            hs.append(w * ar)
+    A = len(ws)
+    w_half = jnp.asarray(ws, jnp.float32) / 2.0
+    h_half = jnp.asarray(hs, jnp.float32) / 2.0
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * stride[1]
+    cx = jnp.broadcast_to(cx[None, :, None], (H, W, A))
+    cy = jnp.broadcast_to(cy[:, None, None], (H, W, A))
+    anchors = jnp.stack([cx - w_half, cy - h_half, cx + w_half, cy + h_half], axis=-1)
+    variances = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), anchors.shape)
+    return anchors, variances
+
+
+def _box_to_cwh(box):
+    w = box[..., 2] - box[..., 0]
+    h = box[..., 3] - box[..., 1]
+    cx = box[..., 0] + w / 2.0
+    cy = box[..., 1] + h / 2.0
+    return cx, cy, w, h
+
+
+def box_coder(
+    prior_boxes: jax.Array,
+    prior_variances: jax.Array,
+    target_or_codes: jax.Array,
+    code_type: str = "encode_center_size",
+) -> jax.Array:
+    """Encode boxes to center-size offsets against priors, or decode offsets
+    back (reference ``box_coder_op.h`` EncodeCenterSize/DecodeCenterSize).
+
+    encode: priors [M, 4], targets [N, 4] → codes [N, M, 4]
+    decode: priors [M, 4], codes [N, M, 4] (or [M, 4]) → boxes same shape
+    """
+    pcx, pcy, pw, ph = _box_to_cwh(prior_boxes)
+    var = prior_variances
+    if code_type == "encode_center_size":
+        t = target_or_codes
+        tcx, tcy, tw, th = _box_to_cwh(t)
+        # broadcast targets [N,1] against priors [1,M]
+        tcx, tcy, tw, th = (v[:, None] for v in (tcx, tcy, tw, th))
+        out = jnp.stack(
+            [
+                (tcx - pcx[None, :]) / pw[None, :] / var[None, :, 0],
+                (tcy - pcy[None, :]) / ph[None, :] / var[None, :, 1],
+                jnp.log(tw / pw[None, :]) / var[None, :, 2],
+                jnp.log(th / ph[None, :]) / var[None, :, 3],
+            ],
+            axis=-1,
+        )
+        return out
+    if code_type == "decode_center_size":
+        c = target_or_codes
+        cx = c[..., 0] * var[..., 0] * pw + pcx
+        cy = c[..., 1] * var[..., 1] * ph + pcy
+        w = jnp.exp(c[..., 2] * var[..., 2]) * pw
+        h = jnp.exp(c[..., 3] * var[..., 3]) * ph
+        return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+    raise ValueError(f"unknown code_type {code_type!r}")
+
+
+def iou_similarity(x: jax.Array, y: jax.Array) -> jax.Array:
+    """Pairwise IoU (reference ``iou_similarity_op.h``): x [N, 4], y [M, 4]
+    → [N, M]."""
+    area = lambda b: jnp.maximum(b[..., 2] - b[..., 0], 0.0) * jnp.maximum(
+        b[..., 3] - b[..., 1], 0.0
+    )
+    xl = jnp.maximum(x[:, None, 0], y[None, :, 0])
+    yt = jnp.maximum(x[:, None, 1], y[None, :, 1])
+    xr = jnp.minimum(x[:, None, 2], y[None, :, 2])
+    yb = jnp.minimum(x[:, None, 3], y[None, :, 3])
+    inter = jnp.maximum(xr - xl, 0.0) * jnp.maximum(yb - yt, 0.0)
+    union = area(x)[:, None] + area(y)[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+def bipartite_match(similarity: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Greedy bipartite matching (reference ``bipartite_match_op.cc``
+    BipartiteMatch): repeatedly take the global max of the similarity matrix,
+    pair that (row, col), and remove both. Returns ``(match_indices [M],
+    match_dist [M])`` — per column, the matched row or -1.
+
+    similarity: [N, M] (rows = ground-truth, cols = priors).
+    """
+    N, M = similarity.shape
+    K = min(N, M)
+
+    def body(_, state):
+        sim, match_idx, match_dist = state
+        flat = jnp.argmax(sim)
+        r, c = flat // M, flat % M
+        best = sim[r, c]
+        # only positive similarity counts as a match (reference BipartiteMatch
+        # leaves zero-overlap columns at -1)
+        valid = best > 0.0
+        match_idx = jnp.where(
+            valid, match_idx.at[c].set(r.astype(jnp.int32)), match_idx
+        )
+        match_dist = jnp.where(valid, match_dist.at[c].set(best), match_dist)
+        sim = sim.at[r, :].set(NEG_INF)
+        sim = sim.at[:, c].set(NEG_INF)
+        return sim, match_idx, match_dist
+
+    sim = similarity.astype(jnp.float32)
+    init = (sim, jnp.full((M,), -1, jnp.int32), jnp.zeros((M,), jnp.float32))
+    _, match_idx, match_dist = jax.lax.fori_loop(0, K, body, init)
+    return match_idx, match_dist
+
+
+def nms(
+    boxes: jax.Array,
+    scores: jax.Array,
+    max_out: int,
+    iou_threshold: float = 0.3,
+    score_threshold: float = 0.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Single-class NMS with a static output size (the reference's NMSFast in
+    ``multiclass_nms_op.cc``): iteratively select the highest-scoring live box
+    and suppress overlaps. Returns ``(indices [max_out] padded with -1,
+    count)``."""
+    n = boxes.shape[0]
+    iou = iou_similarity(boxes, boxes)  # [n, n]
+    live = scores > score_threshold
+
+    def body(i, state):
+        live, sel, count = state
+        masked = jnp.where(live, scores, NEG_INF)
+        best = jnp.argmax(masked)
+        ok = masked[best] > NEG_INF / 2
+        sel = jnp.where(ok, sel.at[i].set(best.astype(jnp.int32)), sel)
+        count = count + ok.astype(jnp.int32)
+        suppress = iou[best] >= iou_threshold
+        live = live & ~suppress & (jnp.arange(n) != best)
+        live = jnp.where(ok, live, jnp.zeros_like(live))
+        return live, sel, count
+
+    init = (live, jnp.full((max_out,), -1, jnp.int32), jnp.zeros((), jnp.int32))
+    _, sel, count = jax.lax.fori_loop(0, max_out, body, init)
+    return sel, count
+
+
+def multiclass_nms(
+    boxes: jax.Array,
+    scores: jax.Array,
+    score_threshold: float = 0.01,
+    nms_threshold: float = 0.3,
+    nms_top_k: int = 64,
+    keep_top_k: int = 100,
+    background_label: int = 0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Multi-class NMS (reference ``multiclass_nms_op.cc`` MultiClassNMS +
+    MultiClassOutput): per non-background class run NMS, then keep the global
+    ``keep_top_k`` by score. Fixed-shape output: ``(dets [keep_top_k, 6],
+    count)`` with rows [class, score, x1, y1, x2, y2], padding class = -1.
+
+    boxes: [N, 4] shared across classes; scores: [C, N].
+    """
+    C, N = scores.shape
+    all_cls, all_score, all_box = [], [], []
+    for c in range(C):
+        if c == background_label:
+            continue
+        sel, _ = nms(boxes, scores[c], nms_top_k, nms_threshold, score_threshold)
+        valid = sel >= 0
+        safe = jnp.maximum(sel, 0)
+        all_cls.append(jnp.where(valid, c, -1).astype(jnp.float32))
+        all_score.append(jnp.where(valid, scores[c][safe], NEG_INF))
+        all_box.append(boxes[safe])
+    cls = jnp.concatenate(all_cls)  # [(C-1)*nms_top_k]
+    score = jnp.concatenate(all_score)
+    box = jnp.concatenate(all_box, axis=0)
+    k = min(keep_top_k, score.shape[0])
+    top_scores, top_idx = jax.lax.top_k(score, k)
+    out_cls = cls[top_idx]
+    valid = top_scores > NEG_INF / 2
+    out = jnp.concatenate(
+        [
+            jnp.where(valid, out_cls, -1.0)[:, None],
+            jnp.where(valid, top_scores, 0.0)[:, None],
+            jnp.where(valid[:, None], box[top_idx], 0.0),
+        ],
+        axis=1,
+    )
+    if k < keep_top_k:
+        out = jnp.pad(out, ((0, keep_top_k - k), (0, 0)), constant_values=-1.0)
+    return out, jnp.sum(valid.astype(jnp.int32))
+
+
+def target_assign(
+    targets: jax.Array,
+    match_indices: jax.Array,
+    mismatch_value: float = 0.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Scatter row targets to matched columns (reference
+    ``target_assign_op.h``): ``targets`` [N, D], ``match_indices`` [M]
+    (row index or -1) → ``(out [M, D], weight [M])`` with mismatch rows
+    filled with ``mismatch_value`` and weight 0."""
+    matched = match_indices >= 0
+    safe = jnp.maximum(match_indices, 0)
+    out = jnp.where(matched[:, None], targets[safe], mismatch_value)
+    weight = matched.astype(jnp.float32)
+    return out, weight
+
+
+def box_clip(boxes: jax.Array, image_shape: Tuple[float, float]) -> jax.Array:
+    """Clip boxes to image bounds (reference ``box_clip`` in bbox_util.h)."""
+    h, w = image_shape
+    return jnp.stack(
+        [
+            jnp.clip(boxes[..., 0], 0.0, w),
+            jnp.clip(boxes[..., 1], 0.0, h),
+            jnp.clip(boxes[..., 2], 0.0, w),
+            jnp.clip(boxes[..., 3], 0.0, h),
+        ],
+        axis=-1,
+    )

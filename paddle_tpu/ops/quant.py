@@ -1,0 +1,101 @@
+"""Fake-quantization ops (quantization-aware training).
+
+Reference: ``paddle/fluid/operators/fake_quantize_op.cc`` (fake_quantize_
+abs_max, fake_channel_wise_quantize_abs_max, fake_quantize_range_abs_max,
+fake_quantize_moving_average_abs_max) and ``fake_dequantize_op.cc``.
+
+TPU-native: quantize/dequantize stay in float (bf16/f32) — the point is to
+simulate INT-k rounding inside the forward pass; gradients flow via the
+straight-through estimator (``jax.custom_vjp`` identity), matching the
+reference's grad kernels which pass gradients through unchanged. Moving
+statistics are functional: the op returns the updated scale state instead of
+mutating a variable in place.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "fake_quantize_abs_max",
+    "fake_channel_wise_quantize_abs_max",
+    "fake_quantize_moving_average_abs_max",
+    "fake_dequantize_max_abs",
+]
+
+
+@jax.custom_vjp
+def _ste_round(x):
+    return jnp.round(x)
+
+
+def _ste_round_fwd(x):
+    return jnp.round(x), None
+
+
+def _ste_round_bwd(_, g):
+    return (g,)
+
+
+_ste_round.defvjp(_ste_round_fwd, _ste_round_bwd)
+
+
+def _quant_levels(bit_length: int) -> float:
+    return float((1 << (bit_length - 1)) - 1)
+
+
+def fake_quantize_abs_max(
+    x: jax.Array, bit_length: int = 8
+) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-tensor fake quantization: scale = max|x|; returns
+    ``(quantized_dequantized, scale)`` (reference fake_quantize_abs_max)."""
+    levels = _quant_levels(bit_length)
+    scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-12)
+    q = _ste_round(x / scale * levels)
+    q = jnp.clip(q, -levels, levels)
+    return q * scale / levels, scale
+
+
+def fake_channel_wise_quantize_abs_max(
+    x: jax.Array, bit_length: int = 8, channel_axis: int = 0
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-channel symmetric fake quantization (reference
+    fake_channel_wise_quantize_abs_max; conv weight layout)."""
+    levels = _quant_levels(bit_length)
+    channel_axis = channel_axis % x.ndim
+    axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    scale = jnp.maximum(jnp.max(jnp.abs(x), axis=axes, keepdims=True), 1e-12)
+    q = jnp.clip(_ste_round(x / scale * levels), -levels, levels)
+    return q * scale / levels, jnp.squeeze(scale, axes)
+
+
+def fake_quantize_moving_average_abs_max(
+    x: jax.Array,
+    moving_scale: jax.Array,
+    bit_length: int = 8,
+    moving_rate: float = 0.9,
+    is_test: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Activation quantization with an EMA scale (reference
+    fake_quantize_moving_average_abs_max): in training the scale state is
+    updated as ``rate*state + (1-rate)*max|x|`` and returned alongside."""
+    levels = _quant_levels(bit_length)
+    if is_test:
+        scale = moving_scale
+    else:
+        cur = jnp.max(jnp.abs(x))
+        scale = moving_rate * moving_scale + (1.0 - moving_rate) * cur
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.clip(_ste_round(x / scale * levels), -levels, levels)
+    return q * scale / levels, scale
+
+
+def fake_dequantize_max_abs(
+    x: jax.Array, scale: jax.Array, max_range: float
+) -> jax.Array:
+    """Dequantize integers back to float (reference fake_dequantize_max_abs):
+    ``out = x * scale / max_range``."""
+    return x * scale / max_range

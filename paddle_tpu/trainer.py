@@ -1,0 +1,250 @@
+"""High-level Trainer with event callbacks, auto-checkpoint and auto-resume.
+
+Reference: ``python/paddle/fluid/trainer.py:169`` (Trainer(train_func,
+optimizer_func) driving train_loop with Begin/EndEpochEvent +
+Begin/EndStepEvent callbacks), ``trainer.py:100`` (CheckpointConfig),
+``trainer.py:594,663,763`` (auto-resume on init, save_checkpoint per
+epoch/step interval, trainer metadata), ``trainer.py:324`` (cluster-role
+wiring from env vars), ``trainer.py:541`` (ParallelExecutor path).
+
+TPU-native: the "program pair" (startup + main) collapses into
+``Model.init`` + a compiled train step; the ParallelExecutor path becomes
+:class:`paddle_tpu.parallel.DataParallel` over a mesh; PS-mode transpilation
+is replaced by multi-host mesh initialization (see
+``paddle_tpu.transpiler.distributed``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Iterable, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from paddle_tpu import checkpoint as ckpt_mod
+from paddle_tpu.checkpoint import CheckpointConfig
+from paddle_tpu.core import logging as ptlog
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.executor import Executor
+from paddle_tpu.framework import Model, Variables
+from paddle_tpu.optimizer import Optimizer, OptState, StepOutput
+
+__all__ = [
+    "Trainer",
+    "BeginEpochEvent",
+    "EndEpochEvent",
+    "BeginStepEvent",
+    "EndStepEvent",
+    "CheckpointConfig",
+]
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id: int):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id: int):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id: int, step_id: int):
+        self.epoch = epoch_id
+        self.step = step_id
+        # mirrors reference BeginStepEvent.fetch_metrics (trainer.py:158)
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id: int, step_id: int, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class Trainer:
+    """Drive training of a built Model with events + checkpointing.
+
+    ``train_func`` builds and returns the model (a :class:`Model` or a plain
+    layer-calling function, which is wrapped); its forward must return the
+    loss first. ``optimizer_func`` returns an :class:`Optimizer`.
+    """
+
+    def __init__(
+        self,
+        train_func: Callable[[], Any],
+        optimizer_func: Callable[[], Optimizer],
+        place=None,
+        parallel: bool = False,
+        checkpoint_config: Optional[CheckpointConfig] = None,
+        rng: int | jax.Array | None = 0,
+    ):
+        from paddle_tpu.framework import build
+
+        model = train_func()
+        self.model = model if isinstance(model, Model) else build(model)
+        self.optimizer = optimizer_func()
+        self.parallel = parallel
+        self.checkpoint_cfg = checkpoint_config
+        self.rng = rng
+        self.place = place
+        self.exe = Executor(place)
+        self.trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._dp = None
+        self._step_fn = None
+        self.variables: Optional[Variables] = None
+        self.opt_state: Optional[OptState] = None
+        self.epoch = 0
+        self.global_step = 0
+        self._last_saved_step = -1
+
+    # -- init / resume ------------------------------------------------------
+    def _ensure_initialized(self, first_batch: Sequence[Any]):
+        if self.variables is not None:
+            return
+        if self.parallel:
+            from paddle_tpu.parallel import DataParallel
+            from paddle_tpu.parallel.mesh import default_mesh
+
+            self._dp = DataParallel(self.model, self.optimizer, mesh=default_mesh())
+            self.variables, self.opt_state = self._dp.init(self.rng, *first_batch)
+        else:
+            self.variables = self.model.init(self.rng, *first_batch)
+            self.opt_state = self.optimizer.create_state(self.variables.params)
+
+        # auto-resume (reference Trainer.__init__ -> _load_checkpoint,
+        # trainer.py:594-629)
+        if self.checkpoint_cfg is not None:
+            root = self.checkpoint_cfg.checkpoint_dir
+            if ckpt_mod.latest_checkpoint(root):
+                tree = (self.variables, self.opt_state)
+                tree, meta = ckpt_mod.load_checkpoint(root, tree, self.trainer_id)
+                self.variables, self.opt_state = tree
+                # next_epoch: epoch+1 for end-of-epoch saves, same epoch for
+                # mid-epoch saves (reference restarts the interrupted epoch)
+                self.epoch = int(meta.get("next_epoch", meta.get("epoch", 0)))
+                self.global_step = int(meta.get("step", 0))
+                self._last_saved_step = self.global_step
+                ptlog.vlog(
+                    0, "resumed from checkpoint: continuing at epoch %d step %d",
+                    self.epoch, self.global_step,
+                )
+
+    def _compiled_step(self):
+        if self._step_fn is None:
+            raw = self.optimizer.minimize(self.model)
+            self._step_fn = self.exe.prepare(raw, key=("trainer_step", id(self)))
+        return self._step_fn
+
+    # -- train loop ---------------------------------------------------------
+    def train(
+        self,
+        num_epochs: int,
+        event_handler: Optional[Callable[[Any], None]] = None,
+        reader: Optional[Callable[[], Iterable[Tuple]]] = None,
+        feed_order=None,  # accepted for API parity; batches are positional
+    ):
+        """Run the training loop (reference ``Trainer.train`` →
+        ``_train_by_executor``/``_train_by_parallel_executor``,
+        trainer.py:404,541)."""
+        enforce(reader is not None, "Trainer.train needs a batched reader")
+        handler = event_handler or (lambda event: None)
+        # initialize (and auto-resume) BEFORE choosing the start epoch, so a
+        # fresh Trainer with a checkpoint on disk skips completed epochs
+        if self.variables is None:
+            first = next(iter(reader()), None)
+            enforce(first is not None, "reader yielded no batches")
+            self._ensure_initialized(first)
+        for epoch_id in range(self.epoch, num_epochs):
+            self.epoch = epoch_id
+            handler(BeginEpochEvent(epoch_id))
+            for step_id, batch in enumerate(reader()):
+                begin_ev = BeginStepEvent(epoch_id, step_id)
+                handler(begin_ev)
+                out = self._run_step(batch)
+                self.variables, self.opt_state = out.variables, out.opt_state
+                self.global_step += 1
+                # honoring fetch_metrics avoids a host sync per step
+                # (reference BeginStepEvent.fetch_metrics, trainer.py:158)
+                metrics = float(out.loss) if begin_ev.fetch_metrics else None
+                handler(EndStepEvent(epoch_id, step_id, metrics))
+                self._maybe_checkpoint(epoch_id, step=True)
+            handler(EndEpochEvent(epoch_id))
+            self._maybe_checkpoint(epoch_id, step=False)
+
+    def _run_step(self, batch) -> StepOutput:
+        if self.parallel:
+            dev_batch = self._dp.put_batch(*batch)
+            return self._dp.step(self.variables, self.opt_state, *dev_batch)
+        step_fn = self._compiled_step()
+        return step_fn(self.variables, self.opt_state, *[jax.numpy.asarray(b) for b in batch])
+
+    def _maybe_checkpoint(self, epoch_id: int, step: bool):
+        cfg = self.checkpoint_cfg
+        if cfg is None or self.variables is None:
+            return
+        due = (
+            self.global_step % cfg.step_interval == 0
+            if step
+            else (epoch_id + 1) % cfg.epoch_interval == 0
+        )
+        if not due:
+            return
+        # if a step save already captured this state, don't save a duplicate
+        # serial — but an epoch boundary must still bump next_epoch in the
+        # metadata so resume skips the completed epoch
+        if self.global_step == self._last_saved_step:
+            if not step:
+                self._update_latest_meta({"next_epoch": self.epoch + 1})
+            return
+        ckpt_mod.save_checkpoint(
+            cfg.checkpoint_dir,
+            (self.variables, self.opt_state),
+            step=self.global_step,
+            epoch=self.epoch,
+            max_num_checkpoints=cfg.max_num_checkpoints,
+            trainer_id=self.trainer_id,
+            extra_meta={"next_epoch": self.epoch + (0 if step else 1)},
+        )
+        self._last_saved_step = self.global_step
+
+    def _update_latest_meta(self, updates: dict):
+        import json
+
+        latest = ckpt_mod.latest_checkpoint(self.checkpoint_cfg.checkpoint_dir)
+        if latest is None:
+            return
+        meta_path = os.path.join(latest, "checkpoint.json")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        meta.update(updates)
+        with open(meta_path, "w") as f:
+            json.dump(meta, f, indent=1)
+
+    # -- eval / predict -----------------------------------------------------
+    def test(self, reader: Callable[[], Iterable[Tuple]], loss_index: int = 0):
+        """Average loss over a reader (reference Trainer.test,
+        trainer.py:438)."""
+        enforce(self.variables is not None, "train (or init) before test")
+        losses, count = [], 0
+        for batch in reader():
+            out, _ = self.model.apply(
+                self.variables, *[jax.numpy.asarray(b) for b in batch], is_train=False
+            )
+            loss = out[loss_index] if isinstance(out, (tuple, list)) else out
+            losses.append(float(jax.numpy.mean(loss)))
+            count += 1
+        return float(np.mean(losses)) if losses else float("nan")
+
+    def save_params(self, dirname: str):
+        """Persist current parameters (reference save_params, io.py:89)."""
+        from paddle_tpu import io as io_mod
+
+        enforce(self.variables is not None, "nothing to save: model not initialized")
+        io_mod.save_params(dirname, self.variables)
+
+    def stop(self):
+        self.exe.close()

@@ -1,0 +1,174 @@
+"""Detection + quantization op tests (reference analogues:
+test_prior_box_op.py, test_anchor_generator_op.py, test_box_coder_op.py,
+test_iou_similarity_op.py, test_bipartite_match_op.py,
+test_multiclass_nms_op.py, test_target_assign_op.py,
+test_fake_quantize_op.py, test_fake_dequantize_op.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import detection as det
+from paddle_tpu.ops import quant
+
+
+def _np_iou(a, b):
+    xl = max(a[0], b[0]); yt = max(a[1], b[1])
+    xr = min(a[2], b[2]); yb = min(a[3], b[3])
+    inter = max(xr - xl, 0) * max(yb - yt, 0)
+    area = lambda r: max(r[2] - r[0], 0) * max(r[3] - r[1], 0)
+    u = area(a) + area(b) - inter
+    return inter / u if u > 0 else 0.0
+
+
+def test_iou_similarity_vs_numpy(rng):
+    x = np.abs(rng.rand(4, 4)).astype(np.float32)
+    y = np.abs(rng.rand(5, 4)).astype(np.float32)
+    # make valid boxes: x2>x1, y2>y1
+    x[:, 2:] = x[:, :2] + np.abs(rng.rand(4, 2)) + 0.1
+    y[:, 2:] = y[:, :2] + np.abs(rng.rand(5, 2)) + 0.1
+    got = np.asarray(jax.jit(det.iou_similarity)(jnp.asarray(x), jnp.asarray(y)))
+    for i in range(4):
+        for j in range(5):
+            np.testing.assert_allclose(got[i, j], _np_iou(x[i], y[j]), rtol=1e-5)
+
+
+def test_prior_box_first_cell():
+    boxes, variances = det.prior_box(
+        feature_shape=(2, 2), image_shape=(100, 100),
+        min_sizes=[10.0], max_sizes=[20.0], aspect_ratios=[2.0],
+    )
+    # priors per cell: ar {1, 2} × min_size + 1 max_size = 3
+    assert boxes.shape == (2, 2, 3, 4)
+    b = np.asarray(boxes)[0, 0]
+    # cell center at (0.5*50)/100 = 0.25 both axes
+    np.testing.assert_allclose((b[0, 0] + b[0, 2]) / 2, 0.25, atol=1e-6)
+    # ar=1 box is min_size/img = 0.1 wide
+    np.testing.assert_allclose(b[0, 2] - b[0, 0], 0.1, atol=1e-6)
+    # max_size box is sqrt(10*20)/100 wide
+    np.testing.assert_allclose(b[2, 2] - b[2, 0], np.sqrt(200) / 100, atol=1e-6)
+    assert variances.shape == boxes.shape
+
+
+def test_anchor_generator_shapes():
+    anchors, var = det.anchor_generator(
+        (3, 4), anchor_sizes=[64.0, 128.0], aspect_ratios=[0.5, 1.0], stride=(16, 16)
+    )
+    assert anchors.shape == (3, 4, 4, 4)
+    a = np.asarray(anchors)[1, 2]
+    # centers at ((2+.5)*16, (1+.5)*16)
+    np.testing.assert_allclose((a[:, 0] + a[:, 2]) / 2, 40.0, atol=1e-4)
+    np.testing.assert_allclose((a[:, 1] + a[:, 3]) / 2, 24.0, atol=1e-4)
+    # ar=1 size-64 anchor is 64 wide
+    widths = a[:, 2] - a[:, 0]
+    assert np.any(np.isclose(widths, 64.0, atol=1e-3))
+
+
+def test_box_coder_roundtrip(rng):
+    M, N = 6, 3
+    priors = rng.rand(M, 4).astype(np.float32)
+    priors[:, 2:] = priors[:, :2] + 0.2
+    var = np.tile(np.array([0.1, 0.1, 0.2, 0.2], np.float32), (M, 1))
+    targets = rng.rand(N, 4).astype(np.float32)
+    targets[:, 2:] = targets[:, :2] + 0.3
+
+    codes = det.box_coder(jnp.asarray(priors), jnp.asarray(var), jnp.asarray(targets),
+                          "encode_center_size")
+    assert codes.shape == (N, M, 4)
+    decoded = det.box_coder(jnp.asarray(priors), jnp.asarray(var), codes,
+                            "decode_center_size")
+    # decoding the encoded offsets must recover the target boxes for every prior
+    for m in range(M):
+        np.testing.assert_allclose(np.asarray(decoded)[:, m], targets, rtol=1e-4, atol=1e-5)
+
+
+def test_bipartite_match_greedy():
+    sim = jnp.asarray(np.array([
+        [0.9, 0.1, 0.3],
+        [0.8, 0.7, 0.2],
+    ], np.float32))
+    match_idx, match_dist = jax.jit(det.bipartite_match)(sim)
+    # global max 0.9 -> row0/col0; then best remaining 0.7 -> row1/col1
+    np.testing.assert_array_equal(np.asarray(match_idx), [0, 1, -1])
+    np.testing.assert_allclose(np.asarray(match_dist)[:2], [0.9, 0.7])
+
+
+def test_nms_suppresses_overlaps():
+    boxes = jnp.asarray(np.array([
+        [0.0, 0.0, 1.0, 1.0],
+        [0.05, 0.05, 1.0, 1.0],   # heavy overlap with 0
+        [2.0, 2.0, 3.0, 3.0],     # disjoint
+    ], np.float32))
+    scores = jnp.asarray(np.array([0.9, 0.8, 0.7], np.float32))
+    sel, count = jax.jit(lambda b, s: det.nms(b, s, max_out=3, iou_threshold=0.5))(boxes, scores)
+    assert int(count) == 2
+    np.testing.assert_array_equal(np.asarray(sel), [0, 2, -1])
+
+
+def test_multiclass_nms():
+    boxes = jnp.asarray(np.array([
+        [0.0, 0.0, 1.0, 1.0],
+        [0.02, 0.0, 1.0, 1.0],
+        [2.0, 2.0, 3.0, 3.0],
+    ], np.float32))
+    # class 0 = background; classes 1,2 active
+    scores = jnp.asarray(np.array([
+        [0.1, 0.1, 0.1],
+        [0.9, 0.85, 0.05],
+        [0.02, 0.03, 0.95],
+    ], np.float32))
+    dets, count = jax.jit(
+        lambda b, s: det.multiclass_nms(b, s, score_threshold=0.1, nms_threshold=0.5,
+                                        nms_top_k=3, keep_top_k=5)
+    )(boxes, scores)
+    d = np.asarray(dets)
+    assert int(count) == 2
+    # best: class1 box0 (0.9), then class2 box2 (0.95) -> sorted by score
+    assert d[0, 0] == 2.0 and abs(d[0, 1] - 0.95) < 1e-6
+    assert d[1, 0] == 1.0 and abs(d[1, 1] - 0.9) < 1e-6
+    assert np.all(d[2:, 0] == -1.0)
+
+
+def test_target_assign():
+    targets = jnp.asarray(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    match = jnp.asarray(np.array([1, -1, 0], np.int32))
+    out, w = det.target_assign(targets, match, mismatch_value=-9.0)
+    np.testing.assert_allclose(np.asarray(out), [[3, 4], [-9, -9], [1, 2]])
+    np.testing.assert_allclose(np.asarray(w), [1, 0, 1])
+
+
+def test_fake_quantize_abs_max(rng):
+    x = jnp.asarray(rng.randn(8, 8).astype(np.float32))
+    out, scale = jax.jit(quant.fake_quantize_abs_max)(x)
+    assert float(scale) == float(jnp.max(jnp.abs(x)))
+    # quantized values land on the 127-level grid
+    grid = np.asarray(out) / (float(scale) / 127.0)
+    np.testing.assert_allclose(grid, np.round(grid), atol=1e-4)
+    # max error bounded by half a step
+    assert float(jnp.max(jnp.abs(out - x))) <= float(scale) / 127.0 / 2 + 1e-6
+
+
+def test_fake_quantize_ste_gradient(rng):
+    x = jnp.asarray(rng.randn(16).astype(np.float32))
+    g = jax.grad(lambda v: jnp.sum(quant.fake_quantize_abs_max(v)[0] ** 2))(x)
+    assert np.all(np.isfinite(np.asarray(g)))
+    assert float(jnp.max(jnp.abs(g))) > 0.0
+
+
+def test_fake_quantize_channel_and_moving(rng):
+    w = jnp.asarray(rng.randn(4, 3, 3).astype(np.float32))
+    out, scales = quant.fake_channel_wise_quantize_abs_max(w, channel_axis=0)
+    assert scales.shape == (4,)
+    np.testing.assert_allclose(
+        np.asarray(scales), np.abs(np.asarray(w)).max(axis=(1, 2)), rtol=1e-6
+    )
+
+    x = jnp.asarray(rng.randn(10).astype(np.float32))
+    out, new_scale = quant.fake_quantize_moving_average_abs_max(
+        x, jnp.asarray(1.0), moving_rate=0.9
+    )
+    expected = 0.9 * 1.0 + 0.1 * float(jnp.max(jnp.abs(x)))
+    np.testing.assert_allclose(float(new_scale), expected, rtol=1e-6)
+
+    deq = quant.fake_dequantize_max_abs(jnp.asarray([127.0]), jnp.asarray(0.5), 127.0)
+    np.testing.assert_allclose(np.asarray(deq), [0.5])

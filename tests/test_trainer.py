@@ -1,0 +1,123 @@
+"""Trainer high-level API tests (reference analogues: the book tests driven
+through fluid.Trainer, e.g. tests/book/test_fit_a_line.py's trainer path, and
+the checkpoint/auto-resume logic of trainer.py:594-763)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.trainer import (
+    BeginEpochEvent,
+    BeginStepEvent,
+    EndEpochEvent,
+    EndStepEvent,
+    Trainer,
+    CheckpointConfig,
+)
+
+
+def _linreg_model():
+    def net(x, y):
+        pred = pt.layers.fc(x, size=1)
+        loss = pt.ops.nn.square_error_cost(pred, y)
+        return jnp.mean(loss)
+
+    return net
+
+
+def _reader(n_batches=4, bs=8, seed=0):
+    def reader():
+        rng = np.random.RandomState(seed)
+        w = np.array([[2.0], [-1.0], [0.5], [3.0]], np.float32)
+        for _ in range(n_batches):
+            x = rng.randn(bs, 4).astype(np.float32)
+            y = x @ w + 0.1
+            yield x, y
+
+    return reader
+
+
+def test_trainer_loss_decreases_and_events_fire():
+    events = []
+    trainer = Trainer(_linreg_model, lambda: pt.optimizer.SGD(learning_rate=0.1))
+    losses = []
+
+    def handler(ev):
+        events.append(type(ev).__name__)
+        if isinstance(ev, EndStepEvent):
+            losses.append(ev.metrics)
+
+    trainer.train(num_epochs=3, event_handler=handler, reader=_reader())
+    assert losses[-1] < losses[0]
+    assert events[0] == "BeginEpochEvent"
+    assert events.count("BeginEpochEvent") == 3
+    assert events.count("EndEpochEvent") == 3
+    assert events.count("BeginStepEvent") == 12
+    # test() evaluates
+    test_loss = trainer.test(_reader(n_batches=2, seed=1))
+    assert np.isfinite(test_loss)
+
+
+def test_trainer_checkpoint_and_auto_resume(tmp_path):
+    root = str(tmp_path / "ckpt")
+    cfg = CheckpointConfig(root, max_num_checkpoints=2, step_interval=2)
+    t1 = Trainer(_linreg_model, lambda: pt.optimizer.Adam(learning_rate=0.05),
+                 checkpoint_config=cfg)
+    t1.train(num_epochs=2, reader=_reader())
+    assert t1.global_step == 8
+    saved_param = np.asarray(t1.variables.params["fc/w"])
+
+    # a fresh trainer resumes from the checkpoint dir and does NOT re-train
+    # completed epochs (train() loads the checkpoint before picking the
+    # start epoch)
+    t2 = Trainer(_linreg_model, lambda: pt.optimizer.Adam(learning_rate=0.05),
+                 checkpoint_config=cfg)
+    steps = []
+    t2.train(num_epochs=2, reader=_reader(),
+             event_handler=lambda ev: steps.append(ev) if isinstance(ev, EndStepEvent) else None)
+    assert steps == []  # both epochs already done
+    assert t2.global_step == 8
+    assert t2.epoch == 2
+    np.testing.assert_allclose(np.asarray(t2.variables.params["fc/w"]), saved_param)
+    # optimizer slots restored too
+    assert int(t2.opt_state.step) == int(t1.opt_state.step)
+
+    # a third epoch trains exactly 4 more steps
+    t2.train(num_epochs=3, reader=_reader(),
+             event_handler=lambda ev: steps.append(ev) if isinstance(ev, EndStepEvent) else None)
+    assert len(steps) == 4 and t2.global_step == 12
+
+    # pruning: at most max_num_checkpoints serials on disk
+    import os
+
+    serials = [d for d in os.listdir(root) if d.startswith("checkpoint_")]
+    assert len(serials) <= 2
+
+
+def test_trainer_parallel_path():
+    trainer = Trainer(
+        _linreg_model, lambda: pt.optimizer.SGD(learning_rate=0.1), parallel=True
+    )
+    losses = []
+
+    def handler(ev):
+        if isinstance(ev, EndStepEvent):
+            losses.append(ev.metrics)
+
+    trainer.train(num_epochs=2, event_handler=handler, reader=_reader(bs=16))
+    assert losses[-1] < losses[0]
+    assert trainer._dp is not None
+    assert trainer._dp.num_devices == 8  # virtual CPU mesh from conftest
+
+
+def test_trainer_save_params(tmp_path):
+    trainer = Trainer(_linreg_model, lambda: pt.optimizer.SGD(learning_rate=0.1))
+    trainer.train(num_epochs=1, reader=_reader(n_batches=2))
+    out = str(tmp_path / "params")
+    trainer.save_params(out)
+    loaded = pt.io.load_params(out)
+    np.testing.assert_allclose(
+        np.asarray(loaded.params["fc/w"]),
+        np.asarray(trainer.variables.params["fc/w"]),
+    )
