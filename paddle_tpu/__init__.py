@@ -45,6 +45,9 @@ from paddle_tpu import parallel
 from paddle_tpu.parallel import DataParallel
 from paddle_tpu import trainer
 from paddle_tpu.trainer import Trainer, CheckpointConfig
+from paddle_tpu import transpiler
+from paddle_tpu.transpiler import memory_optimize, release_memory
+from paddle_tpu import dataset
 
 CPUPlace = config.CPUPlace
 TPUPlace = config.TPUPlace
@@ -81,6 +84,10 @@ __all__ = [
     "trainer",
     "Trainer",
     "CheckpointConfig",
+    "transpiler",
+    "memory_optimize",
+    "release_memory",
+    "dataset",
     "CPUPlace",
     "TPUPlace",
 ]

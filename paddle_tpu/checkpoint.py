@@ -133,3 +133,20 @@ def load_checkpoint(path_or_root: str, tree_like: Any, trainer_id: int = 0) -> T
         jax.numpy.asarray(l, dtype=np.asarray(ref).dtype) for l, ref in zip(leaves, like_leaves)
     ]
     return jax.tree_util.tree_unflatten(treedef, restored), meta
+
+
+def update_meta(path_or_root: str, updates: dict) -> None:
+    """Merge fields into the latest checkpoint's metadata (used by Trainer to
+    bump next_epoch at epoch boundaries without re-saving identical state)."""
+    path = path_or_root
+    if not os.path.exists(os.path.join(path, _META)):
+        latest = latest_checkpoint(path_or_root)
+        if latest is None:
+            return
+        path = latest
+    meta_path = os.path.join(path, _META)
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta.update(updates)
+    with open(meta_path, "w") as f:
+        json.dump(meta, f, indent=1)

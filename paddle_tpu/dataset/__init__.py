@@ -1,0 +1,43 @@
+"""Dataset reader factories.
+
+Reference: ``python/paddle/dataset/`` — per-dataset modules exposing
+``train()``/``test()`` reader creators over downloaded-and-cached archives
+(``dataset/common.py`` download with md5 cache).
+
+TPU-build note: this environment has no network egress, so each module
+resolves data in this order:
+1. a local cache (``~/.cache/paddle_tpu/dataset/<name>`` or
+   ``$PADDLE_TPU_DATA_HOME``) holding real data in the simple ``.npz``
+   layout documented per module — drop files there to train on real data;
+2. otherwise a deterministic synthetic sample with the exact shapes, dtypes
+   and vocab structure of the real dataset, so every model config, reader
+   combinator and test runs unchanged.
+
+The reader protocol is identical to the reference: a reader creator returns a
+zero-arg callable yielding one example per next() (batching is done by
+``paddle_tpu.reader`` combinators, mirroring ``paddle.batch``).
+"""
+
+from paddle_tpu.dataset import common  # noqa: F401
+from paddle_tpu.dataset import uci_housing  # noqa: F401
+from paddle_tpu.dataset import mnist  # noqa: F401
+from paddle_tpu.dataset import cifar  # noqa: F401
+from paddle_tpu.dataset import flowers  # noqa: F401
+from paddle_tpu.dataset import imdb  # noqa: F401
+from paddle_tpu.dataset import imikolov  # noqa: F401
+from paddle_tpu.dataset import movielens  # noqa: F401
+from paddle_tpu.dataset import wmt16  # noqa: F401
+from paddle_tpu.dataset import conll05  # noqa: F401
+
+__all__ = [
+    "common",
+    "uci_housing",
+    "mnist",
+    "cifar",
+    "flowers",
+    "imdb",
+    "imikolov",
+    "movielens",
+    "wmt16",
+    "conll05",
+]

@@ -1,0 +1,47 @@
+"""CIFAR-10/100 (reference ``dataset/cifar.py``): examples are
+(image [3072] float32 in [0, 1], label). Cache layout:
+``cifar{10,100}/{train,test}.npz`` with ``images`` [N,3072], ``labels`` [N].
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+IMAGE_SIZE = 3 * 32 * 32
+
+
+def _synthetic(name: str, split: str, n: int, num_classes: int):
+    rng = np.random.RandomState(common.synthetic_seed(name, split))
+    labels = rng.randint(0, num_classes, n).astype(np.int64)
+    templates = np.random.RandomState(11).rand(num_classes, IMAGE_SIZE)
+    images = templates[labels] * 0.6 + rng.rand(n, IMAGE_SIZE) * 0.4
+    return {"images": images.astype(np.float32), "labels": labels}
+
+
+def _reader_creator(name: str, split: str, n: int, num_classes: int):
+    def reader():
+        data = common.cached_npz(name, split) or _synthetic(name, split, n, num_classes)
+        for img, lbl in zip(data["images"], data["labels"]):
+            yield img, int(lbl)
+
+    return reader
+
+
+def train10():
+    return _reader_creator("cifar10", "train", 1024, 10)
+
+
+def test10():
+    return _reader_creator("cifar10", "test", 256, 10)
+
+
+def train100():
+    return _reader_creator("cifar100", "train", 1024, 100)
+
+
+def test100():
+    return _reader_creator("cifar100", "test", 256, 100)

@@ -1,0 +1,48 @@
+"""CoNLL-2005 semantic role labeling (reference ``dataset/conll05.py``):
+examples are (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, verb_ids,
+mark, label_ids) — the label_semantic_roles config input."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+__all__ = ["test", "get_dict", "get_embedding", "word_dict_len", "label_dict_len", "pred_dict_len"]
+
+word_dict_len = 44068
+label_dict_len = 59
+pred_dict_len = 3162
+
+
+def get_dict():
+    word_dict = {f"w{i}": i for i in range(word_dict_len)}
+    verb_dict = {f"v{i}": i for i in range(pred_dict_len)}
+    label_dict = {f"l{i}": i for i in range(label_dict_len)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """Pretrained word embedding table [word_dict_len, 32] (the reference
+    ships emb32); synthetic: deterministic random."""
+    rng = np.random.RandomState(common.synthetic_seed("conll05", "emb"))
+    return rng.randn(word_dict_len, 32).astype(np.float32)
+
+
+def test():
+    def reader():
+        rng = np.random.RandomState(common.synthetic_seed("conll05", "test"))
+        for _ in range(128):
+            length = int(rng.randint(5, 30))
+            words = rng.randint(0, word_dict_len, length).tolist()
+            verb = int(rng.randint(0, pred_dict_len))
+            verb_pos = int(rng.randint(0, length))
+            ctx = [
+                [max(0, min(word_dict_len - 1, w + d)) for w in words]
+                for d in (-2, -1, 0, 1, 2)
+            ]
+            mark = [1 if i == verb_pos else 0 for i in range(length)]
+            labels = rng.randint(0, label_dict_len, length).tolist()
+            yield (words, *ctx, [verb] * length, mark, labels)
+
+    return reader

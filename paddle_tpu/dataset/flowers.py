@@ -1,0 +1,41 @@
+"""102 Category Flowers (reference ``dataset/flowers.py``): examples are
+(image [3, 224, 224] float32, label int). Cache layout:
+``flowers/{train,test}.npz`` with ``images`` [N,3,224,224], ``labels`` [N]."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+__all__ = ["train", "test", "valid"]
+
+NUM_CLASSES = 102
+
+
+def _synthetic(split: str, n: int):
+    rng = np.random.RandomState(common.synthetic_seed("flowers", split))
+    labels = rng.randint(0, NUM_CLASSES, n).astype(np.int64)
+    images = rng.rand(n, 3, 224, 224).astype(np.float32)
+    return {"images": images, "labels": labels}
+
+
+def _reader_creator(split: str, n: int):
+    def reader():
+        data = common.cached_npz("flowers", split) or _synthetic(split, n)
+        for img, lbl in zip(data["images"], data["labels"]):
+            yield img, int(lbl)
+
+    return reader
+
+
+def train():
+    return _reader_creator("train", 64)
+
+
+def test():
+    return _reader_creator("test", 16)
+
+
+def valid():
+    return _reader_creator("valid", 16)

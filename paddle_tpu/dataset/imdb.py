@@ -1,0 +1,53 @@
+"""IMDB sentiment (reference ``dataset/imdb.py``): examples are
+(word-id list, label 0/1); ``word_dict()`` returns token→id. Cache layout:
+``imdb/{train,test}.npz`` with object-free ragged encoding: ``tokens``
+[total] int64, ``offsets`` [N+1] int64, ``labels`` [N]."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+__all__ = ["train", "test", "word_dict"]
+
+VOCAB_SIZE = 5149  # matches the reference's NLTK-built dict magnitude
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(VOCAB_SIZE)}
+
+
+def _synthetic(split: str, n: int):
+    rng = np.random.RandomState(common.synthetic_seed("imdb", split))
+    labels = rng.randint(0, 2, n).astype(np.int64)
+    seqs, offsets = [], [0]
+    for lbl in labels:
+        length = int(rng.randint(20, 120))
+        # sentiment-correlated token distribution so models can learn
+        lo, hi = (0, VOCAB_SIZE // 2) if lbl == 0 else (VOCAB_SIZE // 2, VOCAB_SIZE)
+        seqs.append(rng.randint(lo, hi, length))
+        offsets.append(offsets[-1] + length)
+    return {
+        "tokens": np.concatenate(seqs).astype(np.int64),
+        "offsets": np.asarray(offsets, np.int64),
+        "labels": labels,
+    }
+
+
+def _reader_creator(split: str, n: int):
+    def reader():
+        data = common.cached_npz("imdb", split) or _synthetic(split, n)
+        toks, offs, labels = data["tokens"], data["offsets"], data["labels"]
+        for i, lbl in enumerate(labels):
+            yield toks[offs[i] : offs[i + 1]].tolist(), int(lbl)
+
+    return reader
+
+
+def train(word_idx=None):
+    return _reader_creator("train", 256)
+
+
+def test(word_idx=None):
+    return _reader_creator("test", 64)

@@ -1,0 +1,44 @@
+"""PTB language-model n-grams (reference ``dataset/imikolov.py``): examples
+are n-tuples of word ids (the word2vec/LM config input); ``build_dict()``
+returns the vocab."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+__all__ = ["train", "test", "build_dict"]
+
+VOCAB_SIZE = 2074  # reference builds ~2074 for min_word_freq=50
+
+
+def build_dict(min_word_freq: int = 50):
+    return {f"w{i}": i for i in range(VOCAB_SIZE)}
+
+
+def _reader_creator(split: str, n_words: int, n: int):
+    def reader():
+        data = common.cached_npz("imikolov", split)
+        if data is not None:
+            stream = data["tokens"]
+        else:
+            rng = np.random.RandomState(common.synthetic_seed("imikolov", split))
+            # Markov-ish stream: next word depends on previous (learnable)
+            stream = np.zeros(n, np.int64)
+            w = 1
+            for i in range(n):
+                w = int((w * 31 + rng.randint(0, 7)) % VOCAB_SIZE)
+                stream[i] = w
+        for i in range(len(stream) - n_words + 1):
+            yield tuple(int(t) for t in stream[i : i + n_words])
+
+    return reader
+
+
+def train(word_idx=None, n: int = 5):
+    return _reader_creator("train", n, 4096)
+
+
+def test(word_idx=None, n: int = 5):
+    return _reader_creator("test", n, 512)

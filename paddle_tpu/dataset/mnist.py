@@ -1,0 +1,43 @@
+"""MNIST (reference ``dataset/mnist.py``): examples are
+(image [784] float32 in [-1, 1], label int64). Cache layout:
+``mnist/{train,test}.npz`` with ``images`` [N,784] float32, ``labels`` [N]
+int64. Synthetic fallback: class-conditional blobs so a classifier can
+actually learn."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+__all__ = ["train", "test"]
+
+IMAGE_SIZE = 784
+NUM_CLASSES = 10
+
+
+def _synthetic(split: str, n: int):
+    rng = np.random.RandomState(common.synthetic_seed("mnist", split))
+    labels = rng.randint(0, NUM_CLASSES, n).astype(np.int64)
+    # one template pattern per class + noise, scaled into [-1, 1]
+    templates = np.random.RandomState(7).randn(NUM_CLASSES, IMAGE_SIZE)
+    images = templates[labels] + rng.randn(n, IMAGE_SIZE) * 0.5
+    images = np.tanh(images).astype(np.float32)
+    return {"images": images, "labels": labels}
+
+
+def _reader_creator(split: str, n: int):
+    def reader():
+        data = common.cached_npz("mnist", split) or _synthetic(split, n)
+        for img, lbl in zip(data["images"], data["labels"]):
+            yield img, int(lbl)
+
+    return reader
+
+
+def train():
+    return _reader_creator("train", 2048)
+
+
+def test():
+    return _reader_creator("test", 512)

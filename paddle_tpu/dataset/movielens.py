@@ -1,0 +1,69 @@
+"""MovieLens-1M (reference ``dataset/movielens.py``): examples are
+(user_id, gender, age, job, movie_id, category_ids, title_ids, score) — the
+recommender-system config input. Synthetic fallback keeps the reference's id
+ranges so embedding tables size identically."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+__all__ = [
+    "train",
+    "test",
+    "max_user_id",
+    "max_movie_id",
+    "max_job_id",
+    "age_table",
+    "movie_categories",
+]
+
+_MAX_USER = 6040
+_MAX_MOVIE = 3952
+_MAX_JOB = 20
+age_table = [1, 18, 25, 35, 45, 50, 56]
+_CATEGORIES = 18
+_TITLE_VOCAB = 5174
+
+
+def max_user_id() -> int:
+    return _MAX_USER
+
+
+def max_movie_id() -> int:
+    return _MAX_MOVIE
+
+
+def max_job_id() -> int:
+    return _MAX_JOB
+
+
+def movie_categories() -> int:
+    return _CATEGORIES
+
+
+def _reader_creator(split: str, n: int):
+    def reader():
+        rng = np.random.RandomState(common.synthetic_seed("movielens", split))
+        for _ in range(n):
+            user = int(rng.randint(1, _MAX_USER + 1))
+            gender = int(rng.randint(0, 2))
+            age = int(rng.randint(0, len(age_table)))
+            job = int(rng.randint(0, _MAX_JOB + 1))
+            movie = int(rng.randint(1, _MAX_MOVIE + 1))
+            cats = rng.randint(0, _CATEGORIES, rng.randint(1, 4)).tolist()
+            title = rng.randint(0, _TITLE_VOCAB, rng.randint(2, 8)).tolist()
+            # score correlated with user/movie parity so models can learn
+            score = float(1 + (user + movie) % 5)
+            yield user, gender, age, job, movie, cats, title, score
+
+    return reader
+
+
+def train():
+    return _reader_creator("train", 1024)
+
+
+def test():
+    return _reader_creator("test", 256)

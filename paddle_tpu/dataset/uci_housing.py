@@ -1,0 +1,39 @@
+"""UCI housing regression dataset (reference ``dataset/uci_housing.py``):
+examples are (features [13] float32, price [1] float32), feature-normalized.
+Cache layout: ``uci_housing/{train,test}.npz`` with arrays ``x`` [N,13], ``y``
+[N,1]. Synthetic fallback: linear ground truth + noise."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+__all__ = ["train", "test", "feature_num"]
+
+feature_num = 13
+
+
+def _synthetic(split: str, n: int):
+    rng = np.random.RandomState(common.synthetic_seed("uci_housing", split))
+    x = rng.randn(n, feature_num).astype(np.float32)
+    w = np.linspace(-2.0, 2.0, feature_num, dtype=np.float32)[:, None]
+    y = x @ w + 0.5 + rng.randn(n, 1).astype(np.float32) * 0.1
+    return {"x": x, "y": y.astype(np.float32)}
+
+
+def _reader_creator(split: str, n: int):
+    def reader():
+        data = common.cached_npz("uci_housing", split) or _synthetic(split, n)
+        for xi, yi in zip(data["x"], data["y"]):
+            yield xi, yi
+
+    return reader
+
+
+def train():
+    return _reader_creator("train", 404)
+
+
+def test():
+    return _reader_creator("test", 102)

@@ -1,0 +1,48 @@
+"""WMT16 en-de translation (reference ``dataset/wmt16.py``): examples are
+(src_ids, trg_ids, trg_next_ids) with <s>/<e>/<unk> conventions; per-language
+``get_dict``. Synthetic fallback emits aligned sequence pairs (target is a
+deterministic function of source) so seq2seq models can learn."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+__all__ = ["train", "test", "validation", "get_dict"]
+
+BOS, EOS, UNK = 0, 1, 2
+
+
+def get_dict(lang: str, dict_size: int, reverse: bool = False):
+    d = {"<s>": BOS, "<e>": EOS, "<unk>": UNK}
+    for i in range(3, dict_size):
+        d[f"{lang}{i}"] = i
+    return {v: k for k, v in d.items()} if reverse else d
+
+
+def _reader_creator(split: str, src_dict_size: int, trg_dict_size: int, n: int):
+    def reader():
+        rng = np.random.RandomState(common.synthetic_seed("wmt16", split))
+        for _ in range(n):
+            length = int(rng.randint(4, 20))
+            src = rng.randint(3, src_dict_size, length).tolist()
+            # deterministic "translation": affine remap into the target vocab
+            trg = [3 + (7 * w + 13) % (trg_dict_size - 3) for w in src]
+            trg_in = [BOS] + trg
+            trg_next = trg + [EOS]
+            yield src, trg_in, trg_next
+
+    return reader
+
+
+def train(src_dict_size: int = 10000, trg_dict_size: int = 10000, src_lang: str = "en"):
+    return _reader_creator("train", src_dict_size, trg_dict_size, 2048)
+
+
+def test(src_dict_size: int = 10000, trg_dict_size: int = 10000, src_lang: str = "en"):
+    return _reader_creator("test", src_dict_size, trg_dict_size, 256)
+
+
+def validation(src_dict_size: int = 10000, trg_dict_size: int = 10000, src_lang: str = "en"):
+    return _reader_creator("validation", src_dict_size, trg_dict_size, 256)

@@ -264,19 +264,23 @@ def multiclass_nms(
     boxes: [N, 4] shared across classes; scores: [C, N].
     """
     C, N = scores.shape
-    all_cls, all_score, all_box = [], [], []
-    for c in range(C):
-        if c == background_label:
-            continue
-        sel, _ = nms(boxes, scores[c], nms_top_k, nms_threshold, score_threshold)
-        valid = sel >= 0
-        safe = jnp.maximum(sel, 0)
-        all_cls.append(jnp.where(valid, c, -1).astype(jnp.float32))
-        all_score.append(jnp.where(valid, scores[c][safe], NEG_INF))
-        all_box.append(boxes[safe])
-    cls = jnp.concatenate(all_cls)  # [(C-1)*nms_top_k]
-    score = jnp.concatenate(all_score)
-    box = jnp.concatenate(all_box, axis=0)
+    cls_ids = jnp.asarray(
+        [c for c in range(C) if c != background_label], jnp.int32
+    )
+    fg_scores = scores[cls_ids]  # [C-1, N]
+
+    # one vmapped NMS over the class axis instead of C unrolled loops —
+    # keeps the HLO size constant in the class count
+    sel, _ = jax.vmap(
+        lambda s: nms(boxes, s, nms_top_k, nms_threshold, score_threshold)
+    )(fg_scores)  # sel: [C-1, nms_top_k]
+    valid = sel >= 0
+    safe = jnp.maximum(sel, 0)
+    cls = jnp.where(valid, cls_ids[:, None], -1).astype(jnp.float32).reshape(-1)
+    score = jnp.where(
+        valid, jnp.take_along_axis(fg_scores, safe, axis=1), NEG_INF
+    ).reshape(-1)
+    box = boxes[safe.reshape(-1)]
     k = min(keep_top_k, score.shape[0])
     top_scores, top_idx = jax.lax.top_k(score, k)
     out_cls = cls[top_idx]

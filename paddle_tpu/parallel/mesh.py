@@ -42,13 +42,15 @@ def initialize_distributed(
     coordinator_address = coordinator_address or os.environ.get("PADDLE_COORDINATOR_ADDR")
     num_processes = num_processes or _env_int("PADDLE_TRAINERS")
     process_id = process_id if process_id is not None else _env_int("PADDLE_TRAINER_ID")
+    # forward whatever the caller pinned down; silently dropping an explicit
+    # topology (e.g. trainers=2 with no coordinator) would mis-initialize
     kwargs = {}
-    if coordinator_address:
-        kwargs = dict(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
     jax.distributed.initialize(**kwargs)
     ptlog.info(
         "distributed initialized: process %d/%d, %d local / %d global devices",

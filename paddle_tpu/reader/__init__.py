@@ -44,6 +44,7 @@ __all__ = [
     "firstn",
     "xmap_readers",
     "batch",
+    "stack_batch",
     "cache",
     "DataFeeder",
     "DevicePrefetcher",
@@ -216,6 +217,23 @@ def batch(reader: Reader, batch_size: int, drop_last: bool = True) -> Reader:
             yield buf
 
     return batch_reader
+
+
+def stack_batch(reader: Reader, batch_size: int, drop_last: bool = True) -> Reader:
+    """Like :func:`batch` but yields a tuple of stacked numpy arrays (one per
+    sample field) instead of a list of sample tuples — the dense fast path
+    feeding jit'ed train steps directly (ragged fields need
+    :class:`DataFeeder` instead)."""
+    batched = batch(reader, batch_size, drop_last)
+
+    def stacked():
+        for samples in batched():
+            n_fields = len(samples[0])
+            yield tuple(
+                np.stack([np.asarray(s[i]) for s in samples]) for i in range(n_fields)
+            )
+
+    return stacked
 
 
 def cache(reader: Reader) -> Reader:

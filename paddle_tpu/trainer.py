@@ -198,7 +198,9 @@ class Trainer:
         # metadata so resume skips the completed epoch
         if self.global_step == self._last_saved_step:
             if not step:
-                self._update_latest_meta({"next_epoch": self.epoch + 1})
+                ckpt_mod.update_meta(
+                    cfg.checkpoint_dir, {"next_epoch": self.epoch + 1}
+                )
             return
         ckpt_mod.save_checkpoint(
             cfg.checkpoint_dir,
@@ -210,19 +212,6 @@ class Trainer:
             extra_meta={"next_epoch": self.epoch + (0 if step else 1)},
         )
         self._last_saved_step = self.global_step
-
-    def _update_latest_meta(self, updates: dict):
-        import json
-
-        latest = ckpt_mod.latest_checkpoint(self.checkpoint_cfg.checkpoint_dir)
-        if latest is None:
-            return
-        meta_path = os.path.join(latest, "checkpoint.json")
-        with open(meta_path) as f:
-            meta = json.load(f)
-        meta.update(updates)
-        with open(meta_path, "w") as f:
-            json.dump(meta, f, indent=1)
 
     # -- eval / predict -----------------------------------------------------
     def test(self, reader: Callable[[], Iterable[Tuple]], loss_index: int = 0):

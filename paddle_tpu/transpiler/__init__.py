@@ -1,0 +1,43 @@
+"""Program-to-program rewrites, TPU-style.
+
+Reference: ``python/paddle/fluid/transpiler/`` — DistributeTranspiler
+(``distribute_transpiler.py:142``), memory_optimize
+(``memory_optimization_transpiler.py:384``), InferenceTranspiler
+(``inference_transpiler.py``) — plus ``paddle/contrib/float16/
+float16_transpiler.py``.
+
+TPU-native: the rewrites operate on (a) traced functions — rematerialization
+policies wrap the model fn before jit; (b) parameter pytrees — BN folding and
+dtype conversion transform the weights; (c) process topology — the
+distributed transpiler wires the multi-host mesh. There is no mutable
+ProgramDesc to rewrite; XLA already does liveness, in-place reuse, and
+fusion (the bulk of memory_optimize and InferenceTranspiler).
+"""
+
+from paddle_tpu.transpiler import amp  # noqa: F401
+from paddle_tpu.transpiler import memory  # noqa: F401
+from paddle_tpu.transpiler import inference  # noqa: F401
+from paddle_tpu.transpiler import distributed  # noqa: F401
+from paddle_tpu.transpiler.amp import (  # noqa: F401
+    DynamicLossScale,
+    amp_minimize,
+    cast_params,
+)
+from paddle_tpu.transpiler.distributed import DistributeTranspiler  # noqa: F401
+from paddle_tpu.transpiler.inference import inference_optimize, fuse_batch_norm  # noqa: F401
+from paddle_tpu.transpiler.memory import memory_optimize, release_memory  # noqa: F401
+
+__all__ = [
+    "amp",
+    "memory",
+    "inference",
+    "distributed",
+    "DynamicLossScale",
+    "amp_minimize",
+    "cast_params",
+    "DistributeTranspiler",
+    "inference_optimize",
+    "fuse_batch_norm",
+    "memory_optimize",
+    "release_memory",
+]

@@ -1,0 +1,121 @@
+"""Inference-time optimization: BN folding and eval-mode program capture.
+
+Reference: ``python/paddle/fluid/transpiler/inference_transpiler.py`` —
+fuse batch_norm into the preceding conv/fc (its ``_fuse_bn`` rewrites the
+program and adjusts weights), plus relu/conv fusions which XLA performs
+automatically on TPU. Here the only work left is the WEIGHT transform: fold
+BN's (scale, bias, moving_mean, moving_var) into the adjacent conv kernel and
+bias; dropout stripping is ``is_train=False``; op fusion is XLA's job.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import logging as ptlog
+from paddle_tpu.framework import Model, Variables
+
+__all__ = ["fuse_batch_norm", "find_conv_bn_pairs", "inference_optimize"]
+
+
+def find_conv_bn_pairs(variables: Variables) -> List[Tuple[str, str]]:
+    """Detect (conv_scope, bn_scope) pairs by the layer naming convention:
+    a ``.../conv2d*/w`` parameter whose sibling scope ``.../batch_norm*``
+    holds scale/bias params and moving stats, with matching channel count.
+    Mirrors the pattern matching of ``inference_transpiler.py`` _fuse_bn
+    (there done on the op graph; here on the name hierarchy)."""
+    params, state = variables.params, variables.state
+    conv_scopes = {}
+    for name in params:
+        m = re.match(r"^(.*conv2d[^/]*)/w$", name)
+        if m:
+            conv_scopes[m.group(1)] = params[name]
+    bn_scopes = set()
+    for name in state:
+        m = re.match(r"^(.*batch_norm[^/]*)/moving_mean$", name)
+        if m:
+            bn_scopes.add(m.group(1))
+
+    pairs = []
+    for conv_scope, w in conv_scopes.items():
+        # sibling bn scope: same parent, batch_norm block created right after
+        parent = conv_scope.rsplit("/", 1)[0] if "/" in conv_scope else ""
+        suffix = re.search(r"_(\d+)$", conv_scope.rsplit("/", 1)[-1])
+        candidates = [
+            b
+            for b in bn_scopes
+            if (b.rsplit("/", 1)[0] if "/" in b else "") == parent
+        ]
+        if suffix:
+            candidates = [b for b in candidates if b.endswith(f"_{suffix.group(1)}")]
+        else:
+            candidates = [b for b in candidates if not re.search(r"_\d+$", b)]
+        for b in candidates:
+            if state[f"{b}/moving_mean"].shape[0] == w.shape[-1]:
+                pairs.append((conv_scope, b))
+    return pairs
+
+
+def fuse_batch_norm(
+    variables: Variables,
+    pairs: Optional[List[Tuple[str, str]]] = None,
+    epsilon: float = 1e-5,
+) -> Variables:
+    """Fold BN into conv weights: ``w' = w * gamma/sqrt(var+eps)`` per output
+    channel, ``b' = beta - gamma*mean/sqrt(var+eps)`` (+ folded old bias).
+    BN scale/bias become identity (1, 0) so the SAME program computes the
+    fused result — the reference rewrites the op list instead
+    (``inference_transpiler.py`` _fuse_bn); with XLA the arithmetic
+    identity-BN folds away at compile time, so only the weights need
+    transforming."""
+    params = dict(variables.params)
+    state = dict(variables.state)
+    pairs = pairs if pairs is not None else find_conv_bn_pairs(variables)
+    for conv_scope, bn_scope in pairs:
+        w_name = f"{conv_scope}/w"
+        gamma = params[f"{bn_scope}/scale"]
+        beta = params[f"{bn_scope}/bias"]
+        mean = state[f"{bn_scope}/moving_mean"]
+        var = state[f"{bn_scope}/moving_variance"]
+        inv_std = 1.0 / jnp.sqrt(var + epsilon)
+        factor = gamma * inv_std  # [C_out]
+        params[w_name] = params[w_name] * factor  # HWIO: broadcast over C_out
+        b_name = f"{conv_scope}/b"
+        old_b = params.get(b_name, jnp.zeros_like(beta))
+        fused_b = (old_b - mean) * factor + beta
+        # the fused bias lands in the conv bias if one exists, else in the
+        # (now otherwise-identity) BN bias; BN becomes a no-op either way
+        if b_name in params:
+            params[b_name] = fused_b
+            params[f"{bn_scope}/bias"] = jnp.zeros_like(beta)
+        else:
+            params[f"{bn_scope}/bias"] = fused_b
+        params[f"{bn_scope}/scale"] = jnp.ones_like(gamma)
+        state[f"{bn_scope}/moving_mean"] = jnp.zeros_like(mean)
+        # var + epsilon must equal exactly 1 so the residual 1/sqrt is identity
+        state[f"{bn_scope}/moving_variance"] = jnp.full_like(var, 1.0 - epsilon)
+    ptlog.vlog(1, "fuse_batch_norm folded %d conv+bn pairs", len(pairs))
+    return Variables(params=params, state=state)
+
+
+def inference_optimize(
+    model: Model,
+    variables: Variables,
+    fuse_bn: bool = True,
+    epsilon: float = 1e-5,
+):
+    """Produce (predict_fn, optimized_variables) for deployment: eval mode
+    (dropout stripped, BN uses moving stats), BN folded into conv weights.
+    The ``program.inference_optimize()`` + InferenceTranspiler pipeline of
+    the reference collapsed into a weight transform + is_train=False trace."""
+    opt_vars = fuse_batch_norm(variables, epsilon=epsilon) if fuse_bn else variables
+
+    def predict_fn(params_state: Variables, *batch):
+        out, _ = model.apply(params_state, *batch, is_train=False)
+        return out
+
+    return predict_fn, opt_vars

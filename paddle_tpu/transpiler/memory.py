@@ -1,0 +1,107 @@
+"""Memory optimization: rematerialization policies.
+
+Reference: ``python/paddle/fluid/transpiler/memory_optimization_transpiler.py``
+(``memory_optimize`` at :384 — CFG liveness + in-place var reuse;
+``release_memory`` inserts delete_var ops). On TPU, XLA buffer assignment
+already performs liveness analysis and in-place reuse, and the Executor's
+eager GC has no analogue (no per-op scope). What remains profitable is
+trading FLOPs for HBM via rematerialization — ``jax.checkpoint`` — which
+subsumes the reference's var-reuse pass for activation memory.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+
+__all__ = ["memory_optimize", "release_memory", "POLICIES"]
+
+# named remat policies (jax.checkpoint policies): what to KEEP (not recompute)
+POLICIES = {
+    # keep nothing: recompute everything in backward — min memory, max FLOPs
+    "full_remat": None,
+    # keep matmul/conv outputs (cheap to store, expensive to recompute):
+    # the usual sweet spot for transformer blocks
+    "save_dots": jax.checkpoint_policies.dots_saveable,
+    "save_dots_with_no_batch_dims": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+}
+# To keep only activations tagged with jax.ad_checkpoint.checkpoint_name,
+# pass the policy callable directly:
+# memory_optimize(m, policy=jax.checkpoint_policies.save_only_these_names("x"))
+
+
+def memory_optimize(
+    fn_or_model,
+    policy: Union[str, Callable, None] = "full_remat",
+    prevent_cse: bool = True,
+):
+    """Wrap a traced function (or a Model's apply) in ``jax.checkpoint``
+    with a named policy — the ``fluid.memory_optimize(program)`` API shape,
+    re-targeted at activation rematerialization.
+
+    Apply to the loss/model function BEFORE jit: under ``jax.grad`` the
+    wrapped region's activations are recomputed in the backward pass instead
+    of being kept live, the TPU replacement for the reference's var-reuse
+    pass (its buffers are already reused by XLA).
+    """
+    if isinstance(policy, str):
+        if policy not in POLICIES:
+            raise KeyError(f"unknown remat policy {policy!r}; known: {sorted(POLICIES)}")
+        policy = POLICIES[policy]
+
+    def wrap(fn: Callable) -> Callable:
+        return jax.checkpoint(fn, policy=policy, prevent_cse=prevent_cse)
+
+    from paddle_tpu.framework import Model
+
+    if isinstance(fn_or_model, Model):
+        return _RematModel(fn_or_model, policy, prevent_cse)
+    return wrap(fn_or_model)
+
+
+class _RematModel:
+    """Model wrapper whose apply() runs under jax.checkpoint.
+
+    The checkpoint boundary must see params/state as EXPLICIT arguments —
+    wrapping the raw layer fn would capture them via the framework's
+    thread-local frame, and closed-over tracers don't get gradients through
+    a remat boundary."""
+
+    def __init__(self, inner, policy, prevent_cse: bool):
+        self._inner = inner
+        self._policy = policy
+        self._prevent_cse = prevent_cse
+        self.name = inner.name + "_remat"
+
+    @property
+    def param_info(self):
+        return self._inner.param_info
+
+    def init(self, rng=None, *args, **kwargs):
+        return self._inner.init(rng, *args, **kwargs)
+
+    def apply(self, variables, *args, rng=None, is_train: bool = False, **kwargs):
+        from paddle_tpu.framework import Variables
+
+        if isinstance(variables, Variables):
+            params, state = variables.params, variables.state
+        elif isinstance(variables, tuple) and len(variables) == 2:
+            params, state = variables
+        else:
+            params, state = variables, {}
+
+        def fn(p, s, r, *a):
+            return self._inner.apply(
+                Variables(p, s), *a, rng=r, is_train=is_train, **kwargs
+            )
+
+        wrapped = jax.checkpoint(fn, policy=self._policy, prevent_cse=self._prevent_cse)
+        return wrapped(params, state, rng, *args)
+
+
+def release_memory(*_args, **_kwargs) -> None:
+    """No-op (API parity with ``fluid.release_memory``): the reference
+    inserted delete_var ops to free dead tensors mid-program; XLA frees
+    buffers at their last use automatically."""
+    return None

@@ -1,0 +1,146 @@
+"""Dataset reader + benchmark CLI tests (reference analogues:
+python/paddle/dataset/tests/*, benchmark/fluid/fluid_benchmark.py driver)."""
+
+import numpy as np
+
+from paddle_tpu import dataset, reader
+from paddle_tpu.benchmark import main as bench_main, parse_args
+
+
+def test_uci_housing_shapes():
+    first = next(iter(dataset.uci_housing.train()()))
+    x, y = first
+    assert x.shape == (13,) and x.dtype == np.float32
+    assert y.shape == (1,)
+    assert len(list(dataset.uci_housing.test()())) == 102
+
+
+def test_mnist_reader_and_batching():
+    r = reader.stack_batch(dataset.mnist.train(), batch_size=32)
+    imgs, labels = next(iter(r()))
+    assert imgs.shape == (32, 784)
+    assert imgs.dtype == np.float32
+    assert labels.shape == (32,)
+    assert float(imgs.min()) >= -1.0 and float(imgs.max()) <= 1.0
+    assert 0 <= int(labels.min()) and int(labels.max()) < 10
+
+
+def test_mnist_is_deterministic():
+    a = [lbl for _, lbl in dataset.mnist.test()()][:20]
+    b = [lbl for _, lbl in dataset.mnist.test()()][:20]
+    assert a == b
+
+
+def test_cifar_variants():
+    img, lbl = next(iter(dataset.cifar.train10()()))
+    assert img.shape == (3072,) and 0 <= lbl < 10
+    img, lbl = next(iter(dataset.cifar.train100()()))
+    assert 0 <= lbl < 100
+
+
+def test_imdb_and_worddict():
+    d = dataset.imdb.word_dict()
+    assert len(d) == 5149
+    seq, lbl = next(iter(dataset.imdb.train(d)()))
+    assert isinstance(seq, list) and len(seq) >= 20
+    assert lbl in (0, 1)
+    assert max(seq) < len(d)
+
+
+def test_imikolov_ngrams():
+    grams = list(dataset.imikolov.train(n=5)())[:10]
+    assert all(len(g) == 5 for g in grams)
+    # sliding window: consecutive grams overlap by 4
+    assert grams[0][1:] == grams[1][:4]
+
+
+def test_movielens_fields():
+    ex = next(iter(dataset.movielens.train()()))
+    user, gender, age, job, movie, cats, title, score = ex
+    assert 1 <= user <= dataset.movielens.max_user_id()
+    assert 1 <= movie <= dataset.movielens.max_movie_id()
+    assert isinstance(cats, list) and isinstance(title, list)
+    assert 1.0 <= score <= 5.0
+
+
+def test_wmt16_alignment():
+    src, trg_in, trg_next = next(iter(dataset.wmt16.train(100, 100)()))
+    assert trg_in[0] == dataset.wmt16.BOS
+    assert trg_next[-1] == dataset.wmt16.EOS
+    assert trg_in[1:] == trg_next[:-1]
+    assert max(src) < 100
+
+
+def test_conll05():
+    ex = next(iter(dataset.conll05.test()()))
+    words = ex[0]
+    assert len(ex) == 9
+    assert all(len(f) == len(words) for f in ex[1:])
+    emb = dataset.conll05.get_embedding()
+    assert emb.shape == (dataset.conll05.word_dict_len, 32)
+
+
+def test_cached_npz_roundtrip(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import common
+
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    d = tmp_path / "uci_housing"
+    d.mkdir()
+    x = np.ones((4, 13), np.float32)
+    y = np.full((4, 1), 7.0, np.float32)
+    np.savez(d / "train.npz", x=x, y=y)
+    rows = list(dataset.uci_housing.train()())
+    assert len(rows) == 4
+    np.testing.assert_allclose(rows[0][1], [7.0])
+
+
+def test_benchmark_cli_mnist():
+    result = bench_main(
+        [
+            "--model", "mnist", "--batch_size", "16", "--iterations", "3",
+            "--skip_batch_num", "1", "--pass_num", "1", "--json", "--no_random",
+        ]
+    )
+    assert result["examples_per_sec"] > 0
+    assert np.isfinite(result["last_loss"])
+
+
+def test_benchmark_cli_parallel_chips():
+    result = bench_main(
+        [
+            "--model", "mnist", "--batch_size", "16", "--iterations", "2",
+            "--skip_batch_num", "1", "--chips", "8", "--no_random",
+        ]
+    )
+    assert result["chips"] == 8
+    assert np.isfinite(result["last_loss"])
+
+
+def test_benchmark_args_defaults():
+    args = parse_args([])
+    assert args.model == "resnet"
+    assert args.skip_batch_num == 5
+    assert args.iterations == 80
+
+
+def test_benchmark_zero_skip_and_infer_only():
+    # skip_batch_num=0 must not crash (one warmup is forced for compile)
+    result = bench_main(
+        ["--model", "mnist", "--batch_size", "8", "--iterations", "2",
+         "--skip_batch_num", "0", "--no_random"]
+    )
+    assert np.isfinite(result["last_loss"])
+    # infer_only on the multi-chip path runs eval, not training
+    result = bench_main(
+        ["--model", "mnist", "--batch_size", "16", "--iterations", "2",
+         "--skip_batch_num", "1", "--chips", "8", "--infer_only", "--no_random"]
+    )
+    assert np.isfinite(result["last_loss"])
+
+
+def test_benchmark_real_data_mnist():
+    result = bench_main(
+        ["--model", "mnist", "--batch_size", "16", "--iterations", "2",
+         "--skip_batch_num", "1", "--use_real_data", "--no_random"]
+    )
+    assert np.isfinite(result["last_loss"])

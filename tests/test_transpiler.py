@@ -1,0 +1,181 @@
+"""Transpiler tests (reference analogues: test_dist_transpiler.py's
+pure-rewrite assertions, test_memory_optimization_transpiler.py,
+test_inference_transpiler — here as weight-transform + wrapper checks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.framework import Variables
+from paddle_tpu.transpiler import (
+    DistributeTranspiler,
+    DynamicLossScale,
+    amp_minimize,
+    cast_params,
+    fuse_batch_norm,
+    inference_optimize,
+    memory_optimize,
+    release_memory,
+)
+from paddle_tpu.transpiler.distributed import parse_cluster_env
+from paddle_tpu.transpiler.inference import find_conv_bn_pairs
+
+
+# ---------------------------------------------------------------------- amp
+def _mlp():
+    def net(x, y):
+        h = pt.layers.fc(x, size=16, act="relu")
+        pred = pt.layers.fc(h, size=1)
+        return jnp.mean(pt.ops.nn.square_error_cost(pred, y))
+
+    return pt.build(net)
+
+
+def test_amp_minimize_bf16_compute(rng):
+    model = _mlp()
+    x = jnp.asarray(rng.randn(8, 4).astype(np.float32))
+    y = jnp.asarray(rng.randn(8, 1).astype(np.float32))
+    variables = model.init(0, x, y)
+    opt = pt.optimizer.Adam(learning_rate=0.01)
+    opt_state = opt.create_state(variables.params)
+    step = jax.jit(amp_minimize(opt, model, compute_dtype="bfloat16"))
+    losses = []
+    v, o, ls = variables, opt_state, None
+    for _ in range(10):
+        out = step(v, o, ls, x, y)
+        v, o = out.variables, out.opt_state
+        losses.append(float(out.loss))
+    assert losses[-1] < losses[0]
+    # master weights stay fp32
+    assert v.params["fc/w"].dtype == jnp.float32
+
+
+def test_amp_dynamic_loss_scaling_skips_overflow(rng):
+    model = _mlp()
+    x = jnp.asarray(rng.randn(4, 4).astype(np.float32))
+    y = jnp.asarray(rng.randn(4, 1).astype(np.float32))
+    variables = model.init(0, x, y)
+    opt = pt.optimizer.SGD(learning_rate=0.1)
+    opt_state = opt.create_state(variables.params)
+    scale = DynamicLossScale.create(initial=2.0 ** 15)
+    step = jax.jit(amp_minimize(opt, model, use_loss_scaling=True))
+    out = step(variables, opt_state, scale, x, y)
+    assert bool(out.grads_finite)
+    assert float(out.loss_scale.scale) == 2.0 ** 15  # unchanged below interval
+
+    # poison the input -> non-finite grads -> update skipped, scale halved
+    bad_x = x.at[0, 0].set(jnp.inf)
+    out2 = step(variables, opt_state, scale, bad_x, y)
+    assert not bool(out2.grads_finite)
+    np.testing.assert_allclose(
+        np.asarray(out2.variables.params["fc/w"]),
+        np.asarray(variables.params["fc/w"]),
+    )
+    assert float(out2.loss_scale.scale) == 2.0 ** 14
+
+
+def test_cast_params():
+    tree = {"w": jnp.ones((2, 2), jnp.float32), "i": jnp.ones((2,), jnp.int32)}
+    out = cast_params(tree, "bfloat16")
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["i"].dtype == jnp.int32  # non-float untouched
+
+
+# ------------------------------------------------------------------- memory
+def test_memory_optimize_preserves_values_and_grads(rng):
+    model = _mlp()
+    x = jnp.asarray(rng.randn(8, 4).astype(np.float32))
+    y = jnp.asarray(rng.randn(8, 1).astype(np.float32))
+    variables = model.init(0, x, y)
+
+    remat_model = memory_optimize(model, policy="full_remat")
+    (loss1, _), (loss2, _) = (
+        model.apply(variables, x, y),
+        remat_model.apply(variables, x, y),
+    )
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-6)
+
+    def loss_of(m):
+        return lambda p: m.apply(Variables(p, variables.state), x, y)[0]
+
+    g1 = jax.grad(loss_of(model))(variables.params)
+    g2 = jax.grad(loss_of(remat_model))(variables.params)
+    for k in g1:
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]), rtol=1e-5)
+
+    assert release_memory() is None
+    with pytest.raises(KeyError):
+        memory_optimize(model, policy="nonexistent")
+
+
+# ---------------------------------------------------------------- inference
+def _conv_bn_model():
+    def net(x):
+        h = pt.layers.conv2d(x, num_filters=8, filter_size=3, padding=1, bias_attr=False)
+        h = pt.layers.batch_norm(h, act="relu")
+        h = pt.layers.conv2d(h, num_filters=4, filter_size=3, padding=1)
+        h = pt.layers.batch_norm(h)
+        return h
+
+    return pt.build(net)
+
+
+def test_fuse_batch_norm_preserves_inference_output(rng):
+    model = _conv_bn_model()
+    x = jnp.asarray(rng.randn(2, 8, 8, 3).astype(np.float32))
+    variables = model.init(0, x)
+    # make BN stats non-trivial
+    state = {
+        k: jnp.asarray(rng.rand(*v.shape).astype(np.float32) + 0.5)
+        for k, v in variables.state.items()
+    }
+    params = dict(variables.params)
+    params = {
+        k: jnp.asarray(rng.randn(*v.shape).astype(np.float32) * 0.5 + (1.0 if k.endswith("scale") else 0.0))
+        for k, v in params.items()
+    }
+    variables = Variables(params, state)
+
+    pairs = find_conv_bn_pairs(variables)
+    assert len(pairs) == 2
+
+    predict, fused_vars = inference_optimize(model, variables)
+    out_ref, _ = model.apply(variables, x, is_train=False)
+    out_fused = predict(fused_vars, x)
+    np.testing.assert_allclose(
+        np.asarray(out_ref), np.asarray(out_fused), rtol=2e-4, atol=2e-5
+    )
+    # bn neutralized
+    for _, bn in pairs:
+        np.testing.assert_allclose(np.asarray(fused_vars.params[f"{bn}/scale"]), 1.0)
+
+
+# -------------------------------------------------------------- distributed
+def test_parse_cluster_env():
+    role = parse_cluster_env(
+        {
+            "PADDLE_TRAINER_ID": "2",
+            "PADDLE_TRAINERS": "4",
+            "PADDLE_TRAINER_ENDPOINTS": "10.0.0.1:7164,10.0.0.2:7164",
+        }
+    )
+    assert role.trainer_id == 2
+    assert role.num_trainers == 4
+    assert role.coordinator == "10.0.0.1:7164"
+    assert not role.is_chief
+
+    with pytest.raises(Exception):
+        parse_cluster_env({"PADDLE_TRAINING_ROLE": "PSERVER"})
+
+
+def test_distribute_transpiler_single_process_mesh():
+    t = DistributeTranspiler()
+    t.transpile(trainer_id=0, trainers=1)
+    mesh = t.trainer_mesh(model_axis=2)
+    assert mesh.shape["data"] * mesh.shape["model"] == 8
+    assert mesh.shape["model"] == 2
+    assert t.get_trainer_program() is None
+    with pytest.raises(NotImplementedError):
+        t.get_pserver_program()
