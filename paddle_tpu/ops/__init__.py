@@ -21,6 +21,7 @@ from paddle_tpu.ops import (  # noqa: F401
     rnn,
     sequence,
     attention,
+    ring_attention,
     control_flow,
     losses,
     detection,
@@ -47,6 +48,7 @@ __all__ = (
         "rnn",
         "sequence",
         "attention",
+        "ring_attention",
         "control_flow",
         "losses",
         "detection",

@@ -1,0 +1,120 @@
+"""Ring attention: exact attention over a sequence-sharded ICI ring.
+
+No reference counterpart (SURVEY.md §5.7: the reference has no context/
+sequence parallelism; its long-sequence story is LoD + DynamicRNN). This is
+the TPU-native long-context path: Q/K/V are sharded over the ``seq`` mesh
+axis; each device computes attention of its local Q block against one K/V
+block at a time while K/V blocks rotate around the ring via ``ppermute``
+(Liu et al., Ring Attention; blockwise online-softmax accumulation à la
+FlashAttention so nothing materializes the full [T, T] score matrix).
+
+Causal masking uses global position offsets derived from each block's ring
+rank, skip-computing is left to XLA (all blocks are computed; masked ones
+contribute -inf scores — static shapes beat dynamic skipping on TPU).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.core.dtypes import NEG_INF
+from paddle_tpu.parallel import mesh as mesh_mod
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+
+def _block_attn(q, k, v, bias):
+    """Scores + online-softmax partials for one (Q-block, KV-block) pair.
+    q: [B, H, Tq, d]; k/v: [B, H, Tk, d]; bias broadcastable to
+    [B, H, Tq, Tk]. Returns (m, l, o): running max, denominator, numerator."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    scores = scores + bias
+    m = jnp.max(scores, axis=-1)  # [B, H, Tq]
+    p = jnp.exp(scores - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return m, l, o
+
+
+def _merge(m1, l1, o1, m2, l2, o2):
+    """Merge two online-softmax partial results."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    l = l1 * a1 + l2 * a2
+    o = o1 * a1[..., None] + o2 * a2[..., None]
+    return m, l, o
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis: str,
+    causal: bool = False,
+) -> jax.Array:
+    """Per-device body (call inside shard_map/pjit with ``axis`` a mesh axis
+    over which the SEQUENCE dim is sharded). q/k/v: [B, H, T_local, d].
+    Returns [B, H, T_local, d] — exact softmax(QK^T)V over the GLOBAL
+    sequence."""
+    n_dev = jax.lax.psum(1, axis)
+    rank = jax.lax.axis_index(axis)
+    t_local = q.shape[2]
+    dtype = q.dtype
+    q32, k0, v0 = q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
+    perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+
+    q_pos = rank * t_local + jnp.arange(t_local)  # global positions of Q rows
+
+    def block_bias(i):
+        # kv block held at ring step i started at rank (rank - i) mod n_dev
+        kv_rank = (rank - i) % n_dev
+        k_pos = kv_rank * t_local + jnp.arange(t_local)
+        if causal:
+            return jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, NEG_INF)[None, None]
+        return jnp.zeros((1, 1, t_local, t_local), jnp.float32)
+
+    # step 0 on the local block, then permute-then-compute for the remaining
+    # n_dev-1 ring steps — no wasted final shift
+    m, l, o = _block_attn(q32, k0, v0, block_bias(0))
+
+    def step(carry, i):
+        m, l, o, kk, vv = carry
+        kk = jax.lax.ppermute(kk, axis, perm)
+        vv = jax.lax.ppermute(vv, axis, perm)
+        bm, bl, bo = _block_attn(q32, kk, vv, block_bias(i))
+        m, l, o = _merge(m, l, o, bm, bl, bo)
+        return (m, l, o, kk, vv), None
+
+    (m, l, o, _, _), _ = jax.lax.scan(
+        step, (m, l, o, k0, v0), jnp.arange(1, n_dev)
+    )
+    out = o / jnp.maximum(l, 1e-20)[..., None]
+    return out.astype(dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = mesh_mod.SEQ_AXIS,
+    causal: bool = False,
+) -> jax.Array:
+    """Convenience wrapper: q/k/v are GLOBAL [B, H, T, d] arrays; shards the
+    T dim over ``axis``, runs :func:`ring_attention` under shard_map, and
+    returns the global result."""
+    spec = P(None, None, axis, None)
+    return shard_map(
+        partial(ring_attention, axis=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

@@ -20,6 +20,8 @@ from paddle_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
     SEQ_AXIS,
+    PIPE_AXIS,
+    EXPERT_AXIS,
 )
 from paddle_tpu.parallel import collective
 from paddle_tpu.parallel.sharding import (
@@ -29,6 +31,12 @@ from paddle_tpu.parallel.sharding import (
     shard_variables,
 )
 from paddle_tpu.parallel.data_parallel import DataParallel
+from paddle_tpu.parallel.pipeline import (
+    pipeline_apply,
+    stack_stage_params,
+    split_microbatches,
+)
+from paddle_tpu.parallel.moe import moe_ffn, switch_gate, MoEOutput
 
 __all__ = [
     "make_mesh",
@@ -37,10 +45,18 @@ __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
     "SEQ_AXIS",
+    "PIPE_AXIS",
+    "EXPERT_AXIS",
     "collective",
     "param_shardings",
     "replicated",
     "batch_sharding",
     "shard_variables",
     "DataParallel",
+    "pipeline_apply",
+    "stack_stage_params",
+    "split_microbatches",
+    "moe_ffn",
+    "switch_gate",
+    "MoEOutput",
 ]

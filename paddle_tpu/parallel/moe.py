@@ -1,0 +1,126 @@
+"""Mixture-of-Experts with expert parallelism over an ``expert`` mesh axis.
+
+No reference counterpart (SURVEY.md §2.4: parallelism in the reference is
+DP + parameter server only) — this is a post-parity TPU extension using the
+GShard/Switch dense-dispatch pattern: top-k gating builds a
+[tokens, experts, capacity] dispatch tensor, expert FFNs run batched with
+their parameters sharded along the ``expert`` axis, and the two dispatch
+einsums become all_to_all exchanges when compiled over the mesh.
+
+Everything is fixed-shape: per-expert token capacity bounds the routed
+tokens; overflow tokens are dropped (standard Switch behavior) and the
+auxiliary load-balancing loss pushes the router toward uniform occupancy.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.framework import ParamAttr, create_parameter, name_scope
+from paddle_tpu.parallel import mesh as mesh_mod
+
+__all__ = ["switch_gate", "moe_ffn", "MoEOutput"]
+
+
+class MoEOutput(NamedTuple):
+    output: jax.Array
+    aux_loss: jax.Array  # load-balancing loss (add to the model loss)
+
+
+def switch_gate(
+    logits: jax.Array, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-1 (Switch) routing. ``logits``: [N, E]. Returns
+    ``(dispatch [N, E, C] bool, combine [N, E, C] float, aux_loss)``.
+
+    Position within each expert's buffer is the token's rank among tokens
+    routed to that expert; ranks >= capacity are dropped.
+    """
+    N, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # [N]
+    expert_mask = jax.nn.one_hot(expert_idx, E, dtype=probs.dtype)  # [N, E]
+
+    # load-balancing aux loss (Switch eq. 4): E * sum_e f_e * P_e
+    density = jnp.mean(expert_mask, axis=0)  # fraction routed per expert
+    density_proxy = jnp.mean(probs, axis=0)
+    aux_loss = E * jnp.sum(density * density_proxy)
+
+    # position of each token in its expert's buffer — integer cumsum:
+    # a float cumsum stops representing counts exactly (e.g. bf16 past 256)
+    # and colliding buffer positions silently merge tokens
+    mask_i = expert_mask.astype(jnp.int32)
+    pos_in_expert = (jnp.cumsum(mask_i, axis=0) - 1) * mask_i  # [N, E]
+    pos = jnp.sum(pos_in_expert, axis=-1).astype(jnp.int32)  # [N]
+    keep = pos < capacity
+    gate = jnp.max(probs * expert_mask, axis=-1) * keep  # [N]
+
+    dispatch = (
+        expert_mask.astype(bool)
+        & keep[:, None]
+    )[..., None] & (jax.nn.one_hot(pos, capacity, dtype=jnp.int32).astype(bool))[:, None, :]
+    combine = gate[:, None, None] * dispatch.astype(probs.dtype)
+    return dispatch, combine, aux_loss
+
+
+def moe_ffn(
+    x: jax.Array,
+    num_experts: int,
+    d_ff: int,
+    capacity_factor: float = 1.25,
+    act=jax.nn.relu,
+    name: Optional[str] = None,
+) -> MoEOutput:
+    """Expert-parallel FFN layer: ``x`` [B, T, D] (or [N, D]) through
+    ``num_experts`` independent two-layer FFNs selected by a Switch router.
+
+    Per-expert weights are created as [E, D, d_ff] / [E, d_ff, D] with
+    sharding ('expert', None, None) — under a mesh with an ``expert`` axis
+    the dispatch einsums compile to all_to_all over ICI.
+    """
+    squeeze = x.ndim == 2
+    if squeeze:
+        x = x[None]
+    B, T, D = x.shape
+    N = B * T
+    tokens = x.reshape(N, D)
+    capacity = max(1, int(math.ceil(N / num_experts * capacity_factor)))
+
+    with name_scope(name or "moe"):
+        wg = create_parameter([D, num_experts], x.dtype, name="w_gate")
+        w_in = create_parameter(
+            [num_experts, D, d_ff], x.dtype, name="w_in",
+            attr=ParamAttr(sharding=(mesh_mod.EXPERT_AXIS, None, None)),
+        )
+        b_in = create_parameter(
+            [num_experts, d_ff], x.dtype, name="b_in",
+            attr=ParamAttr(sharding=(mesh_mod.EXPERT_AXIS, None)),
+        )
+        w_out = create_parameter(
+            [num_experts, d_ff, D], x.dtype, name="w_out",
+            attr=ParamAttr(sharding=(mesh_mod.EXPERT_AXIS, None, None)),
+        )
+        b_out = create_parameter(
+            [num_experts, D], x.dtype, name="b_out",
+            attr=ParamAttr(sharding=(mesh_mod.EXPERT_AXIS, None)),
+        )
+
+    logits = jnp.matmul(tokens, wg, preferred_element_type=jnp.float32)
+    dispatch, combine, aux = switch_gate(logits.astype(jnp.float32), capacity)
+
+    # dispatch: [N, E, C] × [N, D] → expert inputs [E, C, D] (all_to_all #1)
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), tokens)
+    h = act(jnp.einsum("ecd,edf->ecf", expert_in, w_in) + b_in[:, None, :])
+    expert_out = jnp.einsum("ecf,efd->ecd", h, w_out) + b_out[:, None, :]
+    # combine: [N, E, C] × [E, C, D] → [N, D] (all_to_all #2 + weighted sum)
+    out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), expert_out)
+
+    out = out.reshape(B, T, D)
+    if squeeze:
+        out = out[0]
+    return MoEOutput(output=out, aux_loss=aux.astype(jnp.float32))

@@ -1,0 +1,128 @@
+"""Pipeline parallelism: GPipe-style microbatching over a ``pipe`` mesh axis.
+
+No reference counterpart — the reference's only cross-device strategies are
+data parallelism and the parameter server (SURVEY.md §2.4); pipeline
+parallelism is a post-parity TPU extension. Design: each device along the
+``pipe`` axis owns one stage's parameters; microbatch activations flow
+stage-to-stage with ``ppermute`` over the ICI ring inside a ``shard_map``,
+the standard TPU pipelining pattern (cf. the scaling-book recipe: shift
+buffers with collective-permute, overlap bubbles with n_micro >> n_stages).
+
+The whole schedule is one ``lax.scan`` — XLA overlaps the ppermute with the
+next step's stage compute where possible. Differentiable end-to-end: the
+transpose of ppermute is the reverse permute, so ``jax.grad`` yields the
+1F1B-equivalent backward schedule automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.parallel import mesh as mesh_mod
+
+__all__ = ["pipeline_apply", "stack_stage_params", "split_microbatches"]
+
+
+def stack_stage_params(stage_params: Sequence):
+    """Stack per-stage param pytrees along a new leading 'stage' axis:
+    the stacked tree is sharded P('pipe', ...) so each pipe device holds
+    exactly its own stage's weights."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *stage_params)
+
+
+def split_microbatches(x: jax.Array, n_micro: int) -> jax.Array:
+    """[B, ...] → [n_micro, B/n_micro, ...]."""
+    enforce(
+        x.shape[0] % n_micro == 0,
+        f"batch {x.shape[0]} not divisible into {n_micro} microbatches",
+    )
+    return x.reshape((n_micro, x.shape[0] // n_micro) + x.shape[1:])
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stacked_params,
+    microbatches: jax.Array,
+    mesh: Mesh,
+    axis: str = mesh_mod.PIPE_AXIS,
+):
+    """Run ``y_mb = stage_{S-1}(...stage_0(x_mb))`` for each microbatch with
+    stages laid out along the ``axis`` mesh dimension.
+
+    ``stage_fn(params_one_stage, x) -> y`` must be shape-preserving across
+    stages (equal widths — pad stages to a common width otherwise, the usual
+    pipeline constraint). ``stacked_params`` leaves are [S, ...] (see
+    :func:`stack_stage_params`); ``microbatches`` is [n_micro, mb, ...].
+    Returns [n_micro, mb, ...] outputs.
+    """
+    n_stages = mesh.shape[axis]
+    n_micro = microbatches.shape[0]
+    n_steps = n_micro + n_stages - 1
+    fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def spmd(params, mbs):
+        # per-device view: params leaves [1, ...] (own stage), mbs [n_micro, mb, ...]
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        stage = jax.lax.axis_index(axis)
+        mb_shape = mbs.shape[1:]
+
+        def step(carry, t):
+            cur, outs = carry
+            # stage 0 ingests microbatch t (others use the shifted-in value)
+            feed = mbs[jnp.minimum(t, n_micro - 1)]
+            x = jnp.where(stage == 0, feed, cur)
+            y = stage_fn(params, x)
+            # the last stage completes microbatch t-(S-1) at step t
+            done_idx = t - (n_stages - 1)
+            is_done = (stage == n_stages - 1) & (done_idx >= 0)
+            outs = jax.lax.cond(
+                is_done,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, y, jnp.maximum(done_idx, 0), 0
+                ),
+                lambda o: o,
+                outs,
+            )
+            nxt = jax.lax.ppermute(y, axis, fwd)
+            return (nxt, outs), None
+
+        init = (
+            jnp.zeros(mb_shape, microbatches.dtype),
+            jnp.zeros((n_micro,) + mb_shape, microbatches.dtype),
+        )
+        (_, outs), _ = jax.lax.scan(step, init, jnp.arange(n_steps))
+        # outs is populated only on the last stage; psum of the masked value
+        # replicates it to every pipe rank (all other ranks contribute zeros)
+        outs = jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs))
+        return jax.lax.psum(outs, axis)
+
+    from jax import shard_map
+
+    # microbatch rows shard over the non-pipe axes (params stay replicated
+    # there): pipeline composes with data parallelism instead of every
+    # data-rank redundantly recomputing the full pipeline
+    other_axes = tuple(
+        a for a in mesh.axis_names if a != axis and mesh.shape[a] > 1
+    )
+    other_size = 1
+    for a in other_axes:
+        other_size *= mesh.shape[a]
+    enforce(
+        microbatches.shape[1] % other_size == 0,
+        f"microbatch size {microbatches.shape[1]} not divisible by the "
+        f"non-pipe mesh axes {other_axes} (size {other_size})",
+    )
+    mb_spec = P(None, other_axes if other_axes else None)
+    param_spec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    return shard_map(
+        spmd,
+        mesh=mesh,
+        in_specs=(param_spec, mb_spec),
+        out_specs=mb_spec,
+        check_vma=False,
+    )(stacked_params, microbatches)
