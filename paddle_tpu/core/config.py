@@ -30,6 +30,8 @@ class Flags:
     benchmark: bool = False
     # mixed precision: bf16 compute for matmul/conv (MXU-native)
     use_bf16_compute: bool = False
+    # route unmasked/causal attention through the Pallas flash kernel
+    use_flash_attention: bool = False
     # default seed for program-level RNG when none is given
     seed: int = 0
     # host data pipeline: prefetch depth of the device double-buffer

@@ -4,8 +4,8 @@ Reference: attention exists only as composed ops
 (``python/paddle/fluid/nets.py:332`` scaled_dot_product_attention; the
 Transformer model in ``benchmark/fluid/models/machine_translation.py``).
 TPU-native: one fused-friendly function XLA lowers well; a Pallas
-flash-attention kernel (``paddle_tpu.ops.pallas_attention``) takes over for
-long sequences.
+flash-attention kernel (``paddle_tpu.ops.pallas.flash_attention``) takes
+over for long sequences when ``flags().use_flash_attention`` is set.
 """
 
 from __future__ import annotations
@@ -38,6 +38,14 @@ def combine_heads(x: jax.Array) -> jax.Array:
     return x.transpose(0, 2, 1, 3).reshape(b, t, n * d)
 
 
+def _flash_block(t: int):
+    """Largest MXU-friendly block size dividing t (None = no fit)."""
+    for b in (128, 64, 32, 16, 8):
+        if t % b == 0:
+            return b
+    return None
+
+
 def scaled_dot_product_attention(
     q: jax.Array,
     k: jax.Array,
@@ -52,9 +60,29 @@ def scaled_dot_product_attention(
     additive mask broadcastable to [..., Tq, Tk] (0 = keep, -inf = drop).
 
     Softmax in fp32; QK^T and PV matmuls accumulate fp32 on the MXU.
+    With ``flags().use_flash_attention``, the unmasked 4-D case routes
+    through the Pallas flash kernel (``ops.pallas.flash_attention``) when
+    block tiling divides the sequence lengths.
     """
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    from paddle_tpu.core import config as _cfg
+
+    if (
+        _cfg.flags().use_flash_attention
+        and mask is None
+        and (dropout_rate == 0.0 or is_test)
+        and q.ndim == 4
+        and k.shape == v.shape
+        and q.shape[:2] == k.shape[:2]  # no MQA-style broadcast heads
+    ):
+        bq = _flash_block(q.shape[-2])
+        bk = _flash_block(k.shape[-2])
+        if bq and bk:
+            from paddle_tpu.ops.pallas import flash_attention
+
+            return flash_attention(q, k, v, sm_scale=scale, block_q=bq, block_k=bk)
     logits = jnp.matmul(q, jnp.swapaxes(k, -1, -2), preferred_element_type=jnp.float32)
     logits = logits * scale
     if mask is not None:

@@ -1,0 +1,13 @@
+"""Pallas TPU kernels — hand-written kernels for the few patterns where XLA's
+automatic fusion underperforms (SURVEY.md §7: "Pallas kernels only where XLA
+underperforms").
+
+The reference's analogue is the hand-written CUDA kernel layer
+(``paddle/fluid/operators/math/*.cu``, 108 .cu files); here almost all of
+that surface is left to XLA, and only attention-style blockwise-softmax
+fusions get custom kernels. Kernels run in interpret mode off-TPU so tests
+exercise them on the CPU mesh."""
+
+from paddle_tpu.ops.pallas.flash_attention import flash_attention  # noqa: F401
+
+__all__ = ["flash_attention"]
