@@ -1,0 +1,53 @@
+// NDArray: minimal dense float32 tensor for the native inference runtime.
+//
+// TPU-native counterpart of the reference's C++ serving stack
+// (paddle/fluid/inference/api/paddle_inference_api.h PaddlePredictor,
+// framework/tensor.h:36 Tensor): the compute path on TPU is XLA, so the
+// native runtime only needs a small CPU tensor for serving/embedding hosts
+// (reference train/demo/demo_trainer.cc use case).
+
+#pragma once
+
+#include <cassert>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <numeric>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ptnative {
+
+struct NDArray {
+  std::vector<int64_t> shape;
+  std::vector<float> data;
+
+  NDArray() = default;
+  explicit NDArray(std::vector<int64_t> s) : shape(std::move(s)) {
+    data.assign(static_cast<size_t>(numel()), 0.0f);
+  }
+
+  int64_t numel() const {
+    int64_t n = 1;
+    for (auto d : shape) n *= d;
+    return n;
+  }
+  int ndim() const { return static_cast<int>(shape.size()); }
+
+  std::vector<int64_t> strides() const {
+    std::vector<int64_t> st(shape.size());
+    int64_t acc = 1;
+    for (int i = ndim() - 1; i >= 0; --i) {
+      st[i] = acc;
+      acc *= shape[i];
+    }
+    return st;
+  }
+};
+
+inline void check(bool cond, const std::string& msg) {
+  if (!cond) throw std::runtime_error("ptnative: " + msg);
+}
+
+}  // namespace ptnative

@@ -1,0 +1,319 @@
+// Primitive implementations for the native program interpreter.
+//
+// Covers the jaxpr primitive set emitted by paddle_tpu.native.export for
+// inference programs (dense conv/matmul nets + normalization + softmax).
+// The reference analogue is the per-op CPU kernel zoo
+// (paddle/fluid/operators/*.cc REGISTER_OP_CPU_KERNEL); here one generic
+// strided implementation per primitive family suffices because serving
+// throughput on the TPU stack comes from XLA — this runtime is for
+// CPU-embedded deployment parity (inference/api + legacy/capi).
+
+#include "ops.h"
+
+#include <algorithm>
+#include <cmath>
+#include <limits>
+
+namespace ptnative {
+
+// ---------------------------------------------------------------- helpers
+
+static std::vector<int64_t> unravel(int64_t idx, const std::vector<int64_t>& shape) {
+  std::vector<int64_t> out(shape.size());
+  for (int i = static_cast<int>(shape.size()) - 1; i >= 0; --i) {
+    out[i] = idx % shape[i];
+    idx /= shape[i];
+  }
+  return out;
+}
+
+NDArray transpose(const NDArray& x, const std::vector<int64_t>& perm) {
+  check(perm.size() == x.shape.size(), "transpose perm rank mismatch");
+  NDArray out;
+  out.shape.resize(x.ndim());
+  for (int i = 0; i < x.ndim(); ++i) out.shape[i] = x.shape[perm[i]];
+  out.data.resize(x.data.size());
+  auto xs = x.strides();
+  auto os = out.strides();
+  const int nd = x.ndim();
+  for (int64_t i = 0; i < out.numel(); ++i) {
+    auto oc = unravel(i, out.shape);
+    int64_t src = 0;
+    for (int d = 0; d < nd; ++d) src += oc[d] * xs[perm[d]];
+    out.data[i] = x.data[src];
+  }
+  return out;
+}
+
+NDArray reshape(const NDArray& x, const std::vector<int64_t>& shape) {
+  NDArray out;
+  out.shape = shape;
+  check(out.numel() == x.numel(), "reshape numel mismatch");
+  out.data = x.data;
+  return out;
+}
+
+NDArray broadcast_in_dim(const NDArray& x, const std::vector<int64_t>& out_shape,
+                         const std::vector<int64_t>& bcast_dims) {
+  NDArray out(out_shape);
+  auto xs = x.strides();
+  for (int64_t i = 0; i < out.numel(); ++i) {
+    auto oc = unravel(i, out.shape);
+    int64_t src = 0;
+    for (size_t d = 0; d < bcast_dims.size(); ++d) {
+      int64_t od = bcast_dims[d];
+      int64_t c = x.shape[d] == 1 ? 0 : oc[od];
+      src += c * xs[d];
+    }
+    out.data[i] = x.data[src];
+  }
+  return out;
+}
+
+NDArray binary(const NDArray& a, const NDArray& b,
+               const std::function<float(float, float)>& f) {
+  // fast path: identical shapes
+  if (a.shape == b.shape) {
+    NDArray out(a.shape);
+    for (size_t i = 0; i < a.data.size(); ++i) out.data[i] = f(a.data[i], b.data[i]);
+    return out;
+  }
+  // lax binary eqns broadcast size-1 dims at equal rank (plus rank-0 scalars)
+  if (b.numel() == 1) {
+    NDArray out(a.shape);
+    for (size_t i = 0; i < a.data.size(); ++i) out.data[i] = f(a.data[i], b.data[0]);
+    return out;
+  }
+  if (a.numel() == 1) {
+    NDArray out(b.shape);
+    for (size_t i = 0; i < b.data.size(); ++i) out.data[i] = f(a.data[0], b.data[i]);
+    return out;
+  }
+  check(a.shape.size() == b.shape.size(), "binary op rank mismatch");
+  std::vector<int64_t> out_shape(a.shape.size());
+  for (size_t d = 0; d < a.shape.size(); ++d) {
+    check(a.shape[d] == b.shape[d] || a.shape[d] == 1 || b.shape[d] == 1,
+          "binary op incompatible shapes");
+    out_shape[d] = std::max(a.shape[d], b.shape[d]);
+  }
+  NDArray out(out_shape);
+  auto as = a.strides();
+  auto bs = b.strides();
+  for (int64_t i = 0; i < out.numel(); ++i) {
+    auto oc = unravel(i, out.shape);
+    int64_t ai = 0, bi = 0;
+    for (size_t d = 0; d < out_shape.size(); ++d) {
+      ai += (a.shape[d] == 1 ? 0 : oc[d]) * as[d];
+      bi += (b.shape[d] == 1 ? 0 : oc[d]) * bs[d];
+    }
+    out.data[i] = f(a.data[ai], b.data[bi]);
+  }
+  return out;
+}
+
+NDArray unary(const NDArray& x, const std::function<float(float)>& f) {
+  NDArray out(x.shape);
+  for (size_t i = 0; i < x.data.size(); ++i) out.data[i] = f(x.data[i]);
+  return out;
+}
+
+NDArray reduce(const NDArray& x, const std::vector<int64_t>& axes, float init,
+               const std::function<float(float, float)>& f) {
+  std::vector<bool> is_red(x.ndim(), false);
+  for (auto a : axes) is_red[a] = true;
+  std::vector<int64_t> out_shape;
+  for (int d = 0; d < x.ndim(); ++d)
+    if (!is_red[d]) out_shape.push_back(x.shape[d]);
+  if (out_shape.empty()) out_shape = {};  // scalar
+  NDArray out;
+  out.shape = out_shape;
+  out.data.assign(static_cast<size_t>(out.numel()), init);
+  auto os = out.strides();
+  for (int64_t i = 0; i < x.numel(); ++i) {
+    auto xc = unravel(i, x.shape);
+    int64_t oi = 0;
+    int k = 0;
+    for (int d = 0; d < x.ndim(); ++d) {
+      if (!is_red[d]) {
+        oi += xc[d] * os[k];
+        ++k;
+      }
+    }
+    out.data[oi] = f(out.data[oi], x.data[i]);
+  }
+  return out;
+}
+
+// dot_general with arbitrary batch/contracting dims: permute both operands to
+// [batch..., free..., contract...] and run a blocked GEMM per batch.
+NDArray dot_general(const NDArray& lhs, const NDArray& rhs,
+                    const std::vector<int64_t>& lc, const std::vector<int64_t>& rc,
+                    const std::vector<int64_t>& lb, const std::vector<int64_t>& rb) {
+  auto arrange = [](const NDArray& x, const std::vector<int64_t>& batch,
+                    const std::vector<int64_t>& contract) {
+    std::vector<bool> used(x.shape.size(), false);
+    std::vector<int64_t> perm;
+    for (auto d : batch) { perm.push_back(d); used[d] = true; }
+    for (auto d : contract) used[d] = true;
+    std::vector<int64_t> free_dims;
+    for (int d = 0; d < x.ndim(); ++d)
+      if (!used[d]) { perm.push_back(d); free_dims.push_back(d); }
+    for (auto d : contract) perm.push_back(d);
+    return std::make_pair(transpose(x, perm), free_dims);
+  };
+  auto [L, lfree] = arrange(lhs, lb, lc);
+  auto [R, rfree] = arrange(rhs, rb, rc);
+
+  int64_t B = 1;
+  for (auto d : lb) B *= lhs.shape[d];
+  int64_t K = 1;
+  for (auto d : lc) K *= lhs.shape[d];
+  int64_t M = L.numel() / (B * K);
+  int64_t N = R.numel() / (B * K);
+
+  std::vector<int64_t> out_shape;
+  for (auto d : lb) out_shape.push_back(lhs.shape[d]);
+  for (auto d : lfree) out_shape.push_back(lhs.shape[d]);
+  for (auto d : rfree) out_shape.push_back(rhs.shape[d]);
+  NDArray out;
+  out.shape = out_shape.empty() ? std::vector<int64_t>{} : out_shape;
+  out.data.assign(static_cast<size_t>(std::max<int64_t>(out.numel(), 1)), 0.0f);
+
+  // R viewed as [B, N, K]; compute out[b, m, n] = sum_k L[b,m,k] * R[b,n,k]
+  for (int64_t b = 0; b < B; ++b) {
+    const float* Lp = L.data.data() + b * M * K;
+    const float* Rp = R.data.data() + b * N * K;
+    float* Op = out.data.data() + b * M * N;
+    for (int64_t m = 0; m < M; ++m) {
+      for (int64_t n = 0; n < N; ++n) {
+        float acc = 0.0f;
+        const float* lrow = Lp + m * K;
+        const float* rrow = Rp + n * K;
+        for (int64_t k = 0; k < K; ++k) acc += lrow[k] * rrow[k];
+        Op[m * N + n] = acc;
+      }
+    }
+  }
+  return out;
+}
+
+// NHWC x HWIO -> NHWC convolution (im2col-free direct loop; groups for
+// depthwise). Matches lax.conv_general_dilated with dilations == 1.
+NDArray conv2d_nhwc(const NDArray& x, const NDArray& w,
+                    const std::vector<int64_t>& strides,
+                    const std::vector<int64_t>& pad_lo,
+                    const std::vector<int64_t>& pad_hi, int64_t groups) {
+  int64_t Nb = x.shape[0], H = x.shape[1], W = x.shape[2], C = x.shape[3];
+  int64_t KH = w.shape[0], KW = w.shape[1], CI = w.shape[2], CO = w.shape[3];
+  check(CI * groups == C, "conv channel mismatch");
+  int64_t OH = (H + pad_lo[0] + pad_hi[0] - KH) / strides[0] + 1;
+  int64_t OW = (W + pad_lo[1] + pad_hi[1] - KW) / strides[1] + 1;
+  int64_t co_per_g = CO / groups;
+  NDArray out({Nb, OH, OW, CO});
+  for (int64_t n = 0; n < Nb; ++n)
+    for (int64_t oh = 0; oh < OH; ++oh)
+      for (int64_t ow = 0; ow < OW; ++ow)
+        for (int64_t g = 0; g < groups; ++g)
+          for (int64_t oc = 0; oc < co_per_g; ++oc) {
+            float acc = 0.0f;
+            for (int64_t kh = 0; kh < KH; ++kh) {
+              int64_t ih = oh * strides[0] + kh - pad_lo[0];
+              if (ih < 0 || ih >= H) continue;
+              for (int64_t kw = 0; kw < KW; ++kw) {
+                int64_t iw = ow * strides[1] + kw - pad_lo[1];
+                if (iw < 0 || iw >= W) continue;
+                for (int64_t ci = 0; ci < CI; ++ci) {
+                  float xv = x.data[((n * H + ih) * W + iw) * C + g * CI + ci];
+                  float wv = w.data[((kh * KW + kw) * CI + ci) * CO + g * co_per_g + oc];
+                  acc += xv * wv;
+                }
+              }
+            }
+            out.data[((n * OH + oh) * OW + ow) * CO + g * co_per_g + oc] = acc;
+          }
+  return out;
+}
+
+// reduce_window over NHWC with window/strides on (H, W) only.
+NDArray reduce_window_2d(const NDArray& x, const std::vector<int64_t>& window,
+                         const std::vector<int64_t>& strides,
+                         const std::vector<int64_t>& pad_lo,
+                         const std::vector<int64_t>& pad_hi, bool is_max) {
+  int64_t Nb = x.shape[0], H = x.shape[1], W = x.shape[2], C = x.shape[3];
+  int64_t KH = window[1], KW = window[2];
+  int64_t SH = strides[1], SW = strides[2];
+  int64_t OH = (H + pad_lo[1] + pad_hi[1] - KH) / SH + 1;
+  int64_t OW = (W + pad_lo[2] + pad_hi[2] - KW) / SW + 1;
+  NDArray out({Nb, OH, OW, C});
+  float init = is_max ? -std::numeric_limits<float>::infinity() : 0.0f;
+  for (int64_t n = 0; n < Nb; ++n)
+    for (int64_t oh = 0; oh < OH; ++oh)
+      for (int64_t ow = 0; ow < OW; ++ow)
+        for (int64_t c = 0; c < C; ++c) {
+          float acc = init;
+          for (int64_t kh = 0; kh < KH; ++kh) {
+            int64_t ih = oh * SH + kh - pad_lo[1];
+            if (ih < 0 || ih >= H) continue;
+            for (int64_t kw = 0; kw < KW; ++kw) {
+              int64_t iw = ow * SW + kw - pad_lo[2];
+              if (iw < 0 || iw >= W) continue;
+              float v = x.data[((n * H + ih) * W + iw) * C + c];
+              acc = is_max ? std::max(acc, v) : acc + v;
+            }
+          }
+          out.data[((n * OH + oh) * OW + ow) * C + c] = acc;
+        }
+  return out;
+}
+
+NDArray slice_op(const NDArray& x, const std::vector<int64_t>& start,
+                 const std::vector<int64_t>& limit, const std::vector<int64_t>& stride) {
+  NDArray out;
+  out.shape.resize(x.ndim());
+  for (int d = 0; d < x.ndim(); ++d)
+    out.shape[d] = (limit[d] - start[d] + stride[d] - 1) / stride[d];
+  out.data.resize(static_cast<size_t>(out.numel()));
+  auto xs = x.strides();
+  for (int64_t i = 0; i < out.numel(); ++i) {
+    auto oc = unravel(i, out.shape);
+    int64_t src = 0;
+    for (int d = 0; d < x.ndim(); ++d) src += (start[d] + oc[d] * stride[d]) * xs[d];
+    out.data[i] = x.data[src];
+  }
+  return out;
+}
+
+NDArray pad_op(const NDArray& x, float value, const std::vector<int64_t>& lo,
+               const std::vector<int64_t>& hi, const std::vector<int64_t>& interior) {
+  NDArray out;
+  out.shape.resize(x.ndim());
+  for (int d = 0; d < x.ndim(); ++d)
+    out.shape[d] = lo[d] + hi[d] + x.shape[d] + (x.shape[d] - 1) * interior[d];
+  out.data.assign(static_cast<size_t>(out.numel()), value);
+  auto os = out.strides();
+  for (int64_t i = 0; i < x.numel(); ++i) {
+    auto xc = unravel(i, x.shape);
+    int64_t dst = 0;
+    bool ok = true;
+    for (int d = 0; d < x.ndim(); ++d) {
+      int64_t o = lo[d] + xc[d] * (1 + interior[d]);
+      if (o < 0 || o >= out.shape[d]) { ok = false; break; }
+      dst += o * os[d];
+    }
+    if (ok) out.data[dst] = x.data[i];
+  }
+  return out;
+}
+
+NDArray select_n(const NDArray& which, const std::vector<const NDArray*>& cases) {
+  NDArray out(cases[0]->shape);
+  for (size_t i = 0; i < out.data.size(); ++i) {
+    int idx = static_cast<int>(which.data[which.numel() == 1 ? 0 : i]);
+    if (idx < 0) idx = 0;
+    if (idx >= static_cast<int>(cases.size())) idx = static_cast<int>(cases.size()) - 1;
+    out.data[i] = cases[idx]->data[i];
+  }
+  return out;
+}
+
+}  // namespace ptnative

@@ -1,0 +1,37 @@
+// Primitive op declarations for the native interpreter (see ops.cc).
+#pragma once
+
+#include <functional>
+#include <vector>
+
+#include "ndarray.h"
+
+namespace ptnative {
+
+NDArray transpose(const NDArray& x, const std::vector<int64_t>& perm);
+NDArray reshape(const NDArray& x, const std::vector<int64_t>& shape);
+NDArray broadcast_in_dim(const NDArray& x, const std::vector<int64_t>& out_shape,
+                         const std::vector<int64_t>& bcast_dims);
+NDArray binary(const NDArray& a, const NDArray& b,
+               const std::function<float(float, float)>& f);
+NDArray unary(const NDArray& x, const std::function<float(float)>& f);
+NDArray reduce(const NDArray& x, const std::vector<int64_t>& axes, float init,
+               const std::function<float(float, float)>& f);
+NDArray dot_general(const NDArray& lhs, const NDArray& rhs,
+                    const std::vector<int64_t>& lc, const std::vector<int64_t>& rc,
+                    const std::vector<int64_t>& lb, const std::vector<int64_t>& rb);
+NDArray conv2d_nhwc(const NDArray& x, const NDArray& w,
+                    const std::vector<int64_t>& strides,
+                    const std::vector<int64_t>& pad_lo,
+                    const std::vector<int64_t>& pad_hi, int64_t groups);
+NDArray reduce_window_2d(const NDArray& x, const std::vector<int64_t>& window,
+                         const std::vector<int64_t>& strides,
+                         const std::vector<int64_t>& pad_lo,
+                         const std::vector<int64_t>& pad_hi, bool is_max);
+NDArray slice_op(const NDArray& x, const std::vector<int64_t>& start,
+                 const std::vector<int64_t>& limit, const std::vector<int64_t>& stride);
+NDArray pad_op(const NDArray& x, float value, const std::vector<int64_t>& lo,
+               const std::vector<int64_t>& hi, const std::vector<int64_t>& interior);
+NDArray select_n(const NDArray& which, const std::vector<const NDArray*>& cases);
+
+}  // namespace ptnative

@@ -1,0 +1,292 @@
+// Native predictor: loads an exported program (program.txt + weights.bin)
+// and executes it on CPU.
+//
+// Mirrors the reference C++ serving stack: CreatePaddlePredictor /
+// NativePaddlePredictor::Run (paddle/fluid/inference/api/api_impl.cc) which
+// replayed a saved ProgramDesc through the Executor op loop. Here the saved
+// artifact is a linearized jaxpr (emitted by paddle_tpu.native.export) and
+// the op loop interprets the primitive set in ops.cc.
+//
+// Program text format (one instruction per line, '#' comments):
+//   input  <id> <ndim> <dims...>
+//   const  <id> <float_offset> <ndim> <dims...>
+//   op     <prim> <out_id> <nin> <in_ids...> <attrs>   # attrs: k=v;k=v (csv ints)
+//   output <id>
+
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+#include <cmath>
+
+#include "ops.h"
+
+namespace ptnative {
+
+struct Instr {
+  std::string prim;
+  int out = -1;
+  std::vector<int> ins;
+  std::map<std::string, std::vector<int64_t>> attrs;
+  float fattr = 0.0f;  // pad value etc.
+};
+
+// Two-level environment: per-call locals over read-only program constants.
+struct Env {
+  std::map<int, NDArray>* locals;
+  const std::map<int, NDArray>* consts;
+  const NDArray& at(int id) const {
+    auto it = locals->find(id);
+    if (it != locals->end()) return it->second;
+    auto ct = consts->find(id);
+    check(ct != consts->end(), "undefined tensor id " + std::to_string(id));
+    return ct->second;
+  }
+};
+
+struct Program {
+  std::vector<std::pair<int, std::vector<int64_t>>> inputs;   // id, shape
+  std::vector<int> outputs;
+  std::map<int, NDArray> consts;
+  std::vector<Instr> instrs;
+};
+
+static std::vector<int64_t> parse_csv(const std::string& s) {
+  std::vector<int64_t> out;
+  std::stringstream ss(s);
+  std::string item;
+  while (std::getline(ss, item, ',')) {
+    if (!item.empty()) out.push_back(std::stoll(item));
+  }
+  return out;
+}
+
+static std::unique_ptr<Program> load_program(const std::string& dir) {
+  auto prog = std::make_unique<Program>();
+  std::ifstream wf(dir + "/weights.bin", std::ios::binary);
+  check(wf.good(), "cannot open weights.bin in " + dir);
+  wf.seekg(0, std::ios::end);
+  size_t nbytes = static_cast<size_t>(wf.tellg());
+  wf.seekg(0);
+  std::vector<float> wdata(nbytes / sizeof(float));
+  wf.read(reinterpret_cast<char*>(wdata.data()), nbytes);
+
+  std::ifstream pf(dir + "/program.txt");
+  check(pf.good(), "cannot open program.txt in " + dir);
+  std::string line;
+  while (std::getline(pf, line)) {
+    if (line.empty() || line[0] == '#') continue;
+    std::stringstream ss(line);
+    std::string kind;
+    ss >> kind;
+    if (kind == "input") {
+      int id, nd;
+      ss >> id >> nd;
+      std::vector<int64_t> shape(nd);
+      for (auto& d : shape) ss >> d;
+      prog->inputs.emplace_back(id, shape);
+    } else if (kind == "const") {
+      int id, nd;
+      int64_t off;
+      ss >> id >> off >> nd;
+      std::vector<int64_t> shape(nd);
+      for (auto& d : shape) ss >> d;
+      NDArray arr;
+      arr.shape = shape;
+      int64_t n = arr.numel();
+      check(off + n <= static_cast<int64_t>(wdata.size()), "const out of range");
+      arr.data.assign(wdata.begin() + off, wdata.begin() + off + n);
+      prog->consts.emplace(id, std::move(arr));
+    } else if (kind == "op") {
+      Instr ins;
+      int nin;
+      ss >> ins.prim >> ins.out >> nin;
+      ins.ins.resize(nin);
+      for (auto& i : ins.ins) ss >> i;
+      std::string attrs;
+      ss >> attrs;
+      if (!attrs.empty() && attrs != "-") {
+        std::stringstream as(attrs);
+        std::string kv;
+        while (std::getline(as, kv, ';')) {
+          auto eq = kv.find('=');
+          if (eq == std::string::npos) continue;
+          std::string key = kv.substr(0, eq);
+          std::string val = kv.substr(eq + 1);
+          if (key == "fval") {
+            ins.fattr = std::stof(val);
+          } else {
+            ins.attrs[key] = parse_csv(val);
+          }
+        }
+      }
+      prog->instrs.push_back(std::move(ins));
+    } else if (kind == "output") {
+      int id;
+      ss >> id;
+      prog->outputs.push_back(id);
+    }
+  }
+  return prog;
+}
+
+static NDArray run_instr(const Instr& ins, const Env& env) {
+  auto in = [&](int i) -> const NDArray& { return env.at(ins.ins[i]); };
+  auto attr = [&](const char* k) -> const std::vector<int64_t>& {
+    return ins.attrs.at(k);
+  };
+  const std::string& p = ins.prim;
+  if (p == "add") return binary(in(0), in(1), [](float a, float b) { return a + b; });
+  if (p == "sub") return binary(in(0), in(1), [](float a, float b) { return a - b; });
+  if (p == "mul") return binary(in(0), in(1), [](float a, float b) { return a * b; });
+  if (p == "div") return binary(in(0), in(1), [](float a, float b) { return a / b; });
+  if (p == "max") return binary(in(0), in(1), [](float a, float b) { return a > b ? a : b; });
+  if (p == "min") return binary(in(0), in(1), [](float a, float b) { return a < b ? a : b; });
+  if (p == "pow") return binary(in(0), in(1), [](float a, float b) { return std::pow(a, b); });
+  if (p == "eq") return binary(in(0), in(1), [](float a, float b) { return a == b ? 1.0f : 0.0f; });
+  if (p == "lt") return binary(in(0), in(1), [](float a, float b) { return a < b ? 1.0f : 0.0f; });
+  if (p == "gt") return binary(in(0), in(1), [](float a, float b) { return a > b ? 1.0f : 0.0f; });
+  if (p == "ge") return binary(in(0), in(1), [](float a, float b) { return a >= b ? 1.0f : 0.0f; });
+  if (p == "le") return binary(in(0), in(1), [](float a, float b) { return a <= b ? 1.0f : 0.0f; });
+  if (p == "and") return binary(in(0), in(1), [](float a, float b) { return (a != 0 && b != 0) ? 1.0f : 0.0f; });
+  if (p == "or") return binary(in(0), in(1), [](float a, float b) { return (a != 0 || b != 0) ? 1.0f : 0.0f; });
+  if (p == "exp") return unary(in(0), [](float a) { return std::exp(a); });
+  if (p == "log") return unary(in(0), [](float a) { return std::log(a); });
+  if (p == "neg") return unary(in(0), [](float a) { return -a; });
+  if (p == "abs") return unary(in(0), [](float a) { return std::fabs(a); });
+  if (p == "sign") return unary(in(0), [](float a) { return a > 0 ? 1.0f : (a < 0 ? -1.0f : 0.0f); });
+  if (p == "floor") return unary(in(0), [](float a) { return std::floor(a); });
+  if (p == "rsqrt") return unary(in(0), [](float a) { return 1.0f / std::sqrt(a); });
+  if (p == "sqrt") return unary(in(0), [](float a) { return std::sqrt(a); });
+  if (p == "tanh") return unary(in(0), [](float a) { return std::tanh(a); });
+  if (p == "logistic") return unary(in(0), [](float a) { return 1.0f / (1.0f + std::exp(-a)); });
+  if (p == "integer_pow") {
+    float e = static_cast<float>(attr("y")[0]);
+    return unary(in(0), [e](float a) { return std::pow(a, e); });
+  }
+  if (p == "copy" || p == "convert_element_type" || p == "stop_gradient")
+    return env.at(ins.ins[0]);
+  if (p == "reshape") return reshape(in(0), attr("shape"));
+  if (p == "squeeze") return reshape(in(0), attr("shape"));
+  if (p == "transpose") return transpose(in(0), attr("perm"));
+  if (p == "broadcast_in_dim")
+    return broadcast_in_dim(in(0), attr("shape"), attr("dims"));
+  if (p == "reduce_sum")
+    return reduce(in(0), attr("axes"), 0.0f, [](float a, float b) { return a + b; });
+  if (p == "reduce_max")
+    return reduce(in(0), attr("axes"), -std::numeric_limits<float>::infinity(),
+                  [](float a, float b) { return a > b ? a : b; });
+  if (p == "reduce_min")
+    return reduce(in(0), attr("axes"), std::numeric_limits<float>::infinity(),
+                  [](float a, float b) { return a < b ? a : b; });
+  if (p == "reduce_or")
+    return reduce(in(0), attr("axes"), 0.0f,
+                  [](float a, float b) { return (a != 0 || b != 0) ? 1.0f : 0.0f; });
+  if (p == "reduce_and")
+    return reduce(in(0), attr("axes"), 1.0f,
+                  [](float a, float b) { return (a != 0 && b != 0) ? 1.0f : 0.0f; });
+  if (p == "dot_general")
+    return dot_general(in(0), in(1), attr("lc"), attr("rc"), attr("lb"), attr("rb"));
+  if (p == "conv")
+    return conv2d_nhwc(in(0), in(1), attr("strides"), attr("pad_lo"), attr("pad_hi"),
+                       attr("groups")[0]);
+  if (p == "reduce_window_max")
+    return reduce_window_2d(in(0), attr("window"), attr("strides"), attr("pad_lo"),
+                            attr("pad_hi"), true);
+  if (p == "reduce_window_sum")
+    return reduce_window_2d(in(0), attr("window"), attr("strides"), attr("pad_lo"),
+                            attr("pad_hi"), false);
+  if (p == "slice") return slice_op(in(0), attr("start"), attr("limit"), attr("stride"));
+  if (p == "pad") {
+    float value = ins.ins.size() > 1 ? in(1).data[0] : ins.fattr;
+    return pad_op(in(0), value, attr("lo"), attr("hi"), attr("interior"));
+  }
+  if (p == "select_n") {
+    std::vector<const NDArray*> cases;
+    for (size_t i = 1; i < ins.ins.size(); ++i) cases.push_back(&env.at(ins.ins[i]));
+    return select_n(in(0), cases);
+  }
+  check(false, "unsupported primitive: " + p);
+  return NDArray();
+}
+
+}  // namespace ptnative
+
+// ----------------------------------------------------------------- C API
+
+using ptnative::NDArray;
+using ptnative::Program;
+
+struct PTPredictor {
+  std::unique_ptr<Program> prog;
+  std::string error;
+  std::vector<NDArray> last_outputs;
+};
+
+extern "C" {
+
+PTPredictor* pt_predictor_create(const char* dir) {
+  auto* p = new PTPredictor();
+  try {
+    p->prog = ptnative::load_program(dir);
+  } catch (const std::exception& e) {
+    p->error = e.what();
+  }
+  return p;
+}
+
+const char* pt_predictor_error(PTPredictor* p) { return p->error.c_str(); }
+
+void pt_predictor_destroy(PTPredictor* p) { delete p; }
+
+// Run with flat f32 inputs (concatenated in declaration order; shapes must
+// match the exported input shapes). Returns 0 on success.
+int pt_predictor_run(PTPredictor* p, const float** inputs, int n_inputs) {
+  try {
+    ptnative::check(p->prog != nullptr, "predictor failed to load: " + p->error);
+    ptnative::check(n_inputs == static_cast<int>(p->prog->inputs.size()),
+                    "wrong number of inputs");
+    // consts are read through, never copied into the per-call env — weights
+    // for a large model would otherwise be memcpy'd on every run
+    std::map<int, NDArray> locals;
+    ptnative::Env env{&locals, &p->prog->consts};
+    for (int i = 0; i < n_inputs; ++i) {
+      NDArray arr;
+      arr.shape = p->prog->inputs[i].second;
+      arr.data.assign(inputs[i], inputs[i] + arr.numel());
+      locals.emplace(p->prog->inputs[i].first, std::move(arr));
+    }
+    for (const auto& ins : p->prog->instrs) {
+      locals[ins.out] = ptnative::run_instr(ins, env);
+    }
+    p->last_outputs.clear();
+    for (int id : p->prog->outputs) p->last_outputs.push_back(env.at(id));
+    return 0;
+  } catch (const std::exception& e) {
+    p->error = e.what();
+    return 1;
+  }
+}
+
+int pt_predictor_num_outputs(PTPredictor* p) {
+  return static_cast<int>(p->last_outputs.size());
+}
+
+int pt_predictor_output_ndim(PTPredictor* p, int i) {
+  return p->last_outputs[i].ndim();
+}
+
+void pt_predictor_output_shape(PTPredictor* p, int i, int64_t* shape) {
+  for (int d = 0; d < p->last_outputs[i].ndim(); ++d)
+    shape[d] = p->last_outputs[i].shape[d];
+}
+
+void pt_predictor_output_data(PTPredictor* p, int i, float* out) {
+  std::memcpy(out, p->last_outputs[i].data.data(),
+              p->last_outputs[i].data.size() * sizeof(float));
+}
+
+}  // extern "C"

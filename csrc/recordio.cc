@@ -1,0 +1,221 @@
+// RecordIO: chunked record file format with CRC32 integrity and optional
+// zlib compression.
+//
+// Native counterpart of the reference's recordio library
+// (paddle/fluid/recordio/chunk.h Chunk/Header, scanner.h:26 Scanner,
+// writer.h:22 Writer): length-prefixed records accumulate into chunks; each
+// chunk is written as [magic, num_records, checksum, compressor,
+// compressed_len] + payload. Differences from the reference: zlib(deflate)
+// replaces snappy (zlib is in the base image; the reference's kGzip option
+// is the analogous codec), and CRC32 comes from zlib too.
+
+#include <zlib.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x50544e52;  // "PTNR"
+
+struct Chunk {
+  std::vector<std::string> records;
+  size_t num_bytes = 0;
+};
+
+std::string pack_chunk(const Chunk& c) {
+  std::string payload;
+  payload.reserve(c.num_bytes + c.records.size() * 4);
+  for (const auto& r : c.records) {
+    uint32_t len = static_cast<uint32_t>(r.size());
+    payload.append(reinterpret_cast<const char*>(&len), 4);
+    payload.append(r);
+  }
+  return payload;
+}
+
+}  // namespace
+
+struct PTRecordWriter {
+  FILE* f = nullptr;
+  Chunk chunk;
+  size_t max_chunk_bytes;
+  int compress;
+  std::string error;
+
+  bool flush() {
+    if (chunk.records.empty()) return true;
+    std::string payload = pack_chunk(chunk);
+    uint32_t crc = crc32(0, reinterpret_cast<const Bytef*>(payload.data()),
+                         static_cast<uInt>(payload.size()));
+    std::string out = payload;
+    if (compress) {
+      uLongf bound = compressBound(payload.size());
+      out.resize(bound);
+      if (compress2(reinterpret_cast<Bytef*>(&out[0]), &bound,
+                    reinterpret_cast<const Bytef*>(payload.data()),
+                    payload.size(), Z_DEFAULT_COMPRESSION) != Z_OK) {
+        error = "zlib compress failed";
+        return false;
+      }
+      out.resize(bound);
+    }
+    uint32_t header[6] = {
+        kMagic,
+        static_cast<uint32_t>(chunk.records.size()),
+        crc,
+        static_cast<uint32_t>(compress),
+        static_cast<uint32_t>(out.size()),
+        static_cast<uint32_t>(payload.size()),  // uncompressed length
+    };
+    if (fwrite(header, sizeof(header), 1, f) != 1 ||
+        fwrite(out.data(), 1, out.size(), f) != out.size()) {
+      error = "short write";
+      return false;
+    }
+    chunk.records.clear();
+    chunk.num_bytes = 0;
+    return true;
+  }
+};
+
+struct PTRecordScanner {
+  FILE* f = nullptr;
+  Chunk chunk;
+  size_t cursor = 0;
+  std::string error;
+  bool eof = false;
+
+  bool load_chunk() {
+    uint32_t header[6];
+    size_t got_bytes = fread(header, 1, sizeof(header), f);
+    if (got_bytes == 0) {
+      eof = true;
+      return false;
+    }
+    if (got_bytes != sizeof(header)) {
+      // a partial header is truncation, not clean EOF
+      error = "truncated chunk header";
+      return false;
+    }
+    if (header[0] != kMagic) {
+      error = "bad magic (corrupt file?)";
+      return false;
+    }
+    uint32_t n_rec = header[1], crc = header[2], comp = header[3], clen = header[4];
+    uint32_t ulen = header[5];
+    std::string raw(clen, '\0');
+    if (fread(&raw[0], 1, clen, f) != clen) {
+      error = "truncated chunk";
+      return false;
+    }
+    std::string payload;
+    if (comp) {
+      payload.resize(ulen);
+      uLongf got = ulen;
+      int rc = uncompress(reinterpret_cast<Bytef*>(ulen ? &payload[0] : nullptr),
+                          &got, reinterpret_cast<const Bytef*>(raw.data()), clen);
+      if (rc != Z_OK || got != ulen) {
+        error = "zlib uncompress failed";
+        return false;
+      }
+    } else {
+      payload = std::move(raw);
+    }
+    uint32_t actual = crc32(0, reinterpret_cast<const Bytef*>(payload.data()),
+                            static_cast<uInt>(payload.size()));
+    if (actual != crc) {
+      error = "crc mismatch (corrupt chunk)";
+      return false;
+    }
+    chunk.records.clear();
+    size_t off = 0;
+    for (uint32_t i = 0; i < n_rec; ++i) {
+      if (off + 4 > payload.size()) {
+        error = "record length out of range";
+        return false;
+      }
+      uint32_t len;
+      std::memcpy(&len, payload.data() + off, 4);
+      off += 4;
+      if (off + len > payload.size()) {
+        error = "record out of range";
+        return false;
+      }
+      chunk.records.emplace_back(payload.substr(off, len));
+      off += len;
+    }
+    cursor = 0;
+    return true;
+  }
+};
+
+extern "C" {
+
+PTRecordWriter* pt_recordio_writer_open(const char* path, int compress,
+                                        int64_t max_chunk_bytes) {
+  auto* w = new PTRecordWriter();
+  w->f = fopen(path, "wb");
+  w->compress = compress;
+  w->max_chunk_bytes = max_chunk_bytes > 0 ? max_chunk_bytes : (1 << 20);
+  if (!w->f) w->error = "cannot open file for write";
+  return w;
+}
+
+int pt_recordio_writer_write(PTRecordWriter* w, const char* data, int64_t len) {
+  if (!w->f) return 1;
+  w->chunk.records.emplace_back(data, static_cast<size_t>(len));
+  w->chunk.num_bytes += static_cast<size_t>(len);
+  if (w->chunk.num_bytes >= w->max_chunk_bytes) {
+    if (!w->flush()) return 1;
+  }
+  return 0;
+}
+
+int pt_recordio_writer_close(PTRecordWriter* w) {
+  int rc = 0;
+  if (w->f) {
+    if (!w->flush()) rc = 1;
+    fclose(w->f);
+    w->f = nullptr;
+  }
+  return rc;
+}
+
+const char* pt_recordio_writer_error(PTRecordWriter* w) { return w->error.c_str(); }
+
+void pt_recordio_writer_destroy(PTRecordWriter* w) {
+  if (w->f) fclose(w->f);
+  delete w;
+}
+
+PTRecordScanner* pt_recordio_scanner_open(const char* path) {
+  auto* s = new PTRecordScanner();
+  s->f = fopen(path, "rb");
+  if (!s->f) s->error = "cannot open file for read";
+  return s;
+}
+
+// Returns record length (>= 0) and sets *data to an internal buffer valid
+// until the next call; -1 on EOF; -2 on error.
+int64_t pt_recordio_scanner_next(PTRecordScanner* s, const char** data) {
+  if (!s->f) return -2;
+  if (s->cursor >= s->chunk.records.size()) {
+    if (!s->load_chunk()) return s->eof ? -1 : -2;
+  }
+  const std::string& rec = s->chunk.records[s->cursor++];
+  *data = rec.data();
+  return static_cast<int64_t>(rec.size());
+}
+
+const char* pt_recordio_scanner_error(PTRecordScanner* s) { return s->error.c_str(); }
+
+void pt_recordio_scanner_destroy(PTRecordScanner* s) {
+  if (s->f) fclose(s->f);
+  delete s;
+}
+
+}  // extern "C"

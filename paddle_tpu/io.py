@@ -70,11 +70,19 @@ def save_inference_model(
     variables: Variables,
     example_args: Sequence[Any],
     rng=None,
+    native: bool = False,
 ) -> None:
     """Export an inference program (reference save_inference_model): the
     model is traced in eval mode with params baked as constants-free inputs,
-    serialized as StableHLO bytes + the weights archive."""
+    serialized as StableHLO bytes + the weights archive. With ``native=True``
+    a C++-predictor artifact is ALSO written (program.txt + weights.bin,
+    consumed by ``paddle_tpu.native.NativePredictor`` — the analogue of the
+    reference's C++ ``inference/api`` consuming the saved ProgramDesc)."""
     os.makedirs(dirname, exist_ok=True)
+    if native:
+        from paddle_tpu.native.export import save_native_model
+
+        save_native_model(model, variables, example_args, dirname)
 
     def infer_fn(params, state, *args):
         out, _ = model.apply(Variables(params, state), *args, rng=rng, is_train=False)

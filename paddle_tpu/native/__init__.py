@@ -1,0 +1,193 @@
+"""Native (C++) runtime bindings: recordio + CPU inference predictor.
+
+Reference: ``paddle/fluid/recordio/`` (chunked record files feeding the data
+pipeline), ``paddle/fluid/inference/api/paddle_inference_api.h`` (C++
+predictor), ``paddle/fluid/train/demo/demo_trainer.cc`` (pure-C++ run of a
+saved program). The library builds from ``csrc/`` via make on first import
+(no pybind11 in this image — plain ``ctypes`` over an extern-C API).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["RecordIOWriter", "RecordIOScanner", "NativePredictor", "lib"]
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_LIB_PATH = os.path.join(_CSRC, "build", "libpaddle_tpu_native.so")
+
+_lib = None
+
+
+def lib() -> ctypes.CDLL:
+    """Load (building if needed) the native library."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        subprocess.run(["make", "-C", _CSRC], check=True, capture_output=True)
+    _lib = ctypes.CDLL(_LIB_PATH)
+    # recordio
+    _lib.pt_recordio_writer_open.restype = ctypes.c_void_p
+    _lib.pt_recordio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int64]
+    _lib.pt_recordio_writer_write.restype = ctypes.c_int
+    _lib.pt_recordio_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    _lib.pt_recordio_writer_close.restype = ctypes.c_int
+    _lib.pt_recordio_writer_close.argtypes = [ctypes.c_void_p]
+    _lib.pt_recordio_writer_error.restype = ctypes.c_char_p
+    _lib.pt_recordio_writer_error.argtypes = [ctypes.c_void_p]
+    _lib.pt_recordio_writer_destroy.argtypes = [ctypes.c_void_p]
+    _lib.pt_recordio_scanner_open.restype = ctypes.c_void_p
+    _lib.pt_recordio_scanner_open.argtypes = [ctypes.c_char_p]
+    _lib.pt_recordio_scanner_next.restype = ctypes.c_int64
+    _lib.pt_recordio_scanner_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p)]
+    _lib.pt_recordio_scanner_error.restype = ctypes.c_char_p
+    _lib.pt_recordio_scanner_error.argtypes = [ctypes.c_void_p]
+    _lib.pt_recordio_scanner_destroy.argtypes = [ctypes.c_void_p]
+    # predictor
+    _lib.pt_predictor_create.restype = ctypes.c_void_p
+    _lib.pt_predictor_create.argtypes = [ctypes.c_char_p]
+    _lib.pt_predictor_error.restype = ctypes.c_char_p
+    _lib.pt_predictor_error.argtypes = [ctypes.c_void_p]
+    _lib.pt_predictor_destroy.argtypes = [ctypes.c_void_p]
+    _lib.pt_predictor_run.restype = ctypes.c_int
+    _lib.pt_predictor_run.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_int,
+    ]
+    _lib.pt_predictor_num_outputs.restype = ctypes.c_int
+    _lib.pt_predictor_num_outputs.argtypes = [ctypes.c_void_p]
+    _lib.pt_predictor_output_ndim.restype = ctypes.c_int
+    _lib.pt_predictor_output_ndim.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    _lib.pt_predictor_output_shape.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64)
+    ]
+    _lib.pt_predictor_output_data.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_float)
+    ]
+    return _lib
+
+
+class RecordIOWriter:
+    """Writer (reference ``recordio/writer.h:22``): length-prefixed records
+    into CRC-checked, optionally zlib-compressed chunks."""
+
+    def __init__(self, path: str, compress: bool = True, max_chunk_bytes: int = 1 << 20):
+        self._lib = lib()
+        self._h = self._lib.pt_recordio_writer_open(
+            path.encode(), 1 if compress else 0, max_chunk_bytes
+        )
+        self._closed = False
+
+    def write(self, record: bytes) -> None:
+        rc = self._lib.pt_recordio_writer_write(self._h, record, len(record))
+        if rc != 0:
+            raise IOError(self._lib.pt_recordio_writer_error(self._h).decode())
+
+    def close(self) -> None:
+        if not self._closed:
+            rc = self._lib.pt_recordio_writer_close(self._h)
+            if rc != 0:
+                raise IOError(self._lib.pt_recordio_writer_error(self._h).decode())
+            self._lib.pt_recordio_writer_destroy(self._h)
+            self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class RecordIOScanner:
+    """Scanner (reference ``recordio/scanner.h:26``): iterate records."""
+
+    def __init__(self, path: str):
+        self._lib = lib()
+        self._h = self._lib.pt_recordio_scanner_open(path.encode())
+        self._closed = False
+
+    def __iter__(self) -> Iterator[bytes]:
+        buf = ctypes.c_char_p()
+        while True:
+            n = self._lib.pt_recordio_scanner_next(self._h, ctypes.byref(buf))
+            if n == -1:
+                return
+            if n == -2:
+                raise IOError(self._lib.pt_recordio_scanner_error(self._h).decode())
+            yield ctypes.string_at(buf, n)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._lib.pt_recordio_scanner_destroy(self._h)
+            self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class NativePredictor:
+    """C++ predictor over an exported program dir (reference
+    ``CreatePaddlePredictor`` / ``NativePaddlePredictor::Run``,
+    ``inference/api/api_impl.cc``). See ``paddle_tpu.native.export`` for the
+    artifact format."""
+
+    def __init__(self, model_dir: str):
+        self._lib = lib()
+        self._h = self._lib.pt_predictor_create(model_dir.encode())
+        err = self._lib.pt_predictor_error(self._h).decode()
+        if err:
+            raise IOError(f"NativePredictor load failed: {err}")
+        # exported input shapes, for Python-side validation (the C side reads
+        # exactly numel(shape) floats from each raw pointer)
+        self.input_shapes: List[Tuple[int, ...]] = []
+        with open(os.path.join(model_dir, "program.txt")) as f:
+            for line in f:
+                parts = line.split()
+                if parts and parts[0] == "input":
+                    nd = int(parts[2])
+                    self.input_shapes.append(tuple(int(d) for d in parts[3 : 3 + nd]))
+
+    def run(self, *inputs: np.ndarray) -> List[np.ndarray]:
+        if len(inputs) != len(self.input_shapes):
+            raise ValueError(
+                f"expected {len(self.input_shapes)} inputs, got {len(inputs)}"
+            )
+        for i, (x, shape) in enumerate(zip(inputs, self.input_shapes)):
+            if tuple(np.shape(x)) != shape:
+                raise ValueError(
+                    f"input {i} has shape {np.shape(x)}, exported program "
+                    f"expects {shape} (shapes are static)"
+                )
+        arrs = [np.ascontiguousarray(x, dtype=np.float32) for x in inputs]
+        ptrs = (ctypes.POINTER(ctypes.c_float) * len(arrs))(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)) for a in arrs]
+        )
+        rc = self._lib.pt_predictor_run(self._h, ptrs, len(arrs))
+        if rc != 0:
+            raise RuntimeError(self._lib.pt_predictor_error(self._h).decode())
+        outs = []
+        for i in range(self._lib.pt_predictor_num_outputs(self._h)):
+            nd = self._lib.pt_predictor_output_ndim(self._h, i)
+            shape = (ctypes.c_int64 * max(nd, 1))()
+            self._lib.pt_predictor_output_shape(self._h, i, shape)
+            np_shape = tuple(shape[d] for d in range(nd))
+            out = np.empty(np_shape, np.float32)
+            flat = out.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+            self._lib.pt_predictor_output_data(self._h, i, flat)
+            outs.append(out)
+        return outs
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.pt_predictor_destroy(self._h)
+            self._h = None

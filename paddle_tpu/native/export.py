@@ -1,0 +1,327 @@
+"""Export a traced inference function to the native predictor format.
+
+The TPU-native replacement for ``fluid.io.save_inference_model`` feeding the
+C++ side (reference ``io.py:544`` pruned ProgramDesc + persistables; consumed
+by ``inference/api/api_impl.cc``): here the saved program is the model's
+jaxpr, linearized into a flat instruction list over float32 tensors —
+parameters are baked in as constants (the closure plays the role of the
+pruned persistables), pjit regions are inlined, and the artifact is
+
+    <dir>/program.txt    # linearized instructions (see csrc/predictor.cc)
+    <dir>/weights.bin    # all constants, concatenated float32
+
+Only the inference-relevant primitive subset is supported; exporting a
+function with an unsupported primitive (e.g. a training op or gather) raises
+with the primitive name.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Sequence
+
+import jax
+import numpy as np
+from jax.extend import core as jcore
+
+__all__ = ["export_program", "save_native_model"]
+
+_UNARY = {
+    "exp", "log", "neg", "abs", "sign", "floor", "rsqrt", "sqrt", "tanh",
+    "logistic",
+}
+_BINARY = {
+    "add", "sub", "mul", "div", "max", "min", "pow", "eq", "lt", "gt", "ge",
+    "le", "and", "or",
+}
+_COPY = {"convert_element_type", "stop_gradient", "copy"}
+_REDUCE = {"reduce_sum", "reduce_max", "reduce_min", "reduce_or", "reduce_and"}
+
+
+class _Emitter:
+    def __init__(self):
+        self.lines: List[str] = []
+        self.weights: List[np.ndarray] = []
+        self.weight_offset = 0
+        # scope stack: each inlined call gets its own frame so a cached
+        # sub-jaxpr inlined twice (same Var objects) gets FRESH ids per
+        # inlining instead of aliasing the first call's results
+        self.scopes: List[Dict[jcore.Var, int]] = [{}]
+        self.next_id = 0
+
+    def vid(self, var) -> int:
+        for scope in reversed(self.scopes):
+            if var in scope:
+                return scope[var]
+        self.scopes[-1][var] = self.next_id
+        self.next_id += 1
+        return self.scopes[-1][var]
+
+    def bind(self, var, vid: int) -> None:
+        self.scopes[-1][var] = vid
+
+    def push_scope(self) -> None:
+        self.scopes.append({})
+
+    def pop_scope(self) -> None:
+        self.scopes.pop()
+
+    def fresh(self) -> int:
+        self.next_id += 1
+        return self.next_id - 1
+
+    def const(self, value) -> int:
+        arr = np.asarray(value, np.float32)
+        cid = self.fresh()
+        self.lines.append(
+            f"const {cid} {self.weight_offset} {arr.ndim} "
+            + " ".join(str(d) for d in arr.shape)
+        )
+        self.weights.append(arr.ravel())
+        self.weight_offset += arr.size
+        return cid
+
+    def op(self, prim: str, out: int, ins: Sequence[int], attrs: Dict[str, object] = None, fval=None):
+        parts = []
+        for k, v in (attrs or {}).items():
+            if isinstance(v, (list, tuple)):
+                parts.append(f"{k}={','.join(str(int(i)) for i in v)}")
+            else:
+                parts.append(f"{k}={int(v)}")
+        if fval is not None:
+            parts.append(f"fval={float(fval)}")
+        attr_str = ";".join(parts) if parts else "-"
+        self.lines.append(
+            f"op {prim} {out} {len(ins)} " + " ".join(str(i) for i in ins) + " " + attr_str
+        )
+
+
+def _in_ids(em: _Emitter, eqn) -> List[int]:
+    ids = []
+    for v in eqn.invars:
+        if isinstance(v, jcore.Literal):
+            ids.append(em.const(v.val))
+        else:
+            ids.append(em.vid(v))
+    return ids
+
+
+def _emit_eqn(em: _Emitter, eqn) -> None:
+    prim = eqn.primitive.name
+    params = eqn.params
+
+    if prim in ("pjit", "closed_call", "custom_jvp_call", "custom_vjp_call", "jit"):
+        sub = params.get("jaxpr") or params.get("call_jaxpr")
+        if hasattr(sub, "jaxpr"):
+            closed = sub
+            inner = closed.jaxpr
+            const_ids = [em.const(c) for c in closed.consts]
+            arg_ids = _in_ids(em, eqn)
+            em.push_scope()
+            for var, cid in zip(inner.constvars, const_ids):
+                em.bind(var, cid)
+            for var, aid in zip(inner.invars, arg_ids):
+                em.bind(var, aid)
+            for inner_eqn in inner.eqns:
+                _emit_eqn(em, inner_eqn)
+            out_ids = [
+                em.const(v.val) if isinstance(v, jcore.Literal) else em.vid(v)
+                for v in inner.outvars
+            ]
+            em.pop_scope()
+            for outer_out, oid in zip(eqn.outvars, out_ids):
+                em.bind(outer_out, oid)
+            return
+        raise NotImplementedError(f"call primitive without jaxpr: {prim}")
+
+    ins = _in_ids(em, eqn)
+    out = em.vid(eqn.outvars[0])
+
+    if prim in _BINARY:
+        em.op(prim, out, ins)
+    elif prim in _UNARY:
+        em.op(prim, out, ins)
+    elif prim in _COPY:
+        em.op("copy", out, ins[:1])
+    elif prim == "integer_pow":
+        em.op("integer_pow", out, ins, {"y": params["y"]})
+    elif prim == "reshape":
+        em.op("reshape", out, ins[:1], {"shape": eqn.outvars[0].aval.shape})
+    elif prim == "squeeze":
+        em.op("squeeze", out, ins[:1], {"shape": eqn.outvars[0].aval.shape})
+    elif prim == "expand_dims":
+        em.op("reshape", out, ins[:1], {"shape": eqn.outvars[0].aval.shape})
+    elif prim == "transpose":
+        em.op("transpose", out, ins[:1], {"perm": params["permutation"]})
+    elif prim == "broadcast_in_dim":
+        em.op(
+            "broadcast_in_dim", out, ins[:1],
+            {"shape": params["shape"], "dims": params["broadcast_dimensions"]},
+        )
+    elif prim in _REDUCE:
+        em.op(prim, out, ins[:1], {"axes": params["axes"]})
+    elif prim == "dot_general":
+        (lc, rc), (lb, rb) = params["dimension_numbers"]
+        em.op("dot_general", out, ins, {"lc": lc, "rc": rc, "lb": lb, "rb": rb})
+    elif prim == "conv_general_dilated":
+        _emit_conv(em, eqn, ins, out)
+    elif prim == "reduce_window_max":
+        _emit_reduce_window(em, eqn, ins, out, "reduce_window_max")
+    elif prim == "reduce_window_sum":
+        _emit_reduce_window(em, eqn, ins, out, "reduce_window_sum")
+    elif prim == "slice":
+        strides = params["strides"] or (1,) * len(params["start_indices"])
+        em.op(
+            "slice", out, ins[:1],
+            {"start": params["start_indices"], "limit": params["limit_indices"], "stride": strides},
+        )
+    elif prim == "pad":
+        cfg = params["padding_config"]
+        # pad value travels as a scalar operand (ins[1]) — works for both
+        # literals (already materialized as consts) and traced constants
+        em.op(
+            "pad", out, ins,
+            {"lo": [c[0] for c in cfg], "hi": [c[1] for c in cfg], "interior": [c[2] for c in cfg]},
+        )
+    elif prim == "select_n":
+        em.op("select_n", out, ins)
+    elif prim == "iota":
+        arr = np.zeros(params["shape"], np.float32)
+        idx = np.arange(params["shape"][params["dimension"]], dtype=np.float32)
+        shape = [1] * len(params["shape"])
+        shape[params["dimension"]] = -1
+        arr[...] = idx.reshape(shape)
+        em.bind(eqn.outvars[0], em.const(arr))
+    else:
+        raise NotImplementedError(
+            f"primitive {prim!r} is not supported by the native exporter "
+            "(export a pure inference fn: inputs -> logits)"
+        )
+
+
+def _emit_conv(em: _Emitter, eqn, ins, out) -> None:
+    params = eqn.params
+    dn = params["dimension_numbers"]
+    if params.get("lhs_dilation") and any(d != 1 for d in params["lhs_dilation"]):
+        raise NotImplementedError("transposed conv (lhs_dilation) not supported natively")
+    if params.get("rhs_dilation") and any(d != 1 for d in params["rhs_dilation"]):
+        raise NotImplementedError("dilated conv not supported natively")
+    lhs_spec, rhs_spec, out_spec = dn.lhs_spec, dn.rhs_spec, dn.out_spec
+    # canonicalize lhs to NHWC, rhs to HWIO via transposes, then conv, then
+    # transpose the NHWC result to the expected out layout
+    nhwc = (lhs_spec[0], *lhs_spec[2:], lhs_spec[1])  # (N, spatial..., C)
+    hwio = (*rhs_spec[2:], rhs_spec[1], rhs_spec[0])  # (spatial..., I, O)
+    x_id, w_id = ins
+    if tuple(nhwc) != tuple(range(len(nhwc))):
+        t = em.fresh()
+        em.op("transpose", t, [x_id], {"perm": nhwc})
+        x_id = t
+    if tuple(hwio) != tuple(range(len(hwio))):
+        t = em.fresh()
+        em.op("transpose", t, [w_id], {"perm": hwio})
+        w_id = t
+    pad = params["padding"]
+    conv_out = em.fresh()
+    em.op(
+        "conv", conv_out, [x_id, w_id],
+        {
+            "strides": params["window_strides"],
+            "pad_lo": [p[0] for p in pad],
+            "pad_hi": [p[1] for p in pad],
+            "groups": params["feature_group_count"],
+        },
+    )
+    # conv result is NHWC; out_spec gives where (N, C, spatial...) land
+    out_rank = len(out_spec)
+    perm = [0] * out_rank
+    # nhwc position of each logical dim: N=0, C=last, spatial i -> 1+i
+    logical_to_nhwc = {0: 0, 1: out_rank - 1}
+    for i in range(out_rank - 2):
+        logical_to_nhwc[2 + i] = 1 + i
+    for logical, pos in enumerate(out_spec):
+        perm[pos] = logical_to_nhwc[logical]
+    if perm != list(range(out_rank)):
+        em.op("transpose", out, [conv_out], {"perm": perm})
+    else:
+        em.op("copy", out, [conv_out])
+
+
+def _emit_reduce_window(em: _Emitter, eqn, ins, out, name: str) -> None:
+    params = eqn.params
+    wd = params["window_dimensions"]
+    if len(wd) != 4 or wd[0] != 1 or wd[3] != 1:
+        raise NotImplementedError(f"{name}: only NHWC (1,kh,kw,1) windows supported")
+    if any(d != 1 for d in params.get("base_dilation", (1,) * 4)):
+        raise NotImplementedError(f"{name}: base_dilation unsupported")
+    if any(d != 1 for d in params.get("window_dilation", (1,) * 4)):
+        raise NotImplementedError(f"{name}: window_dilation unsupported")
+    pad = params["padding"]
+    em.op(
+        name, out, ins[:1],
+        {
+            "window": wd,
+            "strides": params["window_strides"],
+            "pad_lo": [p[0] for p in pad],
+            "pad_hi": [p[1] for p in pad],
+        },
+    )
+
+
+def _dce(jaxpr):
+    """Keep only eqns whose outputs (transitively) feed jaxpr.outvars — the
+    analogue of the reference's inference-program pruning
+    (``framework/prune.cc:187``); a traced fn may compute losses/metrics the
+    exported predictor never returns."""
+    needed = {v for v in jaxpr.outvars if not isinstance(v, jcore.Literal)}
+    keep = []
+    for eqn in reversed(jaxpr.eqns):
+        if any(o in needed for o in eqn.outvars):
+            keep.append(eqn)
+            for v in eqn.invars:
+                if not isinstance(v, jcore.Literal):
+                    needed.add(v)
+    return list(reversed(keep))
+
+
+def export_program(fn: Callable, example_inputs: Sequence, out_dir: str) -> None:
+    """Trace ``fn(*example_inputs)`` and write the native artifact."""
+    os.makedirs(out_dir, exist_ok=True)
+    closed = jax.make_jaxpr(fn)(*example_inputs)
+    jaxpr = closed.jaxpr
+    em = _Emitter()
+
+    for var, val in zip(jaxpr.constvars, closed.consts):
+        em.bind(var, em.const(np.asarray(val)))
+    for var, ex in zip(jaxpr.invars, example_inputs):
+        vid = em.vid(var)
+        shape = np.shape(ex)
+        em.lines.append(
+            f"input {vid} {len(shape)} " + " ".join(str(d) for d in shape)
+        )
+    for eqn in _dce(jaxpr):
+        _emit_eqn(em, eqn)
+    out_lines = []
+    for var in jaxpr.outvars:
+        if isinstance(var, jcore.Literal):
+            out_lines.append(f"output {em.const(var.val)}")
+        else:
+            out_lines.append(f"output {em.vid(var)}")
+
+    with open(os.path.join(out_dir, "program.txt"), "w") as f:
+        f.write("# paddle_tpu native program v1\n")
+        f.write("\n".join(em.lines + out_lines) + "\n")
+    blob = (
+        np.concatenate(em.weights) if em.weights else np.zeros((0,), np.float32)
+    ).astype(np.float32)
+    blob.tofile(os.path.join(out_dir, "weights.bin"))
+
+
+def save_native_model(model, variables, example_inputs: Sequence, out_dir: str) -> None:
+    """save_inference_model-style convenience: bake ``variables`` into the
+    program as constants and export ``model.apply`` in eval mode."""
+
+    def predict(*inputs):
+        out, _ = model.apply(variables, *inputs, is_train=False)
+        return out
+
+    export_program(predict, example_inputs, out_dir)

@@ -292,3 +292,20 @@ class DevicePrefetcher:
         if isinstance(item, _RaisedInProducer):
             raise item.exc
         return item
+
+
+def recordio(path: str) -> Reader:
+    """Reader over a native recordio file (reference open_recordio_file,
+    ``layers/io.py:344`` + C++ RecordIOFileReader): yields raw bytes records
+    scanned by the C++ library."""
+
+    def reader():
+        from paddle_tpu.native import RecordIOScanner
+
+        with RecordIOScanner(path) as s:
+            yield from s
+
+    return reader
+
+
+__all__.append("recordio")
