@@ -48,6 +48,8 @@ from paddle_tpu.trainer import Trainer, CheckpointConfig
 from paddle_tpu import transpiler
 from paddle_tpu.transpiler import memory_optimize, release_memory
 from paddle_tpu import dataset
+from paddle_tpu import debugger
+from paddle_tpu.core import profiler
 
 CPUPlace = config.CPUPlace
 TPUPlace = config.TPUPlace
@@ -88,6 +90,8 @@ __all__ = [
     "memory_optimize",
     "release_memory",
     "dataset",
+    "debugger",
+    "profiler",
     "CPUPlace",
     "TPUPlace",
 ]

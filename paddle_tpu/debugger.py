@@ -1,0 +1,112 @@
+"""Program observability: IR dumps, graph drawing, memory stats, NaN guard.
+
+Reference: ``python/paddle/fluid/debugger.py:275`` (draw_block_graphviz),
+``framework/ir/graph_viz_pass.cc:138`` (DOT dumps of the op graph),
+``details/multi_devices_graph_print_pass.cc:87`` (SSA graph printer), and
+the numeric sanitizer flag FLAGS_check_nan_inf (``operator.cc:725-737``).
+
+TPU-native: the "program" to inspect is the traced jaxpr and its lowered
+StableHLO/optimized-HLO forms; memory observability comes from the device
+allocator stats (the analogue of FLAGS_benchmark memory logs,
+``executor.cc:399-401``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+
+__all__ = [
+    "program_to_text",
+    "program_to_hlo",
+    "draw_graph",
+    "memory_summary",
+    "nan_guard",
+]
+
+
+def _as_fn(fn_or_model) -> Callable:
+    from paddle_tpu.framework import Model
+
+    if isinstance(fn_or_model, Model):
+        model = fn_or_model
+
+        def fn(variables, *args):
+            return model.apply(variables, *args, is_train=False)
+
+        return fn
+    return fn_or_model
+
+
+def program_to_text(fn_or_model, *example_args) -> str:
+    """Pretty-printed jaxpr of the traced program (the ProgramDesc text dump
+    analogue)."""
+    return str(jax.make_jaxpr(_as_fn(fn_or_model))(*example_args))
+
+
+def program_to_hlo(fn_or_model, *example_args, optimized: bool = False) -> str:
+    """StableHLO (default) or backend-optimized HLO text of the program —
+    what actually runs on the chip after XLA's fusion/layout passes."""
+    lowered = jax.jit(_as_fn(fn_or_model)).lower(*example_args)
+    if optimized:
+        return lowered.compile().as_text()
+    return lowered.as_text()
+
+
+def draw_graph(fn_or_model, *example_args, path: Optional[str] = None) -> str:
+    """DOT graph of the traced jaxpr (draw_block_graphviz /graph_viz_pass
+    parity): one node per equation, edges along var def-use."""
+    closed = jax.make_jaxpr(_as_fn(fn_or_model))(*example_args)
+    jaxpr = closed.jaxpr
+    lines = ["digraph program {", "  rankdir=TB;", "  node [shape=box, fontsize=10];"]
+    var_src: dict = {}
+    for i, var in enumerate(jaxpr.invars):
+        node = f"in{i}"
+        lines.append(f'  {node} [label="input {var.aval.str_short()}", shape=ellipse];')
+        var_src[var] = node
+    from jax.extend import core as jcore
+
+    for i, eqn in enumerate(jaxpr.eqns):
+        node = f"op{i}"
+        label = eqn.primitive.name
+        lines.append(f'  {node} [label="{label}"];')
+        for v in eqn.invars:
+            if not isinstance(v, jcore.Literal) and v in var_src:
+                lines.append(f"  {var_src[v]} -> {node};")
+        for v in eqn.outvars:
+            var_src[v] = node
+    for i, var in enumerate(jaxpr.outvars):
+        node = f"out{i}"
+        lines.append(f'  {node} [label="output", shape=ellipse];')
+        if not isinstance(var, jcore.Literal) and var in var_src:
+            lines.append(f"  {var_src[var]} -> {node};")
+    lines.append("}")
+    dot = "\n".join(lines)
+    if path:
+        with open(path, "w") as f:
+            f.write(dot)
+    return dot
+
+
+def memory_summary(device=None) -> dict:
+    """Device allocator stats (bytes_in_use, peak_bytes_in_use, ...) — the
+    memory_usage logging of FLAGS_benchmark. Returns {} where the backend
+    exposes no stats (CPU)."""
+    dev = device or jax.devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    return dict(stats) if stats else {}
+
+
+@contextlib.contextmanager
+def nan_guard() -> Iterator[None]:
+    """In-graph NaN detection (FLAGS_check_nan_inf parity at trace level):
+    enables jax_debug_nans within the context — any op producing NaN raises
+    with the offending primitive's traceback."""
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)

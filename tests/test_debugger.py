@@ -1,0 +1,55 @@
+"""Observability tests (reference analogues: debugger.draw_block_graphviz
+usage, graph_viz_pass tests)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import debugger
+
+
+def _model():
+    def net(x):
+        h = pt.layers.fc(x, size=8, act="relu")
+        return pt.layers.fc(h, size=2)
+
+    return pt.build(net)
+
+
+def test_program_to_text_and_hlo(rng):
+    model = _model()
+    x = jnp.asarray(rng.randn(4, 3).astype(np.float32))
+    variables = model.init(0, x)
+    txt = debugger.program_to_text(model, variables, x)
+    assert "dot_general" in txt
+    hlo = debugger.program_to_hlo(model, variables, x)
+    assert "stablehlo" in hlo or "mhlo" in hlo or "func" in hlo
+    opt = debugger.program_to_hlo(model, variables, x, optimized=True)
+    assert "fusion" in opt or "dot" in opt
+
+
+def test_draw_graph(tmp_path, rng):
+    model = _model()
+    x = jnp.asarray(rng.randn(2, 3).astype(np.float32))
+    variables = model.init(0, x)
+    path = str(tmp_path / "g.dot")
+    dot = debugger.draw_graph(model, variables, x, path=path)
+    assert dot.startswith("digraph")
+    assert "->" in dot
+    assert open(path).read() == dot
+
+
+def test_memory_summary():
+    stats = debugger.memory_summary()
+    assert isinstance(stats, dict)  # may be empty on CPU
+
+
+def test_nan_guard(rng):
+    import jax
+
+    with debugger.nan_guard():
+        with pytest.raises((FloatingPointError, Exception)):
+            jax.jit(lambda v: jnp.log(v - 10.0))(jnp.zeros((2,))).block_until_ready()
+    # flag restored
+    assert not jax.config.jax_debug_nans
