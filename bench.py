@@ -1,61 +1,260 @@
-"""Benchmark entry — prints ONE JSON line with the headline metric.
+"""Benchmark entry — ALWAYS prints exactly one JSON line on stdout.
 
-Headline config: ResNet-50 training throughput (images/sec) on synthetic
-224×224 data, the ``benchmark/fluid`` ResNet config (reference
-``benchmark/fluid/models/resnet.py``, metric printed as examples/sec at
-``fluid_benchmark.py:295-301``). ``vs_baseline`` is measured against the
-strongest published in-tree reference number for ResNet-50 training:
-84.08 img/s (2-socket Xeon 6148, ``benchmark/IntelOptimizedPaddle.md:41-45``;
-no GPU Fluid ResNet-50 number is published in-tree — see BASELINE.md).
+Headline: ResNet-50 training throughput (images/sec) on synthetic 224x224
+data — the ``benchmark/fluid`` ResNet config (reference
+``benchmark/fluid/models/resnet.py``; examples/sec metric discipline at
+``fluid_benchmark.py:295-301``). The JSON also carries Transformer training
+tokens/sec and computed MFU for both (model FLOPs from the compiled
+executable's cost analysis / chip peak).
+
+``vs_baseline`` is against the strongest published in-tree reference number
+for ResNet-50 training: 84.08 img/s (2S Xeon 6148,
+``benchmark/IntelOptimizedPaddle.md:41-45``; no GPU Fluid ResNet-50 number is
+published in-tree — see BASELINE.md).
+
+Robustness contract (the round-1 failure was rc=1 with no JSON): the parent
+process runs the measurement in a child subprocess under a wall-clock budget;
+if the default (TPU) backend hangs or errors, it retries on CPU with a tiny
+config; if that fails too it prints a degraded JSON line. Exit code is 0
+whenever a JSON line was printed.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import numpy as np
 
 BASELINE_IMG_PER_SEC = 84.08  # ResNet-50 train bs256, 2S Xeon 6148 (in-tree)
 
+# peak dense bf16 FLOP/s per chip, keyed by substring of device_kind
+_PEAK_BF16 = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5", 197e12),  # v5e / "TPU v5 lite"
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
 
-def main(batch_size: int = 64, warmup: int = 2, iters: int = 10) -> dict:
+
+def _peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    for key, peak in _PEAK_BF16:
+        if key in kind:
+            return peak
+    return None
+
+
+def _cost_flops(compiled) -> float:
+    """Per-step model FLOPs from the compiled executable's cost analysis."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca.get("flops", 0.0))
+    except Exception:
+        return 0.0
+
+
+def _bench_step(spec, batch_size: int, warmup: int, iters: int, rng_seed: int = 0):
+    """Compile + time one model's train step; returns (sec/step, flops/step)."""
     import jax
+    import numpy as np
 
-    from paddle_tpu import models
-
-    spec = models.get_model("resnet", dataset="flowers", depth=50, class_dim=1000)
-    rng = np.random.RandomState(0)
+    rng = np.random.RandomState(rng_seed)
     batch = spec.synth_batch(batch_size, rng)
     variables = spec.model.init(0, *batch)
     opt = spec.optimizer()
     opt_state = opt.create_state(variables.params)
-    step_fn = jax.jit(opt.minimize(spec.model), donate_argnums=(0, 1))
-    dev_batch = tuple(jax.device_put(b) for b in batch)
+    step = jax.jit(opt.minimize(spec.model), donate_argnums=(0, 1))
+    dev_batch = tuple(jax.device_put(np.asarray(b)) for b in batch)
+    key = jax.random.PRNGKey(rng_seed)  # dropout etc. in train mode
+
+    lowered = step.lower(variables, opt_state, *dev_batch, rng=key)
+    compiled = lowered.compile()
+    flops = _cost_flops(compiled)
 
     v, o = variables, opt_state
+    out = None
     for _ in range(warmup):
-        out = step_fn(v, o, *dev_batch)
+        out = compiled(v, o, *dev_batch, rng=key)
         v, o = out.variables, out.opt_state
-    jax.block_until_ready(out.loss)
+    jax.block_until_ready(out.loss if out is not None else v)
 
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = step_fn(v, o, *dev_batch)
+        out = compiled(v, o, *dev_batch, rng=key)
         v, o = out.variables, out.opt_state
     jax.block_until_ready(out.loss)
-    dt = time.perf_counter() - t0
+    dt = (time.perf_counter() - t0) / iters
+    return dt, flops
 
-    img_per_sec = batch_size * iters / dt
+
+def child_main(tiny: bool, force_cpu: bool = False) -> None:
+    """Runs measurements, prints ONE JSON line on stdout."""
+    import jax
+
+    if force_cpu:
+        # The container's sitecustomize hard-sets jax_platforms="axon,cpu" at
+        # interpreter startup (env JAX_PLATFORMS is overridden); backends init
+        # lazily, so an explicit config update before first use still wins.
+        jax.config.update("jax_platforms", "cpu")
+
+    try:  # persistent compile cache (also set via env by the parent)
+        jax.config.update("jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache"))
+    except Exception:
+        pass
+
+    from paddle_tpu import models
+
+    deadline = time.monotonic() + float(os.environ.get("PT_BENCH_CHILD_BUDGET_S", "420"))
+    dev = jax.devices()[0]
+    peak = _peak_flops(dev.device_kind)
     result = {
         "metric": "resnet50_train_images_per_sec",
-        "value": round(img_per_sec, 2),
+        "value": 0.0,
         "unit": "images/sec",
-        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+        "vs_baseline": 0.0,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "notes": [],
     }
+    if tiny:
+        result["notes"].append("cpu_fallback_tiny_config")
+
+    # --- ResNet-50 ---
+    bs = 16 if tiny else int(os.environ.get("PT_BENCH_RESNET_BS", "64"))
+    iters = 3 if tiny else 10
+    try:
+        spec = models.get_model("resnet", dataset="flowers", depth=50, class_dim=1000)
+        dt, flops = _bench_step(spec, bs, warmup=1, iters=iters)
+        result["value"] = round(bs / dt, 2)
+        result["vs_baseline"] = round(bs / dt / BASELINE_IMG_PER_SEC, 3)
+        if peak and flops:
+            result["resnet_mfu"] = round(flops / dt / peak, 4)
+        print(f"resnet50: {result['value']} img/s", file=sys.stderr)
+    except Exception as e:  # keep going — transformer number still valuable
+        result["notes"].append(f"resnet_failed: {type(e).__name__}: {e}"[:300])
+
+    # --- Transformer ---
+    if time.monotonic() < deadline:
+        tbs, tseq = (4, 64) if tiny else (32, 256)
+        titers = 3 if tiny else 10
+        try:
+            tspec = models.get_model("transformer", seq_len=tseq)
+            dt, flops = _bench_step(tspec, tbs, warmup=1, iters=titers)
+            result["transformer_tokens_per_sec"] = round(tbs * tseq / dt, 1)
+            if peak and flops:
+                result["transformer_mfu"] = round(flops / dt / peak, 4)
+            print(f"transformer: {result['transformer_tokens_per_sec']} tok/s", file=sys.stderr)
+        except Exception as e:
+            result["notes"].append(f"transformer_failed: {type(e).__name__}: {e}"[:300])
+    else:
+        result["notes"].append("transformer_skipped_budget")
+
+    print(json.dumps(result))
+
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _run_child(extra_env: dict, timeout: float):
+    """Run a measurement child; returns parsed JSON dict or None."""
+    env = {**os.environ, **extra_env}
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(_REPO, ".jax_cache"))
+    try:
+        args = [sys.executable, os.path.abspath(__file__), "--child"]
+        if extra_env.get("PT_BENCH_FORCE_CPU"):
+            args += ["--tiny", "--cpu"]
+        proc = subprocess.run(
+            args,
+            env=env,
+            cwd=_REPO,
+            timeout=timeout,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"bench child timed out after {timeout:.0f}s", file=sys.stderr)
+        return None
+    sys.stderr.write(proc.stderr[-2000:])
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+            if isinstance(parsed, dict) and "metric" in parsed:
+                return parsed
+        except (json.JSONDecodeError, ValueError):
+            continue
+    print(f"bench child rc={proc.returncode}, no JSON found", file=sys.stderr)
+    return None
+
+
+def _probe_default_backend(timeout: float = 150.0) -> bool:
+    """Cheap liveness check: can the default (TPU) backend initialize and run
+    a matmul at all? The round-1 failure mode was an axon tunnel that hangs
+    indefinitely on backend init — don't burn the main budget on that."""
+    code = (
+        "import jax, jax.numpy as jnp; d = jax.devices(); "
+        "x = jnp.ones((128, 128)); jax.block_until_ready(x @ x); "
+        "print('PROBE_OK', d[0].platform, d[0].device_kind)"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout,
+            capture_output=True,
+            text=True,
+            env=dict(os.environ),
+        )
+    except subprocess.TimeoutExpired:
+        print(f"backend probe timed out after {timeout:.0f}s", file=sys.stderr)
+        return False
+    ok = proc.returncode == 0 and "PROBE_OK" in proc.stdout
+    print(f"backend probe: {'ok' if ok else 'FAILED'} {proc.stdout.strip()}", file=sys.stderr)
+    return ok
+
+
+def main() -> dict:
+    budget = float(os.environ.get("PT_BENCH_BUDGET_S", "900"))
+    t0 = time.monotonic()
+
+    result = None
+    if _probe_default_backend():
+        child_budget = min(480.0, budget * 0.6)
+        result = _run_child(
+            {"PT_BENCH_CHILD_BUDGET_S": str(child_budget * 0.85)}, timeout=child_budget
+        )
+
+    if result is None or (result.get("value", 0) == 0 and "transformer_tokens_per_sec" not in result):
+        remaining = budget - (time.monotonic() - t0) - 15
+        if remaining > 60:
+            fallback = _run_child(
+                {
+                    "PT_BENCH_FORCE_CPU": "1",
+                    "PT_BENCH_CHILD_BUDGET_S": str(min(remaining * 0.85, 300)),
+                },
+                timeout=min(remaining, 360),
+            )
+            if fallback is not None:
+                result = fallback
+
+    if result is None:
+        result = {
+            "metric": "resnet50_train_images_per_sec",
+            "value": 0.0,
+            "unit": "images/sec",
+            "vs_baseline": 0.0,
+            "notes": ["all_bench_children_failed_or_timed_out"],
+        }
     print(json.dumps(result))
     return result
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        child_main(tiny="--tiny" in sys.argv, force_cpu="--cpu" in sys.argv)
+    else:
+        main()

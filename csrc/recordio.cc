@@ -203,7 +203,9 @@ PTRecordScanner* pt_recordio_scanner_open(const char* path) {
 // until the next call; -1 on EOF; -2 on error.
 int64_t pt_recordio_scanner_next(PTRecordScanner* s, const char** data) {
   if (!s->f) return -2;
-  if (s->cursor >= s->chunk.records.size()) {
+  // loop: a chunk that passes CRC but holds zero records must not be
+  // indexed (OOB read) — keep refilling until a record or EOF/error
+  while (s->cursor >= s->chunk.records.size()) {
     if (!s->load_chunk()) return s->eof ? -1 : -2;
   }
   const std::string& rec = s->chunk.records[s->cursor++];

@@ -148,5 +148,11 @@ def update_meta(path_or_root: str, updates: dict) -> None:
     with open(meta_path) as f:
         meta = json.load(f)
     meta.update(updates)
-    with open(meta_path, "w") as f:
+    # atomic publish: a crash mid-write must not corrupt the latest
+    # checkpoint's metadata (auto-resume reads it)
+    tmp_path = meta_path + ".tmp"
+    with open(tmp_path, "w") as f:
         json.dump(meta, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp_path, meta_path)

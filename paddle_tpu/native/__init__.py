@@ -10,6 +10,7 @@ saved program). The library builds from ``csrc/`` via make on first import
 from __future__ import annotations
 
 import ctypes
+import fcntl
 import os
 import subprocess
 from typing import Iterator, List, Optional, Sequence, Tuple
@@ -30,7 +31,16 @@ def lib() -> ctypes.CDLL:
     if _lib is not None:
         return _lib
     if not os.path.exists(_LIB_PATH):
-        subprocess.run(["make", "-C", _CSRC], check=True, capture_output=True)
+        # file lock: concurrent importers (multi-host trainers, parallel
+        # tests) must not race make and dlopen a half-written .so
+        lock_path = os.path.join(_CSRC, ".build.lock")
+        with open(lock_path, "w") as lock_f:
+            fcntl.flock(lock_f, fcntl.LOCK_EX)
+            try:
+                if not os.path.exists(_LIB_PATH):
+                    subprocess.run(["make", "-C", _CSRC], check=True, capture_output=True)
+            finally:
+                fcntl.flock(lock_f, fcntl.LOCK_UN)
     _lib = ctypes.CDLL(_LIB_PATH)
     # recordio
     _lib.pt_recordio_writer_open.restype = ctypes.c_void_p

@@ -161,13 +161,19 @@ def xmap_readers(mapper: Callable, reader: Reader, process_num: int, buffer_size
                 in_q.put(end)
 
         def work():
-            while True:
-                got = in_q.get()
-                if got is end:
-                    out_q.put(end)
-                    return
-                i, item = got
-                out_q.put((i, mapper(item)))
+            # a mapper exception must reach the consumer (not strand it in
+            # out_q.get() forever) — mirror buffered()'s _RaisedInProducer
+            try:
+                while True:
+                    got = in_q.get()
+                    if got is end:
+                        out_q.put(end)
+                        return
+                    i, item = got
+                    out_q.put((i, mapper(item)))
+            except BaseException as e:  # noqa: BLE001
+                out_q.put(_RaisedInProducer(e))
+                out_q.put(end)
 
         threading.Thread(target=feed, daemon=True).start()
         workers = [threading.Thread(target=work, daemon=True) for _ in range(process_num)]
@@ -183,6 +189,8 @@ def xmap_readers(mapper: Callable, reader: Reader, process_num: int, buffer_size
                 if got is end:
                     finished += 1
                     continue
+                if isinstance(got, _RaisedInProducer):
+                    raise got.exc
                 i, val = got
                 pending[i] = val
                 while next_i in pending:
@@ -196,6 +204,8 @@ def xmap_readers(mapper: Callable, reader: Reader, process_num: int, buffer_size
                 if got is end:
                     finished += 1
                     continue
+                if isinstance(got, _RaisedInProducer):
+                    raise got.exc
                 yield got[1]
 
     return xreader
