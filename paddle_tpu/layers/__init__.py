@@ -394,6 +394,83 @@ def data(name: str, shape: Sequence[int], dtype="float32", lod_level: int = 0):
     return jax.ShapeDtypeStruct(tuple(s for s in shape), _d.convert(dtype))
 
 
+# ---------------------------------------------------------------------------
+# Extended catalog: the tail of the fluid layers surface (reference
+# layers/nn.py:30 export list + tensor.py/control_flow.py/metric_op.py/io.py)
+# ---------------------------------------------------------------------------
+
+# param-creating wrappers, tensor helpers, control-flow adapters, metric ops
+from paddle_tpu.layers.extended import *  # noqa: F401,F403
+from paddle_tpu.layers.extended import __all__ as _ext_all
+
+# reader-pipeline layer API (py_reader / double_buffer / open_files / ...)
+from paddle_tpu.layers.io_layers import *  # noqa: F401,F403
+from paddle_tpu.layers.io_layers import __all__ as _io_all
+
+# functional op re-exports under their fluid names
+from paddle_tpu.ops.nn import (  # noqa: F401
+    multiplex,
+    pad_constant_like,
+    rank_loss,
+    dice_loss,
+    mean_iou,
+)
+from paddle_tpu.ops.sequence import (  # noqa: F401
+    sequence_pad,
+    sequence_concat,
+    sequence_enumerate,
+    sequence_expand_as,
+    sequence_mask,
+    sequence_reshape,
+    sequence_scatter,
+    sequence_slice,
+    lod_reset,
+    reorder_by_rank as reorder_lod_tensor_by_rank,
+)
+from paddle_tpu.ops.control_flow import (  # noqa: F401
+    while_loop,
+    cond,
+    switch_case,
+    case,
+    TensorArray,
+    create_array,
+    array_write,
+    array_read,
+    array_length,
+    static_rnn,
+    dynamic_rnn,
+    rank_by_length as lod_rank_table,
+    beam_search,
+    beam_search_decode,
+    greedy_search,
+)
+from paddle_tpu.ops.losses import (  # noqa: F401
+    linear_chain_crf,
+    crf_decoding,
+    edit_distance,
+    ctc_loss as warpctc,
+    ctc_greedy_decode as ctc_greedy_decoder,
+)
+from paddle_tpu.ops.detection import (  # noqa: F401
+    prior_box,
+    anchor_generator,
+    bipartite_match,
+    target_assign,
+    box_coder,
+    iou_similarity,
+    multiclass_nms,
+)
+from paddle_tpu.lr_scheduler import (  # noqa: F401
+    exponential_decay,
+    natural_exp_decay,
+    inverse_time_decay,
+    polynomial_decay,
+    piecewise_decay,
+    noam_decay,
+    cosine_decay,
+    append_LARS,
+)
+
 # explicit export surface: layer fns defined here + the functional ops
 # re-exported above (star-import of ops.math plus the named nn/sequence
 # imports) — NOT modules/typing names
@@ -412,5 +489,23 @@ _OP_REEXPORTS = [
     "resize_bilinear", "resize_nearest", "pixel_shuffle",
     "sequence_pool", "sequence_softmax", "sequence_reverse",
     "sequence_first_step", "sequence_last_step", "sequence_expand",
+    # extended functional re-exports
+    "multiplex", "pad_constant_like", "rank_loss", "dice_loss", "mean_iou",
+    "sequence_pad", "sequence_concat", "sequence_enumerate", "sequence_expand_as",
+    "sequence_mask", "sequence_reshape", "sequence_scatter", "sequence_slice",
+    "lod_reset", "reorder_lod_tensor_by_rank",
+    "while_loop", "cond", "switch_case", "case", "TensorArray", "create_array",
+    "array_write", "array_read", "array_length", "static_rnn", "dynamic_rnn",
+    "lod_rank_table", "beam_search", "beam_search_decode", "greedy_search",
+    "linear_chain_crf", "crf_decoding", "edit_distance", "warpctc",
+    "ctc_greedy_decoder",
+    "prior_box", "anchor_generator", "bipartite_match", "target_assign",
+    "box_coder", "iou_similarity", "multiclass_nms",
+    "exponential_decay", "natural_exp_decay", "inverse_time_decay",
+    "polynomial_decay", "piecewise_decay", "noam_decay", "cosine_decay",
+    "append_LARS",
 ]
-__all__ = _LOCAL_LAYERS + _OP_REEXPORTS + list(_om_mod.__all__)
+__all__ = (
+    _LOCAL_LAYERS + _OP_REEXPORTS + list(_om_mod.__all__)
+    + list(_ext_all) + list(_io_all)
+)

@@ -1,0 +1,586 @@
+"""Extended layer catalog — the tail of the fluid ``layers`` surface.
+
+Parameter-creating wrappers (conv3d family, NCE, hsigmoid, row_conv, RNN
+units, LSTMP), tensor helpers (assign/sums/fill_constant_batch_size_like...),
+block-style control-flow adapters (While/Switch/IfElse/StaticRNN/DynamicRNN),
+and metric ops (auc, chunk_eval).
+
+Reference: ``python/paddle/fluid/layers/nn.py:30`` export list,
+``layers/tensor.py``, ``layers/control_flow.py``, ``layers/metric_op.py``.
+Each wrapper follows the fluid call contract; the body is the TPU-native
+functional op from ``paddle_tpu.ops``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import initializer as init_mod
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.framework import (
+    ParamAttr,
+    create_parameter,
+    create_state,
+    name_scope,
+    update_state,
+)
+from paddle_tpu.ops import control_flow as ocf
+from paddle_tpu.ops import nn as on
+from paddle_tpu.ops import nn3d as o3d
+from paddle_tpu.ops import rnn as orn
+from paddle_tpu.ops import sequence as oseq
+from paddle_tpu.ops import vision as ovis
+
+__all__ = [
+    # param-creating layers
+    "conv3d",
+    "conv3d_transpose",
+    "pool3d",
+    "nce",
+    "hsigmoid",
+    "row_conv",
+    "gru_unit",
+    "lstm_unit",
+    "dynamic_lstmp",
+    # vision
+    "image_resize",
+    "image_resize_short",
+    "random_crop",
+    "roi_pool",
+    "im2sequence",
+    # tensor helpers
+    "assign",
+    "create_tensor",
+    "create_global_var",
+    "fill_constant_batch_size_like",
+    "sums",
+    "is_empty",
+    "autoincreased_step_counter",
+    "Print",
+    # control-flow adapters
+    "While",
+    "Switch",
+    "IfElse",
+    "StaticRNN",
+    "DynamicRNN",
+    # metrics
+    "auc",
+    "chunk_eval",
+]
+
+
+def _act(x, act: Optional[str]):
+    if act is None:
+        return x
+    from paddle_tpu.ops import math as om
+
+    return getattr(om, act)(x)
+
+
+# ---------------------------------------------------------------------------
+# 3-D conv family
+# ---------------------------------------------------------------------------
+
+
+def conv3d(
+    input: jax.Array,
+    num_filters: int,
+    filter_size: Union[int, Sequence[int]],
+    stride: Union[int, Sequence[int]] = 1,
+    padding: Union[int, Sequence[int]] = 0,
+    dilation: Union[int, Sequence[int]] = 1,
+    groups: int = 1,
+    param_attr=None,
+    bias_attr=None,
+    act: Optional[str] = None,
+    name: Optional[str] = None,
+) -> jax.Array:
+    """fluid ``layers.conv3d`` (reference ``operators/conv_op.cc`` conv3d
+    registration) over NDHWC input."""
+    fd, fh, fw = o3d._triple(filter_size)
+    in_c = input.shape[-1]
+    with name_scope(name or "conv3d"):
+        w = create_parameter(
+            [fd, fh, fw, in_c // groups, num_filters],
+            input.dtype,
+            name="w",
+            attr=param_attr,
+            default_initializer=init_mod.MSRA(),
+        )
+        out = o3d.conv3d(input, w, stride, padding, dilation, groups)
+        if bias_attr is not False:
+            b = create_parameter(
+                [num_filters], input.dtype, name="b", attr=bias_attr,
+                default_initializer=init_mod.Constant(0.0),
+            )
+            out = out + b
+        return _act(out, act)
+
+
+def conv3d_transpose(
+    input: jax.Array,
+    num_filters: int,
+    filter_size: Union[int, Sequence[int]],
+    stride: Union[int, Sequence[int]] = 1,
+    padding: Union[int, Sequence[int]] = 0,
+    param_attr=None,
+    bias_attr=None,
+    act: Optional[str] = None,
+    name: Optional[str] = None,
+) -> jax.Array:
+    """fluid ``layers.conv3d_transpose`` (reference
+    ``conv_transpose_op.cc``)."""
+    fd, fh, fw = o3d._triple(filter_size)
+    in_c = input.shape[-1]
+    with name_scope(name or "conv3d_transpose"):
+        w = create_parameter(
+            [fd, fh, fw, in_c, num_filters],
+            input.dtype,
+            name="w",
+            attr=param_attr,
+            default_initializer=init_mod.MSRA(),
+        )
+        out = o3d.conv3d_transpose(input, w, stride, padding)
+        if bias_attr is not False:
+            b = create_parameter(
+                [num_filters], input.dtype, name="b", attr=bias_attr,
+                default_initializer=init_mod.Constant(0.0),
+            )
+            out = out + b
+        return _act(out, act)
+
+
+pool3d = o3d.pool3d
+
+# vision re-exports (no parameters)
+image_resize = ovis.image_resize
+image_resize_short = ovis.image_resize_short
+random_crop = ovis.random_crop
+roi_pool = ovis.roi_pool
+im2sequence = ovis.im2sequence
+
+
+# ---------------------------------------------------------------------------
+# Sampled / hierarchical losses
+# ---------------------------------------------------------------------------
+
+
+def nce(
+    input: jax.Array,
+    label: jax.Array,
+    num_total_classes: int,
+    num_neg_samples: int = 10,
+    rng: Optional[jax.Array] = None,
+    param_attr=None,
+    bias_attr=None,
+    name: Optional[str] = None,
+) -> jax.Array:
+    """fluid ``layers.nce`` (reference ``nce_op.cc`` /
+    ``layers/nn.py`` nce): creates the [num_classes, D] class matrix and
+    returns the per-row NCE loss."""
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    d = input.shape[-1]
+    with name_scope(name or "nce"):
+        w = create_parameter(
+            [num_total_classes, d], input.dtype, name="w", attr=param_attr,
+            default_initializer=init_mod.Xavier(),
+        )
+        b = None
+        if bias_attr is not False:
+            b = create_parameter(
+                [num_total_classes], input.dtype, name="b", attr=bias_attr,
+                default_initializer=init_mod.Constant(0.0),
+            )
+        return on.nce_loss(input, w, b, label, num_neg_samples, rng, num_total_classes)
+
+
+def hsigmoid(
+    input: jax.Array,
+    label: jax.Array,
+    num_classes: int,
+    param_attr=None,
+    bias_attr=None,
+    name: Optional[str] = None,
+) -> jax.Array:
+    """fluid ``layers.hsigmoid`` (reference
+    ``hierarchical_sigmoid_op.cc``): complete-binary-tree hierarchical
+    softmax; creates [num_classes-1, D] internal-node weights."""
+    d = input.shape[-1]
+    with name_scope(name or "hsigmoid"):
+        w = create_parameter(
+            [num_classes - 1, d], input.dtype, name="w", attr=param_attr,
+            default_initializer=init_mod.Xavier(),
+        )
+        b = None
+        if bias_attr is not False:
+            b = create_parameter(
+                [num_classes - 1], input.dtype, name="b", attr=bias_attr,
+                default_initializer=init_mod.Constant(0.0),
+            )
+        return on.hsigmoid_loss(input, w, b, label, num_classes)
+
+
+def row_conv(
+    input: jax.Array,
+    future_context_size: int,
+    lengths: Optional[jax.Array] = None,
+    param_attr=None,
+    act: Optional[str] = None,
+    name: Optional[str] = None,
+) -> jax.Array:
+    """fluid ``layers.row_conv`` (reference ``row_conv_op.cc``)."""
+    d = input.shape[-1]
+    with name_scope(name or "row_conv"):
+        w = create_parameter(
+            [future_context_size + 1, d], input.dtype, name="w", attr=param_attr,
+            default_initializer=init_mod.Xavier(),
+        )
+        return _act(on.row_conv(input, w, lengths), act)
+
+
+# ---------------------------------------------------------------------------
+# RNN units
+# ---------------------------------------------------------------------------
+
+
+def gru_unit(
+    input: jax.Array,
+    hidden: jax.Array,
+    size: int,
+    param_attr=None,
+    bias_attr=None,
+    name: Optional[str] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """fluid ``layers.gru_unit`` (reference ``gru_unit_op.cc``): one GRU step.
+    ``size`` is 3*H (fluid contract); ``input`` [B, 3H] is the pre-projected
+    input. Creates the [H, 3H] recurrent weight + [3H] bias."""
+    h = size // 3
+    enforce(hidden.shape[-1] == h, f"hidden dim {hidden.shape[-1]} != size/3 {h}")
+    with name_scope(name or "gru_unit"):
+        w = create_parameter(
+            [h, 3 * h], input.dtype, name="w", attr=param_attr,
+            default_initializer=init_mod.Xavier(),
+        )
+        bias = None
+        if bias_attr is not False:
+            bias = create_parameter(
+                [3 * h], input.dtype, name="b", attr=bias_attr,
+                default_initializer=init_mod.Constant(0.0),
+            )
+        new_h, _ = orn.gru_unit(input, hidden, w, bias)
+        return new_h, new_h
+
+
+def lstm_unit(
+    x_t: jax.Array,
+    hidden_t_prev: jax.Array,
+    cell_t_prev: jax.Array,
+    forget_bias: float = 0.0,
+    param_attr=None,
+    bias_attr=None,
+    name: Optional[str] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """fluid ``layers.lstm_unit`` (reference ``lstm_unit_op.cc`` via an fc on
+    concat(x, h)): one LSTM step, returns (hidden, cell)."""
+    d = x_t.shape[-1]
+    h = hidden_t_prev.shape[-1]
+    with name_scope(name or "lstm_unit"):
+        w = create_parameter(
+            [d + h, 4 * h], x_t.dtype, name="w", attr=param_attr,
+            default_initializer=init_mod.Xavier(),
+        )
+        bias = None
+        if bias_attr is not False:
+            bias = create_parameter(
+                [4 * h], x_t.dtype, name="b", attr=bias_attr,
+                default_initializer=init_mod.Constant(0.0),
+            )
+        proj = jnp.matmul(
+            jnp.concatenate([x_t, hidden_t_prev], axis=-1), w,
+            preferred_element_type=jnp.float32,
+        ).astype(x_t.dtype)
+        st = orn.lstm_cell(
+            proj, orn.LSTMState(hidden_t_prev, cell_t_prev),
+            jnp.zeros((h, 4 * h), x_t.dtype), bias, forget_bias,
+        )
+        return st.h, st.c
+
+
+def dynamic_lstmp(
+    input: jax.Array,
+    size: int,
+    proj_size: int,
+    lengths: Optional[jax.Array] = None,
+    param_attr=None,
+    bias_attr=None,
+    cell_clip: Optional[float] = None,
+    proj_clip: Optional[float] = None,
+    proj_activation: Optional[str] = None,
+    name: Optional[str] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """fluid ``layers.dynamic_lstmp`` (reference ``lstmp_op.cc``): projected
+    LSTM over a padded batch. ``size`` is 4*H; ``input`` [B, T, 4H] is
+    pre-projected (fluid contract). Returns (proj_out [B,T,P], cell-state
+    outputs' final step is in the state)."""
+    h = size // 4
+    with name_scope(name or "dynamic_lstmp"):
+        w_hh = create_parameter(
+            [proj_size, 4 * h], input.dtype, name="w", attr=param_attr,
+            default_initializer=init_mod.Xavier(),
+        )
+        w_proj = create_parameter(
+            [h, proj_size], input.dtype, name="w_proj", attr=param_attr,
+            default_initializer=init_mod.Xavier(),
+        )
+        bias = None
+        if bias_attr is not False:
+            bias = create_parameter(
+                [4 * h], input.dtype, name="b", attr=bias_attr,
+                default_initializer=init_mod.Constant(0.0),
+            )
+        outs, final = orn.dynamic_lstmp(
+            input, None, w_hh, w_proj, bias, lengths,
+            cell_clip=cell_clip, proj_clip=proj_clip, proj_act=proj_activation,
+        )
+        return outs, final
+
+
+# ---------------------------------------------------------------------------
+# Tensor helpers (reference layers/tensor.py)
+# ---------------------------------------------------------------------------
+
+
+def assign(input) -> jax.Array:
+    """fluid ``layers.assign`` (reference ``assign_op.cc``): value copy."""
+    return jnp.asarray(input)
+
+
+def create_tensor(dtype="float32", name: Optional[str] = None) -> jax.Array:
+    """fluid ``layers.create_tensor``. Under tracing there are no empty vars;
+    returns a 0-d placeholder of ``dtype`` for later ``assign``-style use."""
+    from paddle_tpu.core import dtypes as dmod
+
+    return jnp.zeros((), dmod.convert(dtype))
+
+
+def create_global_var(
+    shape: Sequence[int], value: float, dtype="float32",
+    persistable: bool = False, name: Optional[str] = None,
+) -> jax.Array:
+    """fluid ``layers.create_global_var``: a named mutable state entry (the
+    startup-program global var analogue); lives in Model state."""
+    from paddle_tpu.core import dtypes as dmod
+
+    nm = name or "global_var"
+    return create_state(
+        nm, shape, dtype, init=lambda s, d: jnp.full(s, value, dmod.convert(dtype))
+    )
+
+
+def fill_constant_batch_size_like(input: jax.Array, shape: Sequence[int], dtype, value) -> jax.Array:
+    """Reference ``fill_constant_batch_size_like_op.cc``: constant tensor
+    whose leading dim tracks the batch size of ``input``."""
+    from paddle_tpu.core import dtypes as dmod
+
+    shp = (input.shape[0],) + tuple(int(s) for s in shape[1:])
+    return jnp.full(shp, value, dmod.convert(dtype))
+
+
+def sums(inputs: Sequence[jax.Array]) -> jax.Array:
+    """Reference ``sum_op.cc`` n-ary add."""
+    out = inputs[0]
+    for x in inputs[1:]:
+        out = out + x
+    return out
+
+
+def is_empty(x: jax.Array) -> bool:
+    """Reference ``is_empty_op.cc``. Static under XLA: shapes are known at
+    trace time, so this is a Python bool."""
+    return x.size == 0
+
+
+def autoincreased_step_counter(counter_name: str = "@STEP_COUNTER@", begin: int = 1, step: int = 1) -> jax.Array:
+    """Reference ``layers/nn.py`` autoincreased_step_counter: a persistent
+    int64 counter bumped every apply (used by LR schedules)."""
+    cur = create_state(
+        counter_name, (), "int64", init=lambda s, d: jnp.asarray(begin - step, d)
+    )
+    new = cur + step
+    update_state(counter_name, new)
+    return new
+
+
+def Print(input: jax.Array, message: str = "", summarize: int = -1, **_ignored) -> jax.Array:
+    """fluid ``layers.Print`` (reference ``print_op.cc``): debug-print the
+    tensor inside the compiled program, pass the value through."""
+    jax.debug.print(message + "{x}", x=input)
+    return input
+
+
+# ---------------------------------------------------------------------------
+# Block-style control-flow adapters
+# ---------------------------------------------------------------------------
+
+
+class While:
+    """Functional adapter for fluid's block-style ``While`` (reference
+    ``layers/control_flow.py`` While / ``while_op.cc:36``). The fluid idiom
+
+        while_op = While(cond)
+        with while_op.block(): ...
+
+    appends ops into a sub-block; under tracing the loop body is a function:
+
+        While(cond_fn)(body_fn, init_vars)
+    """
+
+    def __init__(self, cond: Callable):
+        self.cond = cond
+
+    def __call__(self, body: Callable, loop_vars):
+        return ocf.while_loop(self.cond, body, loop_vars)
+
+
+class Switch:
+    """Functional adapter for fluid ``Switch`` blocks: accumulate
+    (condition, fn) cases, then ``build(*operands)`` evaluates the first
+    true branch (reference ``layers/control_flow.py`` Switch)."""
+
+    def __init__(self):
+        self._cases = []
+        self._default: Optional[Callable] = None
+
+    def case(self, condition, fn: Callable) -> "Switch":
+        self._cases.append((condition, fn))
+        return self
+
+    def default(self, fn: Callable) -> "Switch":
+        self._default = fn
+        return self
+
+    def build(self, *operands):
+        return ocf.case(self._cases, self._default, *operands)
+
+
+class IfElse:
+    """Functional adapter for fluid ``IfElse`` (reference
+    ``conditional_block_op.cc``): IfElse(pred)(true_fn, false_fn, *ops)."""
+
+    def __init__(self, pred):
+        self.pred = pred
+
+    def __call__(self, true_fn: Callable, false_fn: Callable, *operands):
+        return ocf.cond(self.pred, true_fn, false_fn, *operands)
+
+
+class StaticRNN:
+    """Adapter over :func:`paddle_tpu.ops.control_flow.static_rnn`: fluid's
+    step-block becomes a step function ``step(carry, x_t) -> (carry, out)``."""
+
+    def __init__(self, step: Callable):
+        self.step = step
+
+    def __call__(self, init_carry, xs_time_major):
+        return ocf.static_rnn(self.step, init_carry, xs_time_major)
+
+
+class DynamicRNN:
+    """Adapter over :func:`paddle_tpu.ops.control_flow.dynamic_rnn` —
+    length-masked scan (the LoD-aware dynamic RNN, reference
+    ``recurrent_op.cc``)."""
+
+    def __init__(self, step: Callable):
+        self.step = step
+
+    def __call__(self, init_carry, xs, lengths):
+        return ocf.dynamic_rnn(self.step, init_carry, xs, lengths)
+
+
+# ---------------------------------------------------------------------------
+# Metric ops (reference layers/metric_op.py)
+# ---------------------------------------------------------------------------
+
+
+def auc(input: jax.Array, label: jax.Array, num_thresholds: int = 200) -> jax.Array:
+    """Batch ROC-AUC (reference ``auc_op.cc``): threshold-bucketed
+    TP/FP counting, trapezoid-free ROC summation (matches the reference's
+    discrete formulation)."""
+    pos_prob = input[:, 1] if input.ndim == 2 and input.shape[1] == 2 else input.reshape(-1)
+    lab = label.reshape(-1).astype(jnp.float32)
+    thresholds = jnp.arange(num_thresholds, dtype=jnp.float32) / (num_thresholds - 1)
+    pred = pos_prob.reshape(-1)[None, :] >= thresholds[:, None]  # [T, B]
+    tp = jnp.sum(pred * lab[None, :], axis=1)
+    fp = jnp.sum(pred * (1.0 - lab[None, :]), axis=1)
+    tot_pos = jnp.maximum(jnp.sum(lab), 1e-6)
+    tot_neg = jnp.maximum(jnp.sum(1.0 - lab), 1e-6)
+    tpr = tp / tot_pos
+    fpr = fp / tot_neg
+    # integrate TPR over FPR; lexsort so equal-FPR ties order by TPR (the
+    # ROC staircase's upper boundary — plain argsort breaks ties arbitrarily)
+    order = jnp.lexsort((tpr, fpr))
+    fpr_s, tpr_s = fpr[order], tpr[order]
+    return jnp.sum((fpr_s[1:] - fpr_s[:-1]) * 0.5 * (tpr_s[1:] + tpr_s[:-1]))
+
+
+def chunk_eval(
+    inferred: jax.Array,
+    label: jax.Array,
+    lengths: jax.Array,
+    num_chunk_types: int,
+    chunk_scheme: str = "IOB",
+):
+    """Chunk-level precision/recall counting (reference ``chunk_eval_op.cc``,
+    IOB scheme): a chunk of type c starts at B-c or at I-c following a
+    different type; two chunks match when (start, end, type) all agree.
+    Tags encode as ``type * num_tag + tag`` with tag B=0, I=1; ``O`` is the
+    single id ``num_chunk_types * 2``.
+
+    Returns (num_infer_chunks, num_label_chunks, num_correct_chunks) int32
+    scalars — precision/recall/F1 are host-side division (fluid's metric
+    accumulators do the same)."""
+    enforce(chunk_scheme == "IOB", "only IOB scheme is implemented")
+    t = inferred.shape[1]
+    valid = oseq.length_mask(lengths, t, jnp.bool_)
+
+    def starts_types(tags):
+        o_id = num_chunk_types * 2
+        is_o = (tags >= o_id) | (tags < 0)
+        typ = jnp.where(is_o, -1, tags // 2)
+        is_b = (~is_o) & (tags % 2 == 0)
+        prev_typ = jnp.pad(typ[:, :-1], ((0, 0), (1, 0)), constant_values=-1)
+        start = valid & (~is_o) & (is_b | (typ != prev_typ))
+        # a chunk at position t spans while typ stays equal and no new B
+        return start, typ
+
+    si, ti = starts_types(inferred)
+    sl, tl = starts_types(label)
+    # chunk id per position: cumulative count of starts (per row); a chunk is
+    # identified by (row, start-position, type, end-position). Two chunks
+    # correct iff both sequences start a chunk of the same type at the same
+    # position AND the chunk boundaries agree: positions until the next
+    # start/O transition match.
+    ni = jnp.sum(si.astype(jnp.int32))
+    nl = jnp.sum(sl.astype(jnp.int32))
+    # boundary signature: next chunk-start-or-invalid position after t
+    def end_marks(start, typ):
+        # position where a chunk (starting at t) ends: scan from the right
+        idx = jnp.arange(t)[None, :]
+        is_boundary = start | ~valid | (typ < 0)
+        # for each t, the smallest boundary position > t
+        big = jnp.where(is_boundary, idx, t + 1)
+        rev = jnp.flip(big, axis=1)
+        nxt = jax.lax.associative_scan(jnp.minimum, rev, axis=1)
+        nxt = jnp.flip(nxt, axis=1)
+        nxt = jnp.concatenate([nxt[:, 1:], jnp.full((nxt.shape[0], 1), t + 1)], axis=1)
+        return nxt
+
+    ei = end_marks(si, ti)
+    el = end_marks(sl, tl)
+    correct = si & sl & (ti == tl) & (ei == el)
+    nc = jnp.sum(correct.astype(jnp.int32))
+    return ni, nl, nc

@@ -1,0 +1,172 @@
+"""layers/io.py parity: graph-feeding readers re-expressed as host pipeline.
+
+Reference: ``python/paddle/fluid/layers/io.py`` — ``py_reader`` (:473,
+LoDTensorBlockingQueue + read op), ``open_files``/``open_recordio_file``
+(:344), ``double_buffer`` (:612-625 device prefetch), ``read_file``,
+``random_data_generator``, ``layers/ops load``. On TPU the "reader ops in
+the graph" design inverts: the graph takes arrays as jit arguments and the
+pipeline runs on host threads with device prefetch (same decorator
+combinators, ``paddle_tpu.reader``)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu import reader as reader_mod
+from paddle_tpu.core.enforce import enforce
+
+__all__ = [
+    "PyReader",
+    "Preprocessor",
+    "py_reader",
+    "double_buffer",
+    "open_files",
+    "open_recordio_file",
+    "read_file",
+    "random_data_generator",
+    "load",
+    "batch",
+    "shuffle",
+]
+
+batch = reader_mod.batch
+shuffle = reader_mod.shuffle
+
+
+class PyReader:
+    """Python-fed reader (reference ``layers/io.py:473`` py_reader): the
+    fluid version creates a blocking queue + in-graph read op; here the queue
+    is a host prefetch pipeline and ``__iter__`` yields ready device batches.
+
+    Usage parity::
+
+        r = layers.py_reader(capacity=64, shapes=[...], dtypes=[...])
+        r.decorate_paddle_reader(train_reader)
+        for batch in r:  # instead of exe.run pulling from the read op
+            step(*batch)
+    """
+
+    def __init__(self, capacity: int, shapes: Sequence[Sequence[int]],
+                 dtypes: Sequence[str], name: Optional[str] = None):
+        self.capacity = capacity
+        self.shapes = [tuple(s) for s in shapes]
+        self.dtypes = [np.dtype(d) for d in dtypes]
+        self._source: Optional[Callable] = None
+
+    def decorate_paddle_reader(self, reader_fn: Callable) -> None:
+        """Attach a sample reader (each item: tuple matching shapes/dtypes)."""
+        self._source = reader_fn
+
+    decorate_tensor_provider = decorate_paddle_reader
+
+    def start(self):
+        return iter(self)
+
+    def __iter__(self):
+        enforce(self._source is not None, "decorate_paddle_reader first")
+        buffered = reader_mod.buffered(self._source, self.capacity)
+        return iter(reader_mod.DevicePrefetcher(buffered()))
+
+
+def py_reader(capacity: int, shapes: Sequence[Sequence[int]],
+              dtypes: Sequence[str], name: Optional[str] = None) -> PyReader:
+    return PyReader(capacity, shapes, dtypes, name)
+
+
+class Preprocessor:
+    """Reader-transform block (reference ``layers/io.py`` Preprocessor: a
+    sub-block of ops applied between read and feed). Functional adapter:
+    the block body is a mapper applied on the host pipeline::
+
+        p = Preprocessor(reader)
+        p.block(lambda *sample: transformed_sample)
+        for item in p(): ...
+    """
+
+    def __init__(self, reader: Callable, name: Optional[str] = None):
+        self._reader = reader
+        self._mapper: Optional[Callable] = None
+
+    def block(self, mapper: Callable) -> None:
+        self._mapper = mapper
+
+    def __call__(self) -> Callable:
+        enforce(self._mapper is not None, "Preprocessor.block(mapper) first")
+        m = self._mapper
+
+        def apply(sample):
+            return m(*sample) if isinstance(sample, tuple) else m(sample)
+
+        return reader_mod.map_readers(apply, self._reader)()
+
+
+def double_buffer(reader: Callable, place=None) -> Callable:
+    """Device prefetch decorator (reference ``layers/io.py`` double_buffer /
+    C++ buffered_reader): overlap host batch prep with device compute."""
+    def decorated():
+        return iter(reader_mod.DevicePrefetcher(reader(), depth=2))
+
+    return decorated
+
+
+def open_recordio_file(filename: str, shapes=None, dtypes=None) -> Callable:
+    """Reader over a native recordio file (reference
+    ``layers/io.py:344`` open_recordio_file → C++ RecordIOFileReader).
+    Records are deserialized with numpy ``frombuffer`` when shapes/dtypes
+    given, else yielded as raw bytes."""
+    def r():
+        from paddle_tpu import native
+
+        with native.RecordIOScanner(filename) as scanner:
+            for rec in scanner:
+                if shapes is None:
+                    yield rec
+                else:
+                    arrs = []
+                    off = 0
+                    for shp, dt in zip(shapes, dtypes):
+                        n = int(np.prod(shp)) * np.dtype(dt).itemsize
+                        arrs.append(np.frombuffer(rec[off:off + n], dtype=dt).reshape(shp))
+                        off += n
+                    yield tuple(arrs)
+
+    return r
+
+
+def open_files(filenames: Sequence[str], shapes=None, dtypes=None,
+               thread_num: int = 1) -> Callable:
+    """Multi-file reader (reference ``layers/io.py`` open_files): chains the
+    per-file recordio readers."""
+    return reader_mod.chain(*[open_recordio_file(f, shapes, dtypes) for f in filenames])
+
+
+def read_file(reader_obj) -> tuple:
+    """Pull one item (reference ``layers/io.py`` read_file op)."""
+    return next(iter(reader_obj() if callable(reader_obj) else reader_obj))
+
+
+def random_data_generator(low: float, high: float,
+                          shapes: Sequence[Sequence[int]],
+                          seed: int = 0, count: int = 1 << 30) -> Callable:
+    """Synthetic uniform reader (reference
+    ``operators/reader/create_random_data_generator_op.cc``) — the fake-data
+    path of the benchmark suite."""
+    def r():
+        rng = np.random.RandomState(seed)
+        for _ in range(count):
+            yield tuple(
+                rng.uniform(low, high, size=s).astype(np.float32) for s in shapes
+            )
+
+    return r
+
+
+def load(dirname: str):
+    """Load saved persistables (reference ``layers/ops`` load op /
+    ``io.load_persistables``): returns the Variables tree saved by
+    ``io.save_params``."""
+    from paddle_tpu import io as io_mod
+
+    return io_mod.load_params(dirname)

@@ -136,3 +136,17 @@ def resolve(lr) -> LRScheduler:
     if isinstance(lr, LRScheduler):
         return lr
     return Constant(float(lr))
+
+
+def append_LARS(base_lr, param, grad, weight_decay: float = 0.0005, lars_coeff: float = 0.001, epsilon: float = 1e-9):
+    """Layer-wise adaptive rate scaling (reference
+    ``layers/learning_rate_scheduler.py`` append_LARS): scale the base LR for
+    one parameter by lars_coeff * ||w|| / (||g|| + wd * ||w||). Pure
+    function of (param, grad) — apply per-parameter inside an optimizer's
+    update (the reference appends it as graph ops per param)."""
+    import jax.numpy as jnp
+
+    wn = jnp.sqrt(jnp.sum(jnp.square(param.astype(jnp.float32))))
+    gn = jnp.sqrt(jnp.sum(jnp.square(grad.astype(jnp.float32))))
+    local = lars_coeff * wn / (gn + weight_decay * wn + epsilon)
+    return base_lr * local

@@ -37,6 +37,7 @@ __all__ = [
     "dynamic_rnn",
     "rank_by_length",
     "beam_search",
+    "beam_search_decode",
     "greedy_search",
     "BeamState",
 ]
@@ -309,19 +310,7 @@ def beam_search(
         return new_state, (new_tokens, src_beam)
 
     final, (tok_hist, ptr_hist) = jax.lax.scan(step, state, None, length=max_len)
-
-    # backtrace (beam_search_decode): walk backpointers from the last step
-    def back(beam_idx, hist):
-        tok_t, ptr_t = hist
-        toks = jnp.take_along_axis(tok_t, beam_idx, axis=1)  # [B, K]
-        prev = jnp.take_along_axis(ptr_t, beam_idx, axis=1)
-        return prev, toks
-
-    last_idx = jnp.tile(jnp.arange(beam_size)[None, :], (batch_size, 1))
-    _, rev_tokens = jax.lax.scan(
-        back, last_idx, (tok_hist, ptr_hist), reverse=True
-    )  # [T, B, K]
-    sequences = jnp.transpose(rev_tokens, (1, 2, 0))  # [B, K, T]
+    sequences = beam_search_decode(tok_hist, ptr_hist)  # [B, K, T]
 
     scores = final.scores
     if length_penalty_alpha:
@@ -332,6 +321,25 @@ def beam_search(
     sequences = jnp.take_along_axis(sequences, order[..., None], axis=1)
     scores = jnp.take_along_axis(scores, order, axis=1)
     return sequences, scores
+
+
+def beam_search_decode(tok_hist: jax.Array, ptr_hist: jax.Array) -> jax.Array:
+    """Backtrace per-step beam selections into final sequences (reference
+    ``beam_search_decode_op.cc``): walk the backpointers from the last step's
+    beams to the start. ``tok_hist``/``ptr_hist`` are [T, B, K] stacks of the
+    chosen token and source-beam index at each step (what
+    :func:`beam_search`'s scan emits). Returns sequences [B, K, T]."""
+    t, batch_size, beam_size = tok_hist.shape
+
+    def back(beam_idx, hist):
+        tok_t, ptr_t = hist
+        toks = jnp.take_along_axis(tok_t, beam_idx, axis=1)  # [B, K]
+        prev = jnp.take_along_axis(ptr_t, beam_idx, axis=1)
+        return prev, toks
+
+    last_idx = jnp.tile(jnp.arange(beam_size)[None, :], (batch_size, 1))
+    _, rev_tokens = jax.lax.scan(back, last_idx, (tok_hist, ptr_hist), reverse=True)
+    return jnp.transpose(rev_tokens, (1, 2, 0))
 
 
 def greedy_search(

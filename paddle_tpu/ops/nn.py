@@ -16,6 +16,7 @@ TPU-first conventions:
 
 from __future__ import annotations
 
+import math as _math
 from typing import Optional, Sequence, Tuple, Union
 
 import jax
@@ -58,6 +59,14 @@ __all__ = [
     "cos_sim",
     "l2_normalize",
     "matmul_bias",
+    "multiplex",
+    "row_conv",
+    "pad_constant_like",
+    "rank_loss",
+    "dice_loss",
+    "mean_iou",
+    "nce_loss",
+    "hsigmoid_loss",
 ]
 
 _IntOrPair = Union[int, Sequence[int]]
@@ -493,3 +502,145 @@ def matmul_bias(x, w, b=None):
     if b is not None:
         out = out + b
     return out
+
+
+def multiplex(inputs: Sequence[jax.Array], index: jax.Array) -> jax.Array:
+    """Row-wise select among N same-shaped inputs (reference
+    ``multiplex_op.cc``): out[b] = inputs[index[b]][b]."""
+    stacked = jnp.stack(inputs, axis=0)  # [N, B, ...]
+    idx = index.reshape(-1).astype(jnp.int32)  # [B]
+    return jnp.take_along_axis(
+        stacked, idx[None, :].reshape((1, -1) + (1,) * (stacked.ndim - 2)), axis=0
+    )[0]
+
+
+def row_conv(x: jax.Array, weight: jax.Array, lengths: Optional[jax.Array] = None) -> jax.Array:
+    """Lookahead row convolution (reference ``row_conv_op.cc``, DeepSpeech2):
+    out[b, t, d] = sum_k w[k, d] * x[b, t+k, d] over a future context window.
+    ``weight`` is [context, D]. Streaming-friendly alternative to bi-RNNs."""
+    b, t, d = x.shape
+    context = weight.shape[0]
+    if lengths is not None:
+        mask = (jnp.arange(t)[None, :] < lengths[:, None])[..., None]
+        x = jnp.where(mask, x, 0.0)
+    out = jnp.zeros((b, t, d), jnp.float32)
+    for k in range(context):  # context is small (~2-20); unrolled shifts fuse
+        shifted = jnp.pad(x[:, k:], ((0, 0), (0, k), (0, 0)))
+        out = out + shifted.astype(jnp.float32) * weight[k].astype(jnp.float32)
+    out = out.astype(x.dtype)
+    if lengths is not None:
+        out = jnp.where(mask, out, 0.0)
+    return out
+
+
+def pad_constant_like(x: jax.Array, y: jax.Array, pad_value: float = 0.0) -> jax.Array:
+    """Pad ``y`` at the tail of every axis to match ``x``'s shape (reference
+    ``pad_constant_like_op.cc``)."""
+    cfg = [(0, int(xs - ys)) for xs, ys in zip(x.shape, y.shape)]
+    return jnp.pad(y, cfg, constant_values=pad_value)
+
+
+def rank_loss(label: jax.Array, left: jax.Array, right: jax.Array) -> jax.Array:
+    """RankNet pairwise loss (reference ``rank_loss_op.cc``):
+    C = log(1 + e^o) - label * o with o = left - right, computed stably."""
+    o = (left - right).astype(jnp.float32)
+    lab = label.astype(jnp.float32)
+    return (jnp.logaddexp(0.0, o) - lab * o).astype(left.dtype)
+
+
+def dice_loss(input: jax.Array, label: jax.Array, epsilon: float = 1e-5) -> jax.Array:
+    """Dice loss over per-row probability maps (reference fluid
+    ``layers.dice_loss``): 1 - 2|X∩Y| / (|X|+|Y|)."""
+    p = input.astype(jnp.float32).reshape(input.shape[0], -1)
+    g = label.astype(jnp.float32).reshape(label.shape[0], -1)
+    inter = jnp.sum(p * g, axis=1)
+    union = jnp.sum(p, axis=1) + jnp.sum(g, axis=1)
+    return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+
+def mean_iou(pred: jax.Array, label: jax.Array, num_classes: int):
+    """Mean intersection-over-union metric (reference ``mean_iou_op.cc``).
+    Returns (mean_iou scalar, per-class wrong counts, per-class correct
+    counts). Dense bincount formulation (one-hot matmul free)."""
+    p = pred.reshape(-1).astype(jnp.int32)
+    l = label.reshape(-1).astype(jnp.int32)
+    correct = jnp.zeros((num_classes,), jnp.int32).at[l].add((p == l).astype(jnp.int32))
+    pred_cnt = jnp.zeros((num_classes,), jnp.int32).at[p].add(1)
+    label_cnt = jnp.zeros((num_classes,), jnp.int32).at[l].add(1)
+    union = pred_cnt + label_cnt - correct
+    wrong = union - correct
+    present = union > 0
+    iou = jnp.where(present, correct / jnp.maximum(union, 1), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present.astype(jnp.int32)), 1)
+    return miou.astype(jnp.float32), wrong, correct
+
+
+def nce_loss(
+    x: jax.Array,
+    weight: jax.Array,
+    bias: Optional[jax.Array],
+    labels: jax.Array,
+    num_neg_samples: int,
+    rng: jax.Array,
+    num_total_classes: Optional[int] = None,
+) -> jax.Array:
+    """Noise-contrastive estimation loss (reference ``nce_op.cc``): binary
+    logistic discrimination of the true class against ``num_neg_samples``
+    uniformly drawn noise classes. ``weight`` [num_classes, D], ``x`` [B, D],
+    ``labels`` [B]. Returns per-row loss [B].
+
+    TPU design: gathers only the (1 + S) rows of the class matrix per
+    example — no full [B, num_classes] logits are formed."""
+    n_classes = num_total_classes or weight.shape[0]
+    b = x.shape[0]
+    samples = jax.random.randint(rng, (b, num_neg_samples), 0, n_classes)
+    ids = jnp.concatenate([labels.reshape(b, 1).astype(jnp.int32), samples], axis=1)  # [B, 1+S]
+    w = weight[ids]  # [B, 1+S, D]
+    logits = jnp.einsum(
+        "bd,bsd->bs", x.astype(jnp.float32), w.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    if bias is not None:
+        logits = logits + bias[ids].astype(jnp.float32)
+    # NCE posterior correction: discriminate against k noise samples from the
+    # uniform prior q = 1/num_classes, i.e. classify with logit - log(k*q)
+    logits = logits - _math.log(num_neg_samples / n_classes)
+    labels01 = jnp.concatenate(
+        [jnp.ones((b, 1), jnp.float32), jnp.zeros((b, num_neg_samples), jnp.float32)], axis=1
+    )
+    per = jnp.maximum(logits, 0.0) - logits * labels01 + jnp.logaddexp(0.0, -jnp.abs(logits))
+    return jnp.sum(per, axis=1)
+
+
+def hsigmoid_loss(
+    x: jax.Array,
+    weight: jax.Array,
+    bias: Optional[jax.Array],
+    labels: jax.Array,
+    num_classes: int,
+) -> jax.Array:
+    """Hierarchical sigmoid loss over the default complete binary tree
+    (reference ``hierarchical_sigmoid_op.cc`` with MatrixBitCode): class c's
+    leaf sits at heap id c + num_classes; the path to the root visits
+    internal nodes id//2 with the branch bit id&1. ``weight`` is
+    [num_classes - 1, D] (one row per internal node). Cost O(B * log C * D)
+    vs softmax's O(B * C * D). Returns per-row loss [B]."""
+    code_len = max(1, (max(num_classes, 2) - 1).bit_length())
+    leaf = labels.astype(jnp.int32) + num_classes  # heap ids, root = 1
+    node = leaf
+    total = jnp.zeros(x.shape[0], jnp.float32)
+    xf = x.astype(jnp.float32)
+    for _ in range(code_len):
+        bit = (node & 1).astype(jnp.float32)  # branch taken at the parent
+        parent = node // 2  # internal heap id >= 1
+        idx = jnp.clip(parent - 1, 0, num_classes - 2)
+        active = (parent >= 1).astype(jnp.float32)
+        w = weight[idx].astype(jnp.float32)  # [B, D]
+        logit = jnp.sum(xf * w, axis=-1)
+        if bias is not None:
+            logit = logit + bias[idx].astype(jnp.float32)
+        # sigmoid CE against the branch bit, numerically stable
+        per = jnp.maximum(logit, 0.0) - logit * bit + jnp.logaddexp(0.0, -jnp.abs(logit))
+        total = total + per * active
+        node = parent
+    return total

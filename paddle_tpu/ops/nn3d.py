@@ -1,0 +1,134 @@
+"""3-D conv/pool ops over NDHWC volumes.
+
+Reference: ``operators/conv_op.cc`` (conv3d registered alongside conv2d),
+``operators/conv_transpose_op.cc`` (conv3d_transpose),
+``operators/pool_op.cc`` (pool3d) — vol2col + gemm CPU paths and cuDNN GPU
+paths. TPU-first: one ``lax.conv_general_dilated`` / ``lax.reduce_window``
+per op over NDHWC (XLA tiles 3-D convs onto the MXU the same way as 2-D;
+no vol2col materialization, no algo selection).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["conv3d", "conv3d_transpose", "pool3d"]
+
+_IntOrTriple = Union[int, Sequence[int]]
+
+
+def _triple(v: _IntOrTriple) -> Tuple[int, int, int]:
+    if isinstance(v, (list, tuple)):
+        return int(v[0]), int(v[1]), int(v[2])
+    return int(v), int(v), int(v)
+
+
+_NDHWC_SPEC = ("NDHWC", "DHWIO", "NDHWC")
+
+
+def conv3d(
+    x: jax.Array,
+    weight: jax.Array,
+    stride: _IntOrTriple = 1,
+    padding: Union[str, _IntOrTriple] = 0,
+    dilation: _IntOrTriple = 1,
+    groups: int = 1,
+) -> jax.Array:
+    """3-D convolution, NDHWC activations x DHWIO weights (reference
+    ``conv3d`` kernel in ``operators/conv_op.cc``)."""
+    if isinstance(padding, str):
+        pads: Union[str, Sequence[Tuple[int, int]]] = padding.upper()
+    else:
+        pd, ph, pw = _triple(padding)
+        pads = [(pd, pd), (ph, ph), (pw, pw)]
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, _NDHWC_SPEC)
+    out = lax.conv_general_dilated(
+        x,
+        weight,
+        window_strides=_triple(stride),
+        padding=pads,
+        rhs_dilation=_triple(dilation),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(x.dtype)
+
+
+def conv3d_transpose(
+    x: jax.Array,
+    weight: jax.Array,
+    stride: _IntOrTriple = 1,
+    padding: _IntOrTriple = 0,
+    output_padding: _IntOrTriple = 0,
+) -> jax.Array:
+    """Transposed 3-D conv (reference ``conv_transpose_op.cc`` conv3d path).
+    weight is DHWIO with I = in_channels of x, O = out_channels; the
+    gradient-of-conv formulation: dilate inputs by stride, flip kernel."""
+    sd, sh, sw = _triple(stride)
+    pd, ph, pw = _triple(padding)
+    opd, oph, opw = _triple(output_padding)
+    kd, kh, kw = weight.shape[0], weight.shape[1], weight.shape[2]
+    pads = [
+        (kd - 1 - pd, kd - 1 - pd + opd),
+        (kh - 1 - ph, kh - 1 - ph + oph),
+        (kw - 1 - pw, kw - 1 - pw + opw),
+    ]
+    w_flipped = jnp.flip(weight, (0, 1, 2))
+    dn = lax.conv_dimension_numbers(x.shape, w_flipped.shape, _NDHWC_SPEC)
+    out = lax.conv_general_dilated(
+        x,
+        w_flipped,
+        window_strides=(1, 1, 1),
+        padding=pads,
+        lhs_dilation=(sd, sh, sw),
+        dimension_numbers=dn,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(x.dtype)
+
+
+def pool3d(
+    x: jax.Array,
+    pool_size: _IntOrTriple = 2,
+    pool_type: str = "max",
+    pool_stride: _IntOrTriple = 1,
+    pool_padding: _IntOrTriple = 0,
+    exclusive: bool = True,
+    global_pooling: bool = False,
+) -> jax.Array:
+    """Max/avg pooling over NDHWC (reference ``pool_op.cc`` pool3d kernels,
+    incl. ``exclusive`` average counting over non-padding elements)."""
+    if global_pooling:
+        pool_size = (x.shape[1], x.shape[2], x.shape[3])
+        pool_padding = 0
+    kd, kh, kw = _triple(pool_size)
+    sd, sh, sw = _triple(pool_stride)
+    pd, ph, pw = _triple(pool_padding)
+    dims = (1, kd, kh, kw, 1)
+    strides = (1, sd, sh, sw, 1)
+    pads = ((0, 0), (pd, pd), (ph, ph), (pw, pw), (0, 0))
+
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        padded = jnp.pad(x, pads, constant_values=init)
+        return lax.reduce_window(padded, init, lax.max, dims, strides, "VALID")
+    if pool_type == "avg":
+        padded = jnp.pad(x.astype(jnp.float32), pads, constant_values=0.0)
+        summed = lax.reduce_window(padded, 0.0, lax.add, dims, strides, "VALID")
+        if exclusive and (pd or ph or pw):
+            ones = jnp.pad(
+                jnp.ones(x.shape[1:4], jnp.float32), pads[1:4], constant_values=0.0
+            )
+            counts = lax.reduce_window(
+                ones, 0.0, lax.add, (kd, kh, kw), (sd, sh, sw), "VALID"
+            )
+            out = summed / counts[None, :, :, :, None]
+        else:
+            out = summed / float(kd * kh * kw)
+        return out.astype(x.dtype)
+    raise ValueError(f"pool_type must be 'max' or 'avg', got {pool_type!r}")

@@ -172,3 +172,114 @@ def dynamic_gru(
         mask = (jnp.arange(t)[:, None] < lengths[None, :])[..., None]
         outs = jnp.where(mask, outs, 0.0)
     return jnp.swapaxes(outs, 0, 1), final
+
+
+class LSTMPState(NamedTuple):
+    h: jax.Array  # projected recurrent state [B, P]
+    c: jax.Array  # cell state [B, H]
+
+
+def lstmp_cell(
+    x_proj: jax.Array,
+    state: LSTMPState,
+    w_hh: jax.Array,
+    w_proj: jax.Array,
+    bias: Optional[jax.Array] = None,
+    cell_clip: Optional[float] = None,
+    proj_clip: Optional[float] = None,
+    proj_act: Optional[str] = None,
+) -> LSTMPState:
+    """One LSTMP (LSTM-with-projection) step — reference ``lstmp_op.cc``:
+    the recurrent state fed back into the gates is ``r = act(h @ W_proj)``
+    ([B, P] with P < H), cutting the recurrent matmul from H×4H to P×4H.
+    ``w_hh`` is [P, 4H], ``w_proj`` is [H, P]."""
+    r, c = state
+    gates = x_proj + jnp.matmul(r, w_hh, preferred_element_type=jnp.float32).astype(x_proj.dtype)
+    if bias is not None:
+        gates = gates + bias
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    new_c = f * c + i * g
+    if cell_clip is not None:
+        new_c = jnp.clip(new_c, -cell_clip, cell_clip)
+    new_h = o * jnp.tanh(new_c)
+    new_r = jnp.matmul(new_h, w_proj, preferred_element_type=jnp.float32).astype(new_h.dtype)
+    if proj_act == "tanh":
+        new_r = jnp.tanh(new_r)
+    elif proj_act == "sigmoid":
+        new_r = jax.nn.sigmoid(new_r)
+    if proj_clip is not None:
+        new_r = jnp.clip(new_r, -proj_clip, proj_clip)
+    return LSTMPState(new_r, new_c)
+
+
+def dynamic_lstmp(
+    x: jax.Array,
+    w_ih: Optional[jax.Array],
+    w_hh: jax.Array,
+    w_proj: jax.Array,
+    bias: Optional[jax.Array] = None,
+    lengths: Optional[jax.Array] = None,
+    init_state: Optional[LSTMPState] = None,
+    cell_clip: Optional[float] = None,
+    proj_clip: Optional[float] = None,
+    proj_act: Optional[str] = None,
+) -> Tuple[jax.Array, LSTMPState]:
+    """Full-sequence projected LSTM over padded [B, T, D] (reference
+    ``lstmp_op.cc`` / fluid ``layers.dynamic_lstmp``): masked ``lax.scan``,
+    state carried through past each row's length. Returns the projected
+    outputs [B, T, P] and the final state."""
+    x = jnp.swapaxes(x, 0, 1)  # [T, B, D]
+    t, b, _ = x.shape
+    hsize = w_proj.shape[0]
+    psize = w_proj.shape[1]
+    if init_state is None:
+        init_state = LSTMPState(
+            jnp.zeros((b, psize), x.dtype), jnp.zeros((b, hsize), x.dtype)
+        )
+    x_proj = x if w_ih is None else jnp.matmul(
+        x, w_ih, preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+    steps = jnp.arange(t)
+
+    def step(state, inp):
+        s, xp = inp
+        new = lstmp_cell(xp, state, w_hh, w_proj, bias, cell_clip, proj_clip, proj_act)
+        if lengths is not None:
+            m = (s < lengths)[:, None]
+            new = LSTMPState(jnp.where(m, new.h, state.h), jnp.where(m, new.c, state.c))
+        return new, new.h
+
+    final, outs = lax.scan(step, init_state, (steps, x_proj))
+    if lengths is not None:
+        mask = (jnp.arange(t)[:, None] < lengths[None, :])[..., None]
+        outs = jnp.where(mask, outs, 0.0)
+    return jnp.swapaxes(outs, 0, 1), final
+
+
+def gru_unit(
+    x_proj: jax.Array, h_prev: jax.Array, w_hh: jax.Array, bias=None
+) -> Tuple[jax.Array, jax.Array]:
+    """Single GRU step with the fluid ``layers.gru_unit`` return contract
+    (reference ``gru_unit_op.cc``): returns (new_hidden, new_hidden) — the
+    reference also exposes reset_hidden_pre and gate outputs; on TPU those
+    are fusion-internal. ``x_proj`` [B, 3H] is the pre-projected input."""
+    new_h = gru_cell(x_proj, h_prev, w_hh, bias)
+    return new_h, new_h
+
+
+def lstm_unit(
+    x_proj: jax.Array,
+    h_prev: jax.Array,
+    c_prev: jax.Array,
+    w_hh: jax.Array,
+    bias: Optional[jax.Array] = None,
+    forget_bias: float = 0.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Single LSTM step with the fluid ``layers.lstm_unit`` return contract
+    (reference ``lstm_unit_op.cc``): returns (hidden, cell)."""
+    st = lstm_cell(x_proj, LSTMState(h_prev, c_prev), w_hh, bias, forget_bias)
+    return st.h, st.c

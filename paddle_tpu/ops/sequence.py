@@ -33,6 +33,15 @@ __all__ = [
     "sequence_first_step",
     "sequence_conv",
     "sequence_erase",
+    "sequence_concat",
+    "sequence_enumerate",
+    "sequence_expand_as",
+    "sequence_mask",
+    "sequence_reshape",
+    "sequence_scatter",
+    "sequence_slice",
+    "lod_reset",
+    "reorder_by_rank",
 ]
 
 
@@ -167,3 +176,117 @@ def sequence_erase(x: jax.Array, lengths: jax.Array, tokens: jax.Array) -> Tuple
     new_len = jnp.sum(keep.astype(jnp.int32), axis=1)
     compacted = jnp.where(length_mask(new_len, t), compacted, 0)
     return compacted, new_len
+
+
+def sequence_concat(
+    x: jax.Array, x_lens: jax.Array, y: jax.Array, y_lens: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Row-wise sequence concatenation (reference ``sequence_concat_op.cc``):
+    output row b is x[b,:x_lens[b]] followed by y[b,:y_lens[b]], padded to
+    Tx+Ty. Pure gather over the padded buffers — no host-side repacking."""
+    tx, ty = x.shape[1], y.shape[1]
+    t_out = tx + ty
+    pos = jnp.arange(t_out)[None, :]  # [1, T_out]
+    xl = x_lens[:, None]
+    from_x = pos < xl
+    idx_x = jnp.clip(pos, 0, tx - 1)
+    idx_y = jnp.clip(pos - xl, 0, ty - 1)
+    gx = jnp.take_along_axis(x, idx_x[..., None] if x.ndim == 3 else idx_x, axis=1)
+    gy = jnp.take_along_axis(y, idx_y[..., None] if y.ndim == 3 else idx_y, axis=1)
+    sel = from_x if x.ndim == 2 else from_x[..., None]
+    out = jnp.where(sel, gx, gy)
+    new_lens = x_lens + y_lens
+    valid = pos < new_lens[:, None]
+    if x.ndim == 3:
+        valid = valid[..., None]
+    return jnp.where(valid, out, 0).astype(x.dtype), new_lens
+
+
+def sequence_enumerate(
+    ids: jax.Array, lengths: jax.Array, win_size: int, pad_value: int = 0
+) -> jax.Array:
+    """All length-``win_size`` windows starting at each position (reference
+    ``sequence_enumerate_op.cc``): [B, T] int ids → [B, T, win]; positions
+    past a row's length are pad_value."""
+    t = ids.shape[1]
+    pos = jnp.arange(t)[:, None] + jnp.arange(win_size)[None, :]  # [T, win]
+    gathered = ids[:, jnp.clip(pos, 0, t - 1)]  # [B, T, win]
+    valid = pos[None, :, :] < lengths[:, None, None]
+    return jnp.where(valid, gathered, pad_value).astype(ids.dtype)
+
+
+def sequence_expand_as(x: jax.Array, y_lens: jax.Array, t: int) -> jax.Array:
+    """Expand per-sequence vectors [B, D] to y's padded layout [B, T, D]
+    (reference ``sequence_expand_as_op.cc``)."""
+    return sequence_expand(x, y_lens, t)
+
+
+def sequence_mask(lengths: jax.Array, maxlen: int, dtype=jnp.float32) -> jax.Array:
+    """fluid ``layers.sequence_mask`` (reference sequence_mask op): [B] int
+    lengths → [B, maxlen] 0/1 mask."""
+    return length_mask(lengths, maxlen, dtype)
+
+
+def sequence_reshape(
+    x: jax.Array, lengths: jax.Array, new_dim: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Re-chunk each row's flattened valid data into ``new_dim``-wide
+    timesteps (reference ``sequence_reshape_op.cc``). Works on the padded
+    buffer because each row's valid data is a contiguous prefix: [B, T, D] →
+    [B, T*D/new_dim, new_dim], lengths scaled by D/new_dim. Rows whose
+    ``lengths[b]*D`` is not divisible by new_dim are a caller error (the
+    reference enforces at runtime; XLA shapes are static so we document)."""
+    b, t, d = x.shape
+    total = t * d
+    if total % new_dim != 0:
+        raise ValueError(f"T*D={total} not divisible by new_dim={new_dim}")
+    out = x.reshape(b, total // new_dim, new_dim)
+    new_lens = (lengths * d) // new_dim
+    return out, new_lens
+
+
+def sequence_scatter(
+    x: jax.Array, ids: jax.Array, id_lens: jax.Array, updates: jax.Array
+) -> jax.Array:
+    """Per-row scatter-add (reference ``sequence_scatter_op.cc``): for row b
+    and valid j, x[b, ids[b, j]] += updates[b, j]. Dense one-hot matmul
+    formulation (MXU-friendly, no serialized scatters): builds [B, S, M]
+    one-hots masked by validity and contracts over S."""
+    m = x.shape[1]
+    s = ids.shape[1]
+    valid = length_mask(id_lens, s, jnp.float32)  # [B, S]
+    onehot = jax.nn.one_hot(ids, m, dtype=jnp.float32)  # [B, S, M]
+    upd = (updates.astype(jnp.float32) * valid)[:, :, None]  # [B, S, 1]
+    add = jnp.sum(onehot * upd, axis=1)  # [B, M]
+    return (x.astype(jnp.float32) + add).astype(x.dtype)
+
+
+def sequence_slice(
+    x: jax.Array, lengths: jax.Array, offset: jax.Array, length: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-row subsequence x[b, offset[b]:offset[b]+length[b]] (reference
+    ``sequence_slice_op.cc``), left-aligned into the padded output."""
+    t = x.shape[1]
+    pos = jnp.arange(t)[None, :]
+    src = jnp.clip(offset[:, None] + pos, 0, t - 1)
+    out = jnp.take_along_axis(x, src[..., None] if x.ndim == 3 else src, axis=1)
+    valid = pos < length[:, None]
+    if x.ndim == 3:
+        valid = valid[..., None]
+    return jnp.where(valid, out, 0).astype(x.dtype), length.astype(jnp.int32)
+
+
+def lod_reset(
+    x: jax.Array, new_lengths: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Replace a padded batch's sequence metadata (reference
+    ``lod_reset_op.cc``): data unchanged, lengths swapped."""
+    return x, new_lengths.astype(jnp.int32)
+
+
+def reorder_by_rank(x: jax.Array, rank: jax.Array) -> jax.Array:
+    """Gather rows into rank order (reference
+    ``reorder_lod_tensor_by_rank_op.cc`` driven by a lod_rank_table; on TPU
+    the rank table is just an argsort of lengths — see
+    ``control_flow.rank_by_length``)."""
+    return jnp.take(x, rank, axis=0)

@@ -1,0 +1,148 @@
+"""Image/vision ops: resize dispatch, random crop, ROI pooling, im2sequence.
+
+Reference: ``operators/interpolate`` family (``bilinear_interp_op.cc``,
+``nearest_interp_op.cc`` behind fluid ``layers.image_resize``),
+``operators/random_crop_op.cc``, ``operators/roi_pool_op.cc``,
+``operators/im2sequence_op.cc``. All NHWC (TPU layout; reference is NCHW).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.ops.nn import resize_bilinear, resize_nearest
+
+__all__ = [
+    "image_resize",
+    "image_resize_short",
+    "random_crop",
+    "roi_pool",
+    "im2sequence",
+]
+
+
+def image_resize(
+    x: jax.Array,
+    out_shape: Optional[Sequence[int]] = None,
+    scale: Optional[float] = None,
+    resample: str = "BILINEAR",
+    align_corners: bool = True,
+) -> jax.Array:
+    """fluid ``layers.image_resize`` dispatch (reference
+    ``layers/nn.py`` image_resize → bilinear/nearest interp ops)."""
+    n, h, w, c = x.shape
+    if out_shape is None:
+        if scale is None:
+            raise ValueError("one of out_shape / scale is required")
+        out_shape = (int(h * scale), int(w * scale))
+    oh, ow = int(out_shape[0]), int(out_shape[1])
+    method = resample.upper()
+    if method == "BILINEAR":
+        return resize_bilinear(x, (oh, ow), align_corners=align_corners)
+    if method == "NEAREST":
+        return resize_nearest(x, (oh, ow))
+    raise ValueError(f"resample must be BILINEAR or NEAREST, got {resample!r}")
+
+
+def image_resize_short(x: jax.Array, out_short_len: int, resample: str = "BILINEAR") -> jax.Array:
+    """Resize so the shorter edge becomes ``out_short_len``, preserving
+    aspect ratio (reference ``layers/nn.py`` image_resize_short)."""
+    n, h, w, c = x.shape
+    short, long_ = (h, w) if h < w else (w, h)
+    new_long = int(round(long_ * out_short_len / short))
+    out_shape = (out_short_len, new_long) if h < w else (new_long, out_short_len)
+    return image_resize(x, out_shape=out_shape, resample=resample)
+
+
+def random_crop(x: jax.Array, crop_shape: Tuple[int, int], rng: jax.Array) -> jax.Array:
+    """Per-sample random spatial crop of an NHWC batch (reference
+    ``random_crop_op.cc``): independent offsets per row via vmapped
+    dynamic_slice."""
+    n, h, w, c = x.shape
+    ch, cw = crop_shape
+    ky, kx = jax.random.split(rng)
+    ys = jax.random.randint(ky, (n,), 0, h - ch + 1)
+    xs = jax.random.randint(kx, (n,), 0, w - cw + 1)
+
+    def crop_one(img, y0, x0):
+        return lax.dynamic_slice(img, (y0, x0, 0), (ch, cw, c))
+
+    return jax.vmap(crop_one)(x, ys, xs)
+
+
+def roi_pool(
+    x: jax.Array,
+    rois: jax.Array,
+    roi_batch_idx: jax.Array,
+    pooled_height: int,
+    pooled_width: int,
+    spatial_scale: float = 1.0,
+) -> jax.Array:
+    """Max-pool each region of interest into a fixed grid (reference
+    ``roi_pool_op.cc``, Fast R-CNN). ``rois`` [R, 4] are (x1, y1, x2, y2) in
+    input-image coordinates; ``roi_batch_idx`` [R] maps each ROI to its
+    batch row. Returns [R, pooled_h, pooled_w, C].
+
+    TPU design: instead of the reference's per-bin argmax loops, each ROI
+    builds separable bin-membership masks over H and W and max-reduces —
+    static shapes, no dynamic slicing, vmapped over ROIs."""
+    n, h, w, c = x.shape
+    feats = x[roi_batch_idx]  # [R, H, W, C]
+    r = rois.astype(jnp.float32) * spatial_scale
+    x1, y1, x2, y2 = jnp.round(r[:, 0]), jnp.round(r[:, 1]), jnp.round(r[:, 2]), jnp.round(r[:, 3])
+    roi_h = jnp.maximum(y2 - y1 + 1.0, 1.0)
+    roi_w = jnp.maximum(x2 - x1 + 1.0, 1.0)
+    bin_h = roi_h / pooled_height  # [R]
+    bin_w = roi_w / pooled_width
+
+    ph = jnp.arange(pooled_height, dtype=jnp.float32)
+    pw = jnp.arange(pooled_width, dtype=jnp.float32)
+    # bin edges, clipped to the feature map (reference hstart/hend math)
+    hstart = jnp.clip(jnp.floor(ph[None, :] * bin_h[:, None]) + y1[:, None], 0, h)  # [R, PH]
+    hend = jnp.clip(jnp.ceil((ph[None, :] + 1) * bin_h[:, None]) + y1[:, None], 0, h)
+    wstart = jnp.clip(jnp.floor(pw[None, :] * bin_w[:, None]) + x1[:, None], 0, w)  # [R, PW]
+    wend = jnp.clip(jnp.ceil((pw[None, :] + 1) * bin_w[:, None]) + x1[:, None], 0, w)
+
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+    my = (ys[None, None, :] >= hstart[:, :, None]) & (ys[None, None, :] < hend[:, :, None])  # [R,PH,H]
+    mx = (xs[None, None, :] >= wstart[:, :, None]) & (xs[None, None, :] < wend[:, :, None])  # [R,PW,W]
+
+    neg = jnp.finfo(jnp.float32).min
+    f = feats.astype(jnp.float32)
+    # separable max: over W per output column, then over H per output row
+    fx = jnp.where(mx[:, None, :, :, None], f[:, :, None, :, :], neg)  # [R,H,PW,W,C]
+    fx = jnp.max(fx, axis=3)  # [R, H, PW, C]
+    fy = jnp.where(my[:, :, :, None, None], fx[:, None, :, :, :], neg)  # [R,PH,H,PW,C]
+    out = jnp.max(fy, axis=2)  # [R, PH, PW, C]
+    # empty bins (hstart>=hend) pool to 0 like the reference
+    empty = (hstart >= hend)[:, :, None, None] | (wstart >= wend)[:, None, :, None]
+    return jnp.where(empty, 0.0, out).astype(x.dtype)
+
+
+def im2sequence(
+    x: jax.Array,
+    filter_size: Union[int, Tuple[int, int]] = 1,
+    stride: Union[int, Tuple[int, int]] = 1,
+    padding: Union[int, Tuple[int, int]] = 0,
+) -> jax.Array:
+    """Unfold image patches into a sequence (reference
+    ``im2sequence_op.cc``): NHWC [B, H, W, C] → [B, OH*OW, FH*FW*C], each
+    output step one flattened patch (OCR-style image-to-sequence feeds).
+    Uses ``conv_general_dilated_patches`` — one XLA op, no gather loops."""
+    fh, fw = (filter_size, filter_size) if isinstance(filter_size, int) else filter_size
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    patches = lax.conv_general_dilated_patches(
+        x,
+        filter_shape=(fh, fw),
+        window_strides=(sh, sw),
+        padding=[(ph, ph), (pw, pw)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )  # [B, OH, OW, C*FH*FW]
+    b, oh, ow, d = patches.shape
+    return patches.reshape(b, oh * ow, d)

@@ -1,0 +1,523 @@
+"""Output tests for the extended layer catalog (VERDICT round-1 item 4:
+close the ~40-fn gap). Mirrors the reference's per-op test style
+(``python/paddle/fluid/tests/unittests/test_*_op.py``) with numpy
+references computed inline."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.ops import nn as on
+from paddle_tpu.ops import nn3d as o3d
+from paddle_tpu.ops import rnn as orn
+from paddle_tpu.ops import sequence as oseq
+from paddle_tpu.ops import vision as ovis
+from paddle_tpu.ops import control_flow as ocf
+
+
+# ---------------------------------------------------------------------------
+# 3-D conv family
+# ---------------------------------------------------------------------------
+
+
+def test_conv3d_matches_manual(rng):
+    x = rng.randn(2, 4, 5, 6, 3).astype(np.float32)
+    w = rng.randn(2, 2, 2, 3, 4).astype(np.float32)
+    out = o3d.conv3d(jnp.asarray(x), jnp.asarray(w), stride=1, padding=0)
+    assert out.shape == (2, 3, 4, 5, 4)
+    # manual corner check at output (0,0,0,0,:)
+    ref = np.einsum("dhwi,dhwio->o", x[0, :2, :2, :2], w)
+    np.testing.assert_allclose(np.asarray(out[0, 0, 0, 0]), ref, rtol=1e-4)
+
+
+def test_conv3d_transpose_shape_and_grad(rng):
+    x = rng.randn(1, 3, 3, 3, 2).astype(np.float32)
+    w = rng.randn(2, 2, 2, 2, 5).astype(np.float32)
+    out = o3d.conv3d_transpose(jnp.asarray(x), jnp.asarray(w), stride=2)
+    assert out.shape == (1, 6 + 0, 6, 6, 5)[:1] + out.shape[1:]  # smoke: stride upsamples
+    assert out.shape[1] == 2 * 3 - 2 + 2  # (in-1)*s + k - 2p
+    g = jax.grad(lambda a: jnp.sum(o3d.conv3d_transpose(a, jnp.asarray(w), stride=2)))(
+        jnp.asarray(x)
+    )
+    assert g.shape == x.shape and np.all(np.isfinite(np.asarray(g)))
+
+
+def test_pool3d_max_avg(rng):
+    x = rng.randn(2, 4, 4, 4, 3).astype(np.float32)
+    mx = o3d.pool3d(jnp.asarray(x), 2, "max", 2)
+    av = o3d.pool3d(jnp.asarray(x), 2, "avg", 2)
+    assert mx.shape == (2, 2, 2, 2, 3)
+    blk = x[0, :2, :2, :2, 0]
+    np.testing.assert_allclose(float(mx[0, 0, 0, 0, 0]), blk.max(), rtol=1e-5)
+    np.testing.assert_allclose(float(av[0, 0, 0, 0, 0]), blk.mean(), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# nn tail
+# ---------------------------------------------------------------------------
+
+
+def test_multiplex(rng):
+    a = rng.randn(4, 3).astype(np.float32)
+    b = rng.randn(4, 3).astype(np.float32)
+    idx = np.array([0, 1, 1, 0], np.int32)
+    out = np.asarray(on.multiplex([jnp.asarray(a), jnp.asarray(b)], jnp.asarray(idx)))
+    ref = np.stack([a[0], b[1], b[2], a[3]])
+    np.testing.assert_allclose(out, ref)
+
+
+def test_row_conv_manual(rng):
+    x = rng.randn(2, 5, 3).astype(np.float32)
+    w = rng.randn(3, 3).astype(np.float32)  # context 3
+    out = np.asarray(on.row_conv(jnp.asarray(x), jnp.asarray(w)))
+    ref = np.zeros_like(x)
+    for t in range(5):
+        for k in range(3):
+            if t + k < 5:
+                ref[:, t] += x[:, t + k] * w[k]
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_row_conv_respects_lengths(rng):
+    x = rng.randn(2, 5, 3).astype(np.float32)
+    w = rng.randn(2, 3).astype(np.float32)
+    lens = np.array([3, 5], np.int32)
+    out = np.asarray(on.row_conv(jnp.asarray(x), jnp.asarray(w), jnp.asarray(lens)))
+    assert np.all(out[0, 3:] == 0)
+    # row 0 must not see x[0, 3:] (past its length)
+    x2 = x.copy()
+    x2[0, 3:] = 99.0
+    out2 = np.asarray(on.row_conv(jnp.asarray(x2), jnp.asarray(w), jnp.asarray(lens)))
+    np.testing.assert_allclose(out, out2, rtol=1e-5)
+
+
+def test_pad_constant_like(rng):
+    x = np.zeros((4, 6), np.float32)
+    y = rng.randn(2, 3).astype(np.float32)
+    out = np.asarray(on.pad_constant_like(jnp.asarray(x), jnp.asarray(y), 7.0))
+    assert out.shape == (4, 6)
+    np.testing.assert_allclose(out[:2, :3], y)
+    assert np.all(out[2:] == 7.0) and np.all(out[:2, 3:] == 7.0)
+
+
+def test_rank_loss_values():
+    left = jnp.asarray([2.0, 0.0])
+    right = jnp.asarray([1.0, 0.0])
+    lab = jnp.asarray([1.0, 0.0])
+    out = np.asarray(on.rank_loss(lab, left, right))
+    o = np.array([1.0, 0.0])
+    ref = np.log1p(np.exp(o)) - np.array([1.0, 0.0]) * o
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_dice_loss_perfect_and_disjoint():
+    a = jnp.asarray(np.ones((1, 4, 4), np.float32))
+    assert float(on.dice_loss(a, a)) < 1e-4
+    b = jnp.asarray(np.zeros((1, 4, 4), np.float32))
+    assert float(on.dice_loss(a, b)) > 0.99
+
+
+def test_mean_iou_exact():
+    pred = jnp.asarray(np.array([0, 0, 1, 1], np.int32))
+    lab = jnp.asarray(np.array([0, 1, 1, 1], np.int32))
+    miou, wrong, correct = on.mean_iou(pred, lab, 2)
+    # class0: i=1 u=2 -> 0.5 ; class1: i=2 u=3 -> 2/3
+    np.testing.assert_allclose(float(miou), (0.5 + 2 / 3) / 2, rtol=1e-5)
+
+
+def test_nce_loss_decreases_with_training(rng):
+    # NCE on a tiny classification task must beat random
+    d, n_classes, b = 8, 50, 32
+    x = rng.randn(b, d).astype(np.float32)
+    labels = rng.randint(0, n_classes, (b,)).astype(np.int32)
+
+    def net(x, y):
+        return layers.nce(x, y, num_total_classes=n_classes, num_neg_samples=5,
+                          rng=jax.random.PRNGKey(7)).mean()
+
+    model = pt.build(net)
+    v = model.init(0, x, labels)
+    opt = pt.optimizer.Adam(learning_rate=5e-2)
+    o = opt.create_state(v.params)
+    step = jax.jit(opt.minimize(model))
+    first = None
+    for i in range(30):
+        out = step(v, o, x, labels)
+        v, o = out.variables, out.opt_state
+        if first is None:
+            first = float(out.loss)
+    assert float(out.loss) < first * 0.7, (first, float(out.loss))
+
+
+def test_hsigmoid_trains_and_is_log_cost(rng):
+    d, n_classes, b = 6, 17, 16
+    x = rng.randn(b, d).astype(np.float32)
+    labels = rng.randint(0, n_classes, (b,)).astype(np.int32)
+
+    def net(x, y):
+        return layers.hsigmoid(x, y, num_classes=n_classes).mean()
+
+    model = pt.build(net)
+    v = model.init(0, x, labels)
+    # weight rows = num_classes - 1 internal nodes
+    leaf = jax.tree_util.tree_leaves(v.params)
+    assert any(p.shape[0] == n_classes - 1 for p in leaf if p.ndim == 2)
+    opt = pt.optimizer.Adam(learning_rate=5e-2)
+    o = opt.create_state(v.params)
+    step = jax.jit(opt.minimize(model))
+    losses = []
+    for i in range(25):
+        out = step(v, o, x, labels)
+        v, o = out.variables, out.opt_state
+        losses.append(float(out.loss))
+    assert losses[-1] < losses[0] * 0.8, losses[::8]
+
+
+# ---------------------------------------------------------------------------
+# vision
+# ---------------------------------------------------------------------------
+
+
+def test_image_resize_dispatch(rng):
+    x = rng.randn(1, 4, 6, 3).astype(np.float32)
+    out = ovis.image_resize(jnp.asarray(x), out_shape=(8, 12))
+    assert out.shape == (1, 8, 12, 3)
+    out2 = ovis.image_resize(jnp.asarray(x), scale=2.0, resample="NEAREST")
+    assert out2.shape == (1, 8, 12, 3)
+    short = ovis.image_resize_short(jnp.asarray(x), 8)
+    assert short.shape == (1, 8, 12, 3)
+
+
+def test_random_crop_bounds(rng):
+    x = rng.randn(4, 8, 8, 2).astype(np.float32)
+    out = ovis.random_crop(jnp.asarray(x), (5, 5), jax.random.PRNGKey(3))
+    assert out.shape == (4, 5, 5, 2)
+    # every crop must be a contiguous subwindow of the source
+    xs = np.asarray(x)
+    os_ = np.asarray(out)
+    for i in range(4):
+        found = any(
+            np.allclose(xs[i, y:y + 5, xx:xx + 5], os_[i])
+            for y in range(4) for xx in range(4)
+        )
+        assert found
+
+
+def test_roi_pool_manual(rng):
+    x = rng.randn(1, 8, 8, 1).astype(np.float32)
+    rois = np.array([[0, 0, 3, 3]], np.float32)  # x1,y1,x2,y2
+    idx = np.array([0], np.int32)
+    out = ovis.roi_pool(jnp.asarray(x), jnp.asarray(rois), jnp.asarray(idx), 2, 2)
+    assert out.shape == (1, 2, 2, 1)
+    np.testing.assert_allclose(
+        float(out[0, 0, 0, 0]), x[0, :2, :2, 0].max(), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(out[0, 1, 1, 0]), x[0, 2:4, 2:4, 0].max(), rtol=1e-5
+    )
+
+
+def test_im2sequence_patches(rng):
+    x = rng.randn(2, 4, 4, 3).astype(np.float32)
+    out = ovis.im2sequence(jnp.asarray(x), filter_size=2, stride=2)
+    assert out.shape == (2, 4, 12)
+    # patch (0,0) must contain exactly x[0,:2,:2,:] (any fixed layout)
+    np.testing.assert_allclose(
+        np.sort(np.asarray(out[0, 0])), np.sort(x[0, :2, :2, :].reshape(-1)), rtol=1e-5
+    )
+
+
+# ---------------------------------------------------------------------------
+# rnn units
+# ---------------------------------------------------------------------------
+
+
+def test_gru_unit_layer_step(rng):
+    b, h = 3, 4
+    xp = rng.randn(b, 3 * h).astype(np.float32)
+    hid = rng.randn(b, h).astype(np.float32)
+
+    def net(xp, hid):
+        new_h, _ = layers.gru_unit(xp, hid, size=3 * h)
+        return new_h.sum()
+
+    model = pt.build(net)
+    v = model.init(0, xp, hid)
+    out, _ = model.apply(v, xp, hid)
+    assert np.isfinite(float(out))
+
+
+def test_lstm_unit_layer_step(rng):
+    b, d, h = 3, 5, 4
+    x = rng.randn(b, d).astype(np.float32)
+    hp = rng.randn(b, h).astype(np.float32)
+    cp = rng.randn(b, h).astype(np.float32)
+
+    def net(x, hp, cp):
+        nh, nc = layers.lstm_unit(x, hp, cp)
+        return nh.sum() + nc.sum()
+
+    model = pt.build(net)
+    v = model.init(0, x, hp, cp)
+    out, _ = model.apply(v, x, hp, cp)
+    assert np.isfinite(float(out))
+
+
+def test_dynamic_lstmp_shapes_and_masking(rng):
+    b, t, h, p = 2, 6, 8, 3
+    x = rng.randn(b, t, 4 * h).astype(np.float32)
+    lens = np.array([4, 6], np.int32)
+
+    def net(x, lens):
+        outs, final = layers.dynamic_lstmp(x, size=4 * h, proj_size=p, lengths=lens)
+        return outs
+
+    model = pt.build(net)
+    v = model.init(0, x, lens)
+    outs, _ = model.apply(v, x, lens)
+    assert outs.shape == (b, t, p)
+    assert np.all(np.asarray(outs)[0, 4:] == 0)  # masked past length
+
+
+def test_dynamic_lstmp_final_state_ignores_padding(rng):
+    b, t, h, p = 2, 5, 4, 2
+    w_hh = rng.randn(p, 4 * h).astype(np.float32) * 0.3
+    w_proj = rng.randn(h, p).astype(np.float32) * 0.3
+    x = rng.randn(b, t, 4 * h).astype(np.float32)
+    lens = np.array([3, 5], np.int32)
+    outs, final = orn.dynamic_lstmp(
+        jnp.asarray(x), None, jnp.asarray(w_hh), jnp.asarray(w_proj), lengths=jnp.asarray(lens)
+    )
+    x2 = x.copy()
+    x2[0, 3:] = 77.0  # garbage in padding must not change anything
+    outs2, final2 = orn.dynamic_lstmp(
+        jnp.asarray(x2), None, jnp.asarray(w_hh), jnp.asarray(w_proj), lengths=jnp.asarray(lens)
+    )
+    np.testing.assert_allclose(np.asarray(final.h), np.asarray(final2.h), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs), np.asarray(outs2), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# sequence tail
+# ---------------------------------------------------------------------------
+
+
+def test_sequence_concat(rng):
+    x = rng.randn(2, 3, 2).astype(np.float32)
+    y = rng.randn(2, 4, 2).astype(np.float32)
+    xl = np.array([2, 3], np.int32)
+    yl = np.array([4, 1], np.int32)
+    out, lens = oseq.sequence_concat(
+        jnp.asarray(x), jnp.asarray(xl), jnp.asarray(y), jnp.asarray(yl)
+    )
+    assert out.shape == (2, 7, 2)
+    np.testing.assert_array_equal(np.asarray(lens), [6, 4])
+    np.testing.assert_allclose(np.asarray(out[0, :2]), x[0, :2], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out[0, 2:6]), y[0, :4], rtol=1e-6)
+    assert np.all(np.asarray(out[0, 6:]) == 0)
+    np.testing.assert_allclose(np.asarray(out[1, 3]), y[1, 0], rtol=1e-6)
+
+
+def test_sequence_enumerate():
+    ids = jnp.asarray(np.array([[1, 2, 3, 4, 0]], np.int32))
+    lens = jnp.asarray(np.array([4], np.int32))
+    out = np.asarray(oseq.sequence_enumerate(ids, lens, 2, pad_value=9))
+    np.testing.assert_array_equal(out[0, 0], [1, 2])
+    np.testing.assert_array_equal(out[0, 3], [4, 9])  # window crosses length
+    np.testing.assert_array_equal(out[0, 4], [9, 9])  # fully past length
+
+
+def test_sequence_reshape(rng):
+    x = rng.randn(2, 4, 6).astype(np.float32)
+    lens = np.array([2, 4], np.int32)
+    out, new_lens = oseq.sequence_reshape(jnp.asarray(x), jnp.asarray(lens), 3)
+    assert out.shape == (2, 8, 3)
+    np.testing.assert_array_equal(np.asarray(new_lens), [4, 8])
+    # row data preserved in order
+    np.testing.assert_allclose(
+        np.asarray(out[0]).reshape(-1), x[0].reshape(-1), rtol=1e-6
+    )
+
+
+def test_sequence_scatter():
+    x = jnp.asarray(np.zeros((2, 5), np.float32))
+    ids = jnp.asarray(np.array([[1, 3, 0], [2, 2, 4]], np.int32))
+    idl = jnp.asarray(np.array([2, 3], np.int32))
+    upd = jnp.asarray(np.array([[1.0, 2.0, 99.0], [1.0, 1.0, 5.0]], np.float32))
+    out = np.asarray(oseq.sequence_scatter(x, ids, idl, upd))
+    np.testing.assert_allclose(out[0], [0, 1, 0, 2, 0])  # 99 masked (len 2)
+    np.testing.assert_allclose(out[1], [0, 0, 2, 0, 5])  # duplicate adds
+
+
+def test_sequence_slice(rng):
+    x = rng.randn(2, 6, 2).astype(np.float32)
+    lens = np.array([6, 5], np.int32)
+    off = np.array([1, 0], np.int32)
+    ln = np.array([3, 2], np.int32)
+    out, new_lens = oseq.sequence_slice(
+        jnp.asarray(x), jnp.asarray(lens), jnp.asarray(off), jnp.asarray(ln)
+    )
+    np.testing.assert_allclose(np.asarray(out[0, :3]), x[0, 1:4], rtol=1e-6)
+    assert np.all(np.asarray(out[0, 3:]) == 0)
+    np.testing.assert_array_equal(np.asarray(new_lens), [3, 2])
+
+
+def test_sequence_mask_and_expand_as():
+    lens = jnp.asarray(np.array([2, 4], np.int32))
+    m = np.asarray(oseq.sequence_mask(lens, 5))
+    np.testing.assert_array_equal(m, [[1, 1, 0, 0, 0], [1, 1, 1, 1, 0]])
+    x = jnp.asarray(np.ones((2, 3), np.float32))
+    out = oseq.sequence_expand_as(x, lens, 5)
+    assert out.shape == (2, 5, 3)
+    assert np.all(np.asarray(out[0, 2:]) == 0)
+
+
+def test_lod_reset_and_reorder(rng):
+    x = rng.randn(3, 4).astype(np.float32)
+    _, nl = oseq.lod_reset(jnp.asarray(x), jnp.asarray(np.array([1, 2, 3])))
+    np.testing.assert_array_equal(np.asarray(nl), [1, 2, 3])
+    out = np.asarray(oseq.reorder_by_rank(jnp.asarray(x), jnp.asarray(np.array([2, 0, 1]))))
+    np.testing.assert_allclose(out, x[[2, 0, 1]], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# tensor helpers / control-flow adapters / metrics
+# ---------------------------------------------------------------------------
+
+
+def test_tensor_helpers(rng):
+    x = rng.randn(5, 3).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(layers.assign(x)), x)
+    f = layers.fill_constant_batch_size_like(jnp.asarray(x), [0, 7], "float32", 2.5)
+    assert f.shape == (5, 7) and float(f[0, 0]) == 2.5
+    s = layers.sums([jnp.asarray(x), jnp.asarray(x), jnp.asarray(x)])
+    np.testing.assert_allclose(np.asarray(s), 3 * x, rtol=1e-6)
+    assert layers.is_empty(jnp.zeros((0, 3))) is True
+    assert layers.is_empty(jnp.zeros((1, 3))) is False
+
+
+def test_step_counter_increments(rng):
+    x = np.ones((2, 2), np.float32)
+
+    def net(x):
+        c = layers.autoincreased_step_counter()
+        return x.sum() + 0.0 * c.astype(jnp.float32)
+
+    model = pt.build(net)
+    v = model.init(0, x)
+    out1, v1state = model.apply(v, x)
+    from paddle_tpu.framework import Variables
+
+    v = Variables(v.params, v1state)
+    out2, v2state = model.apply(v, x)
+    (c1,) = [s for s in jax.tree_util.tree_leaves(v1state)]
+    (c2,) = [s for s in jax.tree_util.tree_leaves(v2state)]
+    assert int(c2) == int(c1) + 1
+
+
+def test_while_switch_adapters():
+    out = layers.While(lambda v: v[0] < 5)(lambda v: (v[0] + 1, v[1] * 2), (0, 1))
+    assert out[0] == 5 and out[1] == 32
+    sw = layers.Switch().case(jnp.asarray(False), lambda x: x + 1).case(
+        jnp.asarray(True), lambda x: x + 10
+    ).default(lambda x: x)
+    assert float(sw.build(jnp.asarray(1.0))) == 11.0
+    r = layers.IfElse(jnp.asarray(True))(lambda x: x * 2, lambda x: x, jnp.asarray(3.0))
+    assert float(r) == 6.0
+
+
+def test_beam_search_decode_standalone():
+    # 1 batch, 2 beams, 3 steps with known backpointers
+    tok = jnp.asarray(np.array([[[5, 6]], [[7, 8]], [[9, 10]]], np.int32))  # [T,B,K]
+    ptr = jnp.asarray(np.array([[[0, 0]], [[1, 0]], [[0, 1]]], np.int32))
+    seqs = np.asarray(ocf.beam_search_decode(tok, ptr))
+    # beam 0 at last step: ptr chain 0<-? step2 ptr[0]=0 -> beam0 of step1 (tok 7, ptr 1 -> beam1 of step0: tok 6)
+    np.testing.assert_array_equal(seqs[0, 0], [6, 7, 9])
+    np.testing.assert_array_equal(seqs[0, 1], [5, 8, 10])
+
+
+def test_auc_perfect_and_random(rng):
+    lab = np.array([1, 1, 0, 0], np.float32)
+    perfect = np.array([0.9, 0.8, 0.2, 0.1], np.float32)
+    a = float(layers.auc(jnp.asarray(perfect), jnp.asarray(lab)))
+    assert a > 0.95, a
+    worst = 1.0 - perfect
+    b = float(layers.auc(jnp.asarray(worst), jnp.asarray(lab)))
+    assert b < 0.05, b
+
+
+def test_chunk_eval_iob():
+    # tags: type*2 + {B=0,I=1}, O = num_types*2. 2 types -> O=4
+    label = np.array([[0, 1, 4, 2, 3, 4]], np.int32)  # chunk A:[0,1] type0, B:[3,4] type1
+    lens = np.array([6], np.int32)
+    perfect = label.copy()
+    ni, nl, nc = layers.chunk_eval(
+        jnp.asarray(perfect), jnp.asarray(label), jnp.asarray(lens), num_chunk_types=2
+    )
+    assert int(ni) == 2 and int(nl) == 2 and int(nc) == 2
+    # wrong second chunk type
+    infer = np.array([[0, 1, 4, 0, 1, 4]], np.int32)
+    ni, nl, nc = layers.chunk_eval(
+        jnp.asarray(infer), jnp.asarray(label), jnp.asarray(lens), num_chunk_types=2
+    )
+    assert int(ni) == 2 and int(nl) == 2 and int(nc) == 1
+
+
+def test_append_lars_scaling():
+    p = jnp.asarray(np.ones((10,), np.float32))
+    g = jnp.asarray(np.full((10,), 0.1, np.float32))
+    lr = layers.append_LARS(1.0, p, g)
+    # ||w||=sqrt(10), ||g||=0.1*sqrt(10): local = 0.001*||w||/(||g||+wd*||w||)
+    wn, gn = np.sqrt(10), 0.1 * np.sqrt(10)
+    ref = 0.001 * wn / (gn + 0.0005 * wn + 1e-9)
+    np.testing.assert_allclose(float(lr), ref, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# io layers
+# ---------------------------------------------------------------------------
+
+
+def test_py_reader_pipeline(rng):
+    data = [
+        (np.full((2, 3), i, np.float32), np.array([i], np.int64)) for i in range(5)
+    ]
+    r = layers.py_reader(capacity=4, shapes=[[2, 3], [1]], dtypes=["float32", "int64"])
+    r.decorate_paddle_reader(lambda: iter(data))
+    got = list(r)
+    assert len(got) == 5
+    np.testing.assert_allclose(np.asarray(got[3][0]), data[3][0])
+
+
+def test_double_buffer_and_random_generator():
+    gen = layers.random_data_generator(-1.0, 1.0, [[2, 2]], seed=3, count=4)
+    items = list(layers.double_buffer(gen)())
+    assert len(items) == 4 and items[0][0].shape == (2, 2)
+
+
+def test_preprocessor(rng):
+    src = lambda: iter([(np.float32(1.0),), (np.float32(2.0),)])
+    p = layers.Preprocessor(src)
+    p.block(lambda v: (v * 10,))
+    out = [v[0] for v in p()]
+    np.testing.assert_allclose(out, [10.0, 20.0])
+
+
+def test_open_files_recordio_roundtrip(tmp_path, rng):
+    from paddle_tpu import native
+
+    path = str(tmp_path / "a.recordio")
+    w = native.RecordIOWriter(path)
+    arr = rng.randn(2, 3).astype(np.float32)
+    lab = np.array([4], np.int32)
+    for _ in range(3):
+        w.write(arr.tobytes() + lab.tobytes())
+    w.close()
+    r = layers.open_files([path], shapes=[[2, 3], [1]], dtypes=["float32", "int32"])
+    items = list(r())
+    assert len(items) == 3
+    np.testing.assert_allclose(items[0][0], arr)
+    np.testing.assert_array_equal(items[0][1], lab)
