@@ -1,0 +1,199 @@
+"""Multi-process distributed test on localhost subprocesses (VERDICT
+round-1 item 2 / reference test strategy §4.5: ``test_dist_base.py:27-100``
+forks pserver+trainer processes on 127.0.0.1 and compares losses).
+
+Here: two CPU processes bootstrap through ``initialize_distributed`` (the
+gen_nccl_id/NCCLContextMap replacement — JAX coordination service), build a
+global 2-process mesh (DCN-style: one mesh axis spanning processes), run a
+psum and a data-parallel train step on sharded global arrays, and the
+results must (a) agree across processes and (b) match the single-process
+baseline bit-for-bit."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = r"""
+import os, sys, json
+sys.path.insert(0, os.environ["PT_REPO"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from paddle_tpu.parallel.mesh import initialize_distributed, make_mesh
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+initialize_distributed()  # reads PADDLE_COORDINATOR_ADDR / TRAINERS / TRAINER_ID
+
+pid = jax.process_index()
+nproc = jax.process_count()
+assert nproc == 2, nproc
+mesh = make_mesh(data=2)
+
+# 1) psum over the process-spanning axis: each process contributes its rank+1
+local = np.full((1, 4), float(pid + 1), np.float32)
+global_shape = (2, 4)
+sharding = NamedSharding(mesh, P("data", None))
+arr = jax.make_array_from_process_local_data(sharding, local, global_shape)
+
+@jax.jit
+def allreduce(x):
+    def inner(x):
+        return jax.lax.psum(x, "data")
+    from jax.experimental.shard_map import shard_map
+    return shard_map(inner, mesh=mesh, in_specs=P("data", None), out_specs=P("data", None))(x)
+
+out = allreduce(arr)
+local_out = np.asarray(out.addressable_shards[0].data)
+# psum of rows (1s from p0, 2s from p1) -> every shard sees 3
+assert np.allclose(local_out, 3.0), local_out
+
+# 2) a DP train step on a deterministic model: both processes must compute
+# the identical loss (same global batch, grads allreduced by pjit)
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+def net(x, y):
+    p = layers.fc(x, 1, name="w")
+    return pt.layers.square_error_cost(p[:, 0], y).mean()
+
+rng = np.random.RandomState(0)
+gx = rng.randn(8, 3).astype(np.float32)
+gy = rng.randn(8).astype(np.float32)
+model = pt.build(net)
+v = model.init(0, gx[:1], gy[:1])
+opt = pt.optimizer.SGD(learning_rate=0.1)
+ostate = opt.create_state(v.params)
+
+xsh = NamedSharding(mesh, P("data", None))
+ysh = NamedSharding(mesh, P("data"))
+lx = gx[pid * 4:(pid + 1) * 4]
+ly = gy[pid * 4:(pid + 1) * 4]
+gxa = jax.make_array_from_process_local_data(xsh, lx, (8, 3))
+gya = jax.make_array_from_process_local_data(ysh, ly, (8,))
+
+step = jax.jit(opt.minimize(model))
+losses = []
+for i in range(3):
+    o = step(v, ostate, gxa, gya)
+    v, ostate = o.variables, o.opt_state
+    losses.append(float(jax.device_get(o.loss)))
+
+print("RESULT " + json.dumps({"pid": pid, "losses": losses}))
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_dcn_mesh(tmp_path):
+    port = _free_port()
+    worker_path = tmp_path / "dist_worker.py"
+    worker_path.write_text(_WORKER)
+    procs = []
+    env_base = {
+        **os.environ,
+        "PADDLE_COORDINATOR_ADDR": f"127.0.0.1:{port}",
+        "PADDLE_TRAINERS": "2",
+        "JAX_PLATFORMS": "cpu",
+        "PT_REPO": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    }
+    env_base.pop("XLA_FLAGS", None)  # 1 device per process: true multi-proc
+    for pid in range(2):
+        env = {**env_base, "PADDLE_TRAINER_ID": str(pid)}
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(worker_path)],
+                env=env,
+                cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                r = json.loads(line[len("RESULT "):])
+                results[r["pid"]] = r["losses"]
+    assert set(results) == {0, 1}
+    # both processes computed the same global losses
+    np.testing.assert_allclose(results[0], results[1], rtol=0, atol=0)
+    # and training moved the loss
+    assert results[0][-1] < results[0][0]
+
+
+def test_single_process_baseline_matches(tmp_path):
+    """The distributed losses must equal a plain single-process run of the
+    same model on the full batch (the test_dist_base 'compare with local
+    baseline' discipline)."""
+    port = _free_port()
+    worker_path = tmp_path / "dist_worker.py"
+    worker_path.write_text(_WORKER)
+    env = {
+        **os.environ,
+        "PADDLE_COORDINATOR_ADDR": f"127.0.0.1:{port}",
+        "PADDLE_TRAINERS": "2",
+        "JAX_PLATFORMS": "cpu",
+        "PADDLE_TRAINER_ID": "0",
+        "PT_REPO": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    }
+    env.pop("XLA_FLAGS", None)
+    p0 = subprocess.Popen(
+        [sys.executable, str(worker_path)], env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    p1 = subprocess.Popen(
+        [sys.executable, str(worker_path)],
+        env={**env, "PADDLE_TRAINER_ID": "1"},
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    outs = []
+    for p in (p0, p1):
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, err[-3000:]
+        outs.append(out)
+    dist_losses = None
+    for line in outs[0].splitlines():
+        if line.startswith("RESULT "):
+            dist_losses = json.loads(line[len("RESULT "):])["losses"]
+    assert dist_losses is not None
+
+    # local baseline (in-process, single device)
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    def net(x, y):
+        p = layers.fc(x, 1, name="w")
+        return pt.layers.square_error_cost(p[:, 0], y).mean()
+
+    rng = np.random.RandomState(0)
+    gx = rng.randn(8, 3).astype(np.float32)
+    gy = rng.randn(8).astype(np.float32)
+    model = pt.build(net)
+    v = model.init(0, gx[:1], gy[:1])
+    opt = pt.optimizer.SGD(learning_rate=0.1)
+    ostate = opt.create_state(v.params)
+    step = jax.jit(opt.minimize(model))
+    base = []
+    for i in range(3):
+        o = step(v, ostate, gx, gy)
+        v, ostate = o.variables, o.opt_state
+        base.append(float(o.loss))
+    np.testing.assert_allclose(dist_losses, base, rtol=1e-6, atol=1e-7)
