@@ -38,11 +38,22 @@ class CheckpointConfig:
         max_num_checkpoints: int = 3,
         epoch_interval: int = 1,
         step_interval: int = 10,
+        sharded: Optional[bool] = None,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.max_num_checkpoints = max_num_checkpoints
         self.epoch_interval = max(1, epoch_interval)
         self.step_interval = max(1, step_interval)
+        # None = auto: process-local shard files when running multi-host
+        # (the trainer.py:663 per-shard layout); full-tree npz single-host
+        self.sharded = sharded
+
+    def use_sharded(self) -> bool:
+        if self.sharded is not None:
+            return self.sharded
+        import jax
+
+        return jax.process_count() > 1
 
 
 def _serial_dir(root: str, serial: int) -> str:

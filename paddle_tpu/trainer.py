@@ -119,6 +119,21 @@ class Trainer:
         # trainer.py:594-629)
         if self.checkpoint_cfg is not None:
             root = self.checkpoint_cfg.checkpoint_dir
+            if self.checkpoint_cfg.use_sharded():
+                from paddle_tpu import checkpoint_sharded as cks
+
+                if cks.latest_sharded_checkpoint(root):
+                    tree = (self.variables, self.opt_state)
+                    tree, meta = cks.load_sharded(root, tree)
+                    self.variables, self.opt_state = tree
+                    self.epoch = int(meta.get("next_epoch", meta.get("epoch", 0)))
+                    self.global_step = int(meta.get("step", 0))
+                    self._last_saved_step = self.global_step
+                    ptlog.vlog(
+                        0, "resumed from sharded checkpoint: epoch %d step %d",
+                        self.epoch, self.global_step,
+                    )
+                return
             if ckpt_mod.latest_checkpoint(root):
                 tree = (self.variables, self.opt_state)
                 tree, meta = ckpt_mod.load_checkpoint(root, tree, self.trainer_id)
@@ -196,21 +211,39 @@ class Trainer:
         # if a step save already captured this state, don't save a duplicate
         # serial — but an epoch boundary must still bump next_epoch in the
         # metadata so resume skips the completed epoch
+        sharded = cfg.use_sharded()
         if self.global_step == self._last_saved_step:
             if not step:
-                ckpt_mod.update_meta(
-                    cfg.checkpoint_dir, {"next_epoch": self.epoch + 1}
-                )
+                if sharded:
+                    from paddle_tpu import checkpoint_sharded as cks
+
+                    cks.update_manifest(cfg.checkpoint_dir, {"next_epoch": self.epoch + 1})
+                else:
+                    ckpt_mod.update_meta(
+                        cfg.checkpoint_dir, {"next_epoch": self.epoch + 1}
+                    )
             return
-        ckpt_mod.save_checkpoint(
-            cfg.checkpoint_dir,
-            (self.variables, self.opt_state),
-            step=self.global_step,
-            epoch=self.epoch,
-            max_num_checkpoints=cfg.max_num_checkpoints,
-            trainer_id=self.trainer_id,
-            extra_meta={"next_epoch": self.epoch + (0 if step else 1)},
-        )
+        if sharded:
+            from paddle_tpu import checkpoint_sharded as cks
+
+            cks.save_sharded(
+                cfg.checkpoint_dir,
+                (self.variables, self.opt_state),
+                step=self.global_step,
+                epoch=self.epoch,
+                max_num_checkpoints=cfg.max_num_checkpoints,
+                extra_meta={"next_epoch": self.epoch + (0 if step else 1)},
+            )
+        else:
+            ckpt_mod.save_checkpoint(
+                cfg.checkpoint_dir,
+                (self.variables, self.opt_state),
+                step=self.global_step,
+                epoch=self.epoch,
+                max_num_checkpoints=cfg.max_num_checkpoints,
+                trainer_id=self.trainer_id,
+                extra_meta={"next_epoch": self.epoch + (0 if step else 1)},
+            )
         self._last_saved_step = self.global_step
 
     # -- eval / predict -----------------------------------------------------

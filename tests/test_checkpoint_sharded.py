@@ -1,0 +1,150 @@
+"""Sharded checkpoint tests on the 8-device CPU mesh (VERDICT item 6: done =
+round-trip restoring sharded params bit-exact)."""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu import checkpoint_sharded as cks
+from paddle_tpu.parallel.mesh import make_mesh
+
+
+def _sharded_tree(mesh, rng):
+    wsh = NamedSharding(mesh, P("data", "model"))
+    rsh = NamedSharding(mesh, P(None, "model"))
+    rep = NamedSharding(mesh, P())
+    w = jax.device_put(rng.randn(8, 4).astype(np.float32), wsh)
+    r = jax.device_put(rng.randn(6, 4).astype(np.float32), rsh)
+    b = jax.device_put(rng.randn(5).astype(np.float32), rep)
+    return {"w": w, "nested": {"r": r, "b": b}}
+
+
+def test_roundtrip_bit_exact(tmp_path, rng):
+    mesh = make_mesh(data=4, model=2)
+    tree = _sharded_tree(mesh, rng)
+    path = cks.save_sharded(str(tmp_path), tree, step=7, extra_meta={"tag": "x"})
+    assert os.path.exists(os.path.join(path, "manifest.json"))
+
+    restored, manifest = cks.load_sharded(str(tmp_path), tree)
+    assert manifest["step"] == 7 and manifest["tag"] == "x"
+    for a, b in zip(jax.tree_util.tree_leaves(tree), jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert a.sharding.is_equivalent_to(b.sharding, a.ndim)
+
+
+def test_replicated_dedup_single_owner(tmp_path, rng):
+    """Replicated leaves must be written once (replica_id==0), not 8x."""
+    mesh = make_mesh(data=8)
+    rep = jax.device_put(rng.randn(16).astype(np.float32), NamedSharding(mesh, P()))
+    path = cks.save_sharded(str(tmp_path), {"p": rep}, step=0)
+    with np.load(os.path.join(path, "shards_p0.npz")) as z:
+        assert len(z.files) == 1  # one block for the whole replicated array
+
+
+def test_resharded_restore(tmp_path, rng):
+    """Save under one sharding, restore under another: piecewise assembly."""
+    mesh = make_mesh(data=4, model=2)
+    w = jax.device_put(
+        rng.randn(8, 4).astype(np.float32), NamedSharding(mesh, P("data", "model"))
+    )
+    cks.save_sharded(str(tmp_path), {"w": w}, step=1)
+
+    mesh2 = make_mesh(data=2, model=4)
+    target = jax.ShapeDtypeStruct((8, 4), np.float32, sharding=NamedSharding(mesh2, P("model", None)))
+    restored, _ = cks.load_sharded(str(tmp_path), {"w": target})
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.asarray(w))
+    assert restored["w"].sharding.is_equivalent_to(target.sharding, 2)
+
+
+def test_latest_and_prune(tmp_path, rng):
+    mesh = make_mesh(data=8)
+    t = {"p": jax.device_put(rng.randn(8).astype(np.float32), NamedSharding(mesh, P("data")))}
+    for s in (1, 2, 3, 4):
+        cks.save_sharded(str(tmp_path), t, step=s, max_num_checkpoints=2)
+    assert cks.latest_sharded_checkpoint(str(tmp_path)).endswith("checkpoint_4")
+    kept = sorted(os.listdir(tmp_path))
+    assert kept == ["checkpoint_3", "checkpoint_4"], kept
+
+
+def test_corrupt_manifest_refused(tmp_path, rng):
+    mesh = make_mesh(data=8)
+    t = {"p": jax.device_put(rng.randn(8).astype(np.float32), NamedSharding(mesh, P("data")))}
+    cks.save_sharded(str(tmp_path), t, step=1)
+    # target with wrong leaf count must be rejected, not silently misloaded
+    with pytest.raises(Exception):
+        cks.load_sharded(str(tmp_path), {"p": t["p"], "q": t["p"]})
+
+
+def test_trainstate_roundtrip_through_optimizer(tmp_path, rng):
+    """Full train-state (params + opt slots) round-trip under dp sharding."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.parallel import DataParallel
+
+    mesh = make_mesh(data=8)
+
+    def net(x, y):
+        p = layers.fc(x, 4, act="relu", name="h")
+        p = layers.fc(p, 1, name="o")
+        return pt.layers.square_error_cost(p[:, 0], y).mean()
+
+    model = pt.build(net)
+    x = rng.randn(16, 3).astype(np.float32)
+    y = rng.randn(16).astype(np.float32)
+    dp = DataParallel(model, pt.optimizer.Adam(learning_rate=1e-2), mesh=mesh, donate=False)
+    v, o = dp.init(0, x, y)
+    out = dp.step(v, o, *dp.put_batch(x, y))
+    v, o = out.variables, out.opt_state
+
+    cks.save_sharded(str(tmp_path), {"v": v, "o": o}, step=1)
+    restored, _ = cks.load_sharded(str(tmp_path), {"v": v, "o": o})
+    for a, b in zip(
+        jax.tree_util.tree_leaves({"v": v, "o": o}),
+        jax.tree_util.tree_leaves(restored),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # resumed state must continue training identically
+    out1 = dp.step(v, o, *dp.put_batch(x, y))
+    out2 = dp.step(restored["v"], restored["o"], *dp.put_batch(x, y))
+    assert float(out1.loss) == float(out2.loss)
+
+
+def test_trainer_sharded_checkpoint_resume(tmp_path, rng):
+    """Trainer with CheckpointConfig(sharded=True): save during training,
+    then a fresh Trainer auto-resumes from the sharded layout."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.checkpoint import CheckpointConfig
+    from paddle_tpu.trainer import Trainer
+
+    def net(x, y):
+        p = layers.fc(x, 4, act="relu", name="h")
+        p = layers.fc(p, 1, name="o")
+        return pt.layers.square_error_cost(p[:, 0], y).mean()
+
+    x = rng.randn(16, 3).astype(np.float32)
+    y = rng.randn(16).astype(np.float32)
+
+    def reader():
+        for i in range(4):
+            yield (x, y)
+
+    cfg = CheckpointConfig(str(tmp_path / "ck"), step_interval=2, sharded=True)
+    t1 = Trainer(lambda: pt.build(net), lambda: pt.optimizer.Adam(learning_rate=1e-2),
+                 checkpoint_config=cfg, parallel=True)
+    t1.train(num_epochs=1, reader=reader)
+    assert t1.global_step == 4
+
+    t2 = Trainer(lambda: pt.build(net), lambda: pt.optimizer.Adam(learning_rate=1e-2),
+                 checkpoint_config=cfg, parallel=True)
+    t2.train(num_epochs=1, reader=reader)  # resumes at epoch 1 -> no new steps
+    assert t2.global_step == 4
+    for a, b in zip(
+        jax.tree_util.tree_leaves(t1.variables.params),
+        jax.tree_util.tree_leaves(t2.variables.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

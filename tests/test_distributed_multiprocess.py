@@ -84,6 +84,23 @@ for i in range(3):
     v, ostate = o.variables, o.opt_state
     losses.append(float(jax.device_get(o.loss)))
 
+# 3) sharded checkpoint across processes: each process writes only its own
+# shards; restore must be bit-exact (trainer.py:663 per-shard save parity)
+ckpt_dir = os.environ.get("PT_CKPT_DIR")
+if ckpt_dir:
+    from paddle_tpu import checkpoint_sharded as cks
+    path = cks.save_sharded(ckpt_dir, {"params": v.params, "x": gxa}, step=3)
+    restored, manifest = cks.load_sharded(ckpt_dir, {"params": v.params, "x": gxa})
+    for a, b in zip(jax.tree_util.tree_leaves(v.params), jax.tree_util.tree_leaves(restored["params"])):
+        la = np.asarray(a.addressable_shards[0].data)
+        lb = np.asarray(b.addressable_shards[0].data)
+        assert np.array_equal(la, lb)
+    lx_r = np.asarray(restored["x"].addressable_shards[0].data)
+    assert np.array_equal(lx_r, lx), (lx_r, lx)
+    # exactly one shard file per process
+    import glob as _g
+    assert len(_g.glob(os.path.join(path, "shards_p*.npz"))) == 2
+
 print("RESULT " + json.dumps({"pid": pid, "losses": losses}))
 """
 
@@ -107,6 +124,7 @@ def test_two_process_dcn_mesh(tmp_path):
         "PADDLE_TRAINERS": "2",
         "JAX_PLATFORMS": "cpu",
         "PT_REPO": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PT_CKPT_DIR": str(tmp_path / "ckpt"),
     }
     env_base.pop("XLA_FLAGS", None)  # 1 device per process: true multi-proc
     for pid in range(2):
