@@ -386,6 +386,51 @@ def sequence_conv(
         return _act(out, act)
 
 
+def multi_box_head(
+    inputs: Sequence[jax.Array],
+    image_shape: Tuple[int, int],
+    num_classes: int,
+    min_sizes: Sequence[float],
+    max_sizes: Sequence[float] = (),
+    aspect_ratios: Optional[Sequence[Sequence[float]]] = None,
+    flip: bool = True,
+    clip: bool = False,
+    name: Optional[str] = None,
+):
+    """SSD MultiBox head (reference fluid ``layers.detection.multi_box_head``):
+    for each feature map, a 3x3 conv predicts per-prior location offsets and
+    class scores, and prior_box emits the matching priors. Returns
+    (mbox_locs [P, 4], mbox_confs [P, C], boxes [P, 4], variances [P, 4])
+    with P = total priors across maps, batch folded into the leading axis of
+    locs/confs when inputs are batched."""
+    from paddle_tpu.ops import detection as odet
+
+    aspect_ratios = aspect_ratios or [[2.0]] * len(inputs)
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    with name_scope(name or "multi_box_head"):
+        for i, feat in enumerate(inputs):
+            h, w = feat.shape[1], feat.shape[2]
+            boxes, variances = odet.prior_box(
+                (h, w), image_shape, [min_sizes[i]],
+                [max_sizes[i]] if i < len(max_sizes) else (),
+                aspect_ratios[i], flip=flip, clip=clip,
+            )
+            p = boxes.shape[2]  # priors per cell
+            loc = conv2d(feat, p * 4, 3, padding=1, name=f"loc_{i}")
+            conf = conv2d(feat, p * num_classes, 3, padding=1, name=f"conf_{i}")
+            b = feat.shape[0]
+            locs.append(loc.reshape(b, h * w * p, 4))
+            confs.append(conf.reshape(b, h * w * p, num_classes))
+            boxes_all.append(boxes.reshape(-1, 4))
+            vars_all.append(variances.reshape(-1, 4))
+    return (
+        jnp.concatenate(locs, axis=1),
+        jnp.concatenate(confs, axis=1),
+        jnp.concatenate(boxes_all, axis=0),
+        jnp.concatenate(vars_all, axis=0),
+    )
+
+
 def data(name: str, shape: Sequence[int], dtype="float32", lod_level: int = 0):
     """Compatibility no-op: under tracing, inputs are just function args.
     Returns a ShapeDtypeStruct usable for documentation/feeding order."""
@@ -459,6 +504,16 @@ from paddle_tpu.ops.detection import (  # noqa: F401
     box_coder,
     iou_similarity,
     multiclass_nms,
+    detection_output,
+    ssd_loss,
+    detection_map,
+)
+from paddle_tpu.ops.detection_rpn import (  # noqa: F401
+    rpn_target_assign,
+    generate_proposals,
+    generate_proposal_labels,
+    roi_perspective_transform,
+    polygon_box_transform,
 )
 from paddle_tpu.lr_scheduler import (  # noqa: F401
     exponential_decay,
@@ -501,6 +556,9 @@ _OP_REEXPORTS = [
     "ctc_greedy_decoder",
     "prior_box", "anchor_generator", "bipartite_match", "target_assign",
     "box_coder", "iou_similarity", "multiclass_nms",
+    "detection_output", "ssd_loss", "detection_map",
+    "rpn_target_assign", "generate_proposals", "generate_proposal_labels",
+    "roi_perspective_transform", "polygon_box_transform", "multi_box_head",
     "exponential_decay", "natural_exp_decay", "inverse_time_decay",
     "polynomial_decay", "piecewise_decay", "noam_decay", "cosine_decay",
     "append_LARS",

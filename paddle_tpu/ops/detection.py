@@ -32,6 +32,9 @@ __all__ = [
     "multiclass_nms",
     "target_assign",
     "box_clip",
+    "detection_output",
+    "ssd_loss",
+    "detection_map",
 ]
 
 
@@ -325,4 +328,166 @@ def box_clip(boxes: jax.Array, image_shape: Tuple[float, float]) -> jax.Array:
             jnp.clip(boxes[..., 3], 0.0, h),
         ],
         axis=-1,
+    )
+
+
+def detection_output(
+    loc: jax.Array,
+    scores: jax.Array,
+    prior_boxes: jax.Array,
+    prior_variances: jax.Array,
+    background_label: int = 0,
+    nms_threshold: float = 0.3,
+    nms_top_k: int = 400,
+    keep_top_k: int = 200,
+    score_threshold: float = 0.01,
+) -> Tuple[jax.Array, jax.Array]:
+    """SSD inference head (reference ``detection_output`` in
+    ``layers/detection.py`` = box_coder decode + multiclass_nms ops): decode
+    per-prior location offsets against priors, then multi-class NMS over the
+    class scores. ``loc`` [P, 4], ``scores`` [P, C] (post-softmax),
+    priors/variances [P, 4]. Returns (dets [keep_top_k, 6], count)."""
+    boxes = box_coder(prior_boxes, prior_variances, loc, "decode_center_size")
+    return multiclass_nms(
+        boxes,
+        scores.T,  # [C, P]
+        score_threshold=score_threshold,
+        nms_threshold=nms_threshold,
+        nms_top_k=nms_top_k,
+        keep_top_k=keep_top_k,
+        background_label=background_label,
+    )
+
+
+def ssd_loss(
+    loc: jax.Array,
+    confidence: jax.Array,
+    gt_boxes: jax.Array,
+    gt_labels: jax.Array,
+    gt_valid: jax.Array,
+    prior_boxes: jax.Array,
+    prior_variances: jax.Array,
+    background_label: int = 0,
+    overlap_threshold: float = 0.5,
+    neg_pos_ratio: float = 3.0,
+    loc_loss_weight: float = 1.0,
+    conf_loss_weight: float = 1.0,
+) -> jax.Array:
+    """MultiBox SSD training loss (reference fluid ``layers.detection.ssd_loss``,
+    composing bipartite_match → target_assign → smooth_l1 + softmax CE with
+    hard negative mining at ``neg_pos_ratio``). Single-image form: ``loc``
+    [P, 4] predicted offsets, ``confidence`` [P, C] logits, gt_boxes [G, 4]
+    (padded; ``gt_valid`` [G] bool), gt_labels [G] int. Returns scalar loss.
+
+    TPU design: matching is bipartite + per-prior IoU threshold (the
+    reference's per_prediction mode), negative mining is a fixed-shape top-k
+    over background losses — no dynamic-size mined lists."""
+    P, C = confidence.shape
+    sim = iou_similarity(gt_boxes, prior_boxes)  # [G, P]
+    sim = jnp.where(gt_valid[:, None], sim, 0.0)
+    match_idx, match_dist = bipartite_match(sim)  # per-prior gt or -1
+    # per_prediction augmentation: any prior with IoU >= threshold matches
+    best = jnp.max(sim, axis=0)
+    best_gt = jnp.argmax(sim, axis=0)
+    extra = (best >= overlap_threshold) & (match_idx < 0)
+    match_idx = jnp.where(extra, best_gt.astype(jnp.int32), match_idx)
+
+    matched = match_idx >= 0
+    safe_gt = jnp.maximum(match_idx, 0)
+    n_pos = jnp.maximum(jnp.sum(matched.astype(jnp.int32)), 1)
+
+    # localization loss on matched priors (encode gt against priors)
+    g = gt_boxes[safe_gt]
+    pcx, pcy, pw, ph = _box_to_cwh(prior_boxes)
+    gcx, gcy, gw, gh = _box_to_cwh(g)
+    var = prior_variances
+    t = jnp.stack(
+        [
+            (gcx - pcx) / jnp.maximum(pw, 1e-6) / var[:, 0],
+            (gcy - pcy) / jnp.maximum(ph, 1e-6) / var[:, 1],
+            jnp.log(jnp.maximum(gw, 1e-6) / jnp.maximum(pw, 1e-6)) / var[:, 2],
+            jnp.log(jnp.maximum(gh, 1e-6) / jnp.maximum(ph, 1e-6)) / var[:, 3],
+        ],
+        axis=-1,
+    )
+    diff = jnp.abs(loc - t)
+    loc_l = jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5).sum(-1)
+    loc_loss = jnp.sum(jnp.where(matched, loc_l, 0.0)) / n_pos
+
+    # confidence loss with hard negative mining
+    labels = jnp.where(matched, gt_labels[safe_gt].astype(jnp.int32), background_label)
+    logp = jax.nn.log_softmax(confidence.astype(jnp.float32), axis=-1)
+    ce = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]  # [P]
+    neg_ce = -logp[:, background_label]
+    n_neg = jnp.minimum(
+        (neg_pos_ratio * n_pos).astype(jnp.int32), P - n_pos
+    )
+    neg_scores = jnp.where(matched, NEG_INF, neg_ce)
+    rank = jnp.argsort(jnp.argsort(-neg_scores))
+    neg_sel = (~matched) & (rank < n_neg)
+    conf_loss = (
+        jnp.sum(jnp.where(matched | neg_sel, ce, 0.0)) / n_pos
+    )
+    return loc_loss_weight * loc_loss + conf_loss_weight * conf_loss
+
+
+def detection_map(
+    dets: jax.Array,
+    det_count: jax.Array,
+    gt_boxes: jax.Array,
+    gt_labels: jax.Array,
+    gt_valid: jax.Array,
+    num_classes: int,
+    overlap_threshold: float = 0.5,
+    ap_version: str = "integral",
+) -> jax.Array:
+    """Mean average precision over detection output (reference
+    ``detection_map_op.cc``): greedy-match detections (sorted by score) to
+    unmatched same-class gt at IoU >= threshold, accumulate per-class
+    precision/recall, AP by integral (or 11-point) rule. Single-image form;
+    ``dets`` [K, 6] rows [class, score, x1, y1, x2, y2] (class -1 = pad)."""
+    K = dets.shape[0]
+    cls = dets[:, 0].astype(jnp.int32)
+    scores = dets[:, 1]
+    boxes = dets[:, 2:6]
+    valid_det = (jnp.arange(K) < det_count) & (cls >= 0)
+    order = jnp.argsort(-jnp.where(valid_det, scores, NEG_INF))
+    cls, boxes = cls[order], boxes[order]
+    valid_det = valid_det[order]
+
+    iou = iou_similarity(boxes, gt_boxes)  # [K, G]
+    same_cls = cls[:, None] == gt_labels[None, :].astype(jnp.int32)
+    cand = iou * same_cls.astype(jnp.float32) * gt_valid[None, :].astype(jnp.float32)
+
+    def body(i, state):
+        gt_used, tp = state
+        row = jnp.where(gt_used, 0.0, cand[i])
+        j = jnp.argmax(row)
+        ok = valid_det[i] & (row[j] >= overlap_threshold)
+        gt_used = jnp.where(ok, gt_used.at[j].set(True), gt_used)
+        tp = tp.at[i].set(ok.astype(jnp.float32))
+        return gt_used, tp
+
+    g = gt_boxes.shape[0]
+    gt_used0 = jnp.zeros((g,), bool)
+    _, tp = jax.lax.fori_loop(0, K, body, (gt_used0, jnp.zeros((K,), jnp.float32)))
+    fp = jnp.where(valid_det, 1.0 - tp, 0.0)
+
+    # per-class AP (vectorized over classes)
+    def ap_for(c):
+        m = (cls == c) & valid_det
+        n_gt = jnp.sum((gt_labels.astype(jnp.int32) == c) & gt_valid)
+        tpc = jnp.cumsum(jnp.where(m, tp, 0.0))
+        fpc = jnp.cumsum(jnp.where(m, fp, 0.0))
+        recall = tpc / jnp.maximum(n_gt, 1)
+        precision = tpc / jnp.maximum(tpc + fpc, 1e-8)
+        # integral AP: sum precision * delta-recall at true positives
+        dr = jnp.diff(recall, prepend=0.0)
+        ap = jnp.sum(jnp.where(m, precision * dr, 0.0))
+        return jnp.where(n_gt > 0, ap, jnp.nan)
+
+    aps = jax.vmap(ap_for)(jnp.arange(1, num_classes))
+    present = ~jnp.isnan(aps)
+    return jnp.where(
+        jnp.any(present), jnp.nansum(jnp.where(present, aps, 0.0)) / jnp.maximum(jnp.sum(present), 1), 0.0
     )

@@ -1,0 +1,289 @@
+"""RPN / Faster-R-CNN detection ops + EAST geometry transforms.
+
+Reference: ``paddle/fluid/operators/detection/rpn_target_assign_op.cc``,
+``generate_proposals_op.cc``, ``generate_proposal_labels_op.cc``,
+``roi_perspective_transform_op.cc``, ``polygon_box_transform_op.cc``.
+
+The reference kernels emit LoD-sized outputs from per-box CPU loops; the
+TPU-native versions are fixed-shape vectorized programs: subsampling uses
+random-priority top-k instead of shuffles, proposal lists are padded to
+``post_nms_top_n`` with validity counts, and the perspective warp solves the
+4-point homography batched with ``jnp.linalg.solve``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import NEG_INF
+from paddle_tpu.ops.detection import box_clip, box_coder, iou_similarity, nms
+
+__all__ = [
+    "rpn_target_assign",
+    "generate_proposals",
+    "generate_proposal_labels",
+    "roi_perspective_transform",
+    "polygon_box_transform",
+]
+
+
+def _sample_topk(eligible: jax.Array, k: int, rng: jax.Array) -> jax.Array:
+    """Pick up to ``k`` of the eligible entries uniformly at random with a
+    fixed-shape program: random priorities + top-k (the reference's
+    ReservoirSampling / random_shuffle loops)."""
+    n = eligible.shape[0]
+    pri = jnp.where(eligible, jax.random.uniform(rng, (n,)), -1.0)
+    _, idx = jax.lax.top_k(pri, min(k, n))
+    chosen = jnp.zeros((n,), bool).at[idx].set(True)
+    return chosen & eligible
+
+
+def rpn_target_assign(
+    anchors: jax.Array,
+    gt_boxes: jax.Array,
+    gt_valid: jax.Array,
+    rng: jax.Array,
+    rpn_batch_size_per_im: int = 256,
+    fg_fraction: float = 0.5,
+    rpn_positive_overlap: float = 0.7,
+    rpn_negative_overlap: float = 0.3,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Assign RPN training targets (reference ``rpn_target_assign_op.cc``):
+    fg = anchors with IoU >= positive_overlap with any gt, plus the best
+    anchor per gt; bg = IoU < negative_overlap; subsample to
+    ``rpn_batch_size_per_im`` at ``fg_fraction``. Fixed-shape outputs:
+
+    returns (labels [A] int32 {1 fg, 0 bg, -1 ignore},
+             bbox_targets [A, 4] encoded vs anchors,
+             loc_weight [A] 1.0 on fg,
+             score_weight [A] 1.0 on sampled fg+bg).
+
+    ``gt_boxes`` [G, 4] padded, ``gt_valid`` [G] bool.
+    """
+    a = anchors.shape[0]
+    iou = iou_similarity(gt_boxes, anchors)  # [G, A]
+    iou = jnp.where(gt_valid[:, None], iou, 0.0)
+    anchor_best = jnp.max(iou, axis=0)  # [A]
+    anchor_gt = jnp.argmax(iou, axis=0)  # [A]
+
+    fg = anchor_best >= rpn_positive_overlap
+    # best anchor per valid gt is always fg (reference's second fg rule)
+    best_per_gt = jnp.argmax(iou, axis=1)  # [G]
+    fg = fg.at[best_per_gt].set(jnp.where(gt_valid, True, fg[best_per_gt]))
+    bg = (anchor_best < rpn_negative_overlap) & ~fg
+
+    k_fg = int(rpn_batch_size_per_im * fg_fraction)
+    r1, r2 = jax.random.split(rng)
+    fg_sel = _sample_topk(fg, k_fg, r1)
+    n_fg = jnp.sum(fg_sel.astype(jnp.int32))
+    # fill the remainder with bg (ordered random priorities, trimmed by rank)
+    pri = jnp.where(bg, jax.random.uniform(r2, (a,)), -1.0)
+    order = jnp.argsort(-pri)
+    rank = jnp.zeros((a,), jnp.int32).at[order].set(jnp.arange(a, dtype=jnp.int32))
+    bg_sel = bg & (rank < (rpn_batch_size_per_im - n_fg))
+
+    labels = jnp.where(fg_sel, 1, jnp.where(bg_sel, 0, -1)).astype(jnp.int32)
+    matched_gt = gt_boxes[anchor_gt]  # [A, 4]
+    var = jnp.ones((a, 4), jnp.float32)
+    # encode per-anchor against its matched gt (diagonal of the NxM encode)
+    cx, cy, w, h = _cwh(anchors)
+    gcx, gcy, gw, gh = _cwh(matched_gt)
+    bbox_targets = jnp.stack(
+        [
+            (gcx - cx) / jnp.maximum(w, 1e-6),
+            (gcy - cy) / jnp.maximum(h, 1e-6),
+            jnp.log(jnp.maximum(gw, 1e-6) / jnp.maximum(w, 1e-6)),
+            jnp.log(jnp.maximum(gh, 1e-6) / jnp.maximum(h, 1e-6)),
+        ],
+        axis=-1,
+    )
+    loc_w = fg_sel.astype(jnp.float32)
+    score_w = (fg_sel | bg_sel).astype(jnp.float32)
+    return labels, bbox_targets, loc_w, score_w
+
+
+def _cwh(box):
+    w = box[..., 2] - box[..., 0]
+    h = box[..., 3] - box[..., 1]
+    return box[..., 0] + w / 2, box[..., 1] + h / 2, w, h
+
+
+def generate_proposals(
+    scores: jax.Array,
+    bbox_deltas: jax.Array,
+    anchors: jax.Array,
+    variances: jax.Array,
+    image_shape: Tuple[float, float],
+    pre_nms_top_n: int = 6000,
+    post_nms_top_n: int = 1000,
+    nms_thresh: float = 0.5,
+    min_size: float = 0.0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Decode RPN outputs into proposals (reference
+    ``generate_proposals_op.cc`` ProposalForOneImage): decode deltas against
+    anchors, clip to image, drop boxes smaller than min_size, keep
+    ``pre_nms_top_n`` by score, NMS, keep ``post_nms_top_n``.
+
+    scores [A], bbox_deltas [A, 4], anchors/variances [A, 4]. Returns
+    (proposals [post_nms_top_n, 4], proposal_scores [post_nms_top_n], count);
+    padding rows are 0 with score -inf.
+    """
+    boxes = box_coder(anchors, variances, bbox_deltas, "decode_center_size")
+    boxes = box_clip(boxes, image_shape)
+    w = boxes[:, 2] - boxes[:, 0]
+    h = boxes[:, 3] - boxes[:, 1]
+    alive = (w >= min_size) & (h >= min_size)
+    s = jnp.where(alive, scores, NEG_INF)
+
+    k = min(pre_nms_top_n, s.shape[0])
+    top_s, top_i = jax.lax.top_k(s, k)
+    top_boxes = boxes[top_i]
+    sel, count = nms(top_boxes, top_s, min(post_nms_top_n, k), nms_thresh,
+                     score_threshold=NEG_INF / 2)
+    valid = sel >= 0
+    safe = jnp.maximum(sel, 0)
+    props = jnp.where(valid[:, None], top_boxes[safe], 0.0)
+    pscores = jnp.where(valid, top_s[safe], NEG_INF)
+    if props.shape[0] < post_nms_top_n:
+        pad = post_nms_top_n - props.shape[0]
+        props = jnp.pad(props, ((0, pad), (0, 0)))
+        pscores = jnp.pad(pscores, (0, pad), constant_values=NEG_INF)
+    return props, pscores, count
+
+
+def generate_proposal_labels(
+    rois: jax.Array,
+    gt_boxes: jax.Array,
+    gt_labels: jax.Array,
+    gt_valid: jax.Array,
+    rng: jax.Array,
+    batch_size_per_im: int = 256,
+    fg_fraction: float = 0.25,
+    fg_thresh: float = 0.5,
+    bg_thresh_hi: float = 0.5,
+    bg_thresh_lo: float = 0.0,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Sample RoIs + assign Fast-R-CNN head targets (reference
+    ``generate_proposal_labels_op.cc``): fg RoIs have max-IoU >= fg_thresh
+    (sampled to fg_fraction of the batch), bg RoIs fall in
+    [bg_thresh_lo, bg_thresh_hi). Fixed-shape outputs over all R rois:
+
+    returns (labels [R] int32 {class, 0 bg, -1 unsampled},
+             bbox_targets [R, 4] encoded vs roi,
+             loc_weight [R], sample_weight [R])."""
+    r = rois.shape[0]
+    iou = iou_similarity(gt_boxes, rois)  # [G, R]
+    iou = jnp.where(gt_valid[:, None], iou, 0.0)
+    best = jnp.max(iou, axis=0)
+    best_gt = jnp.argmax(iou, axis=0)
+
+    fg = best >= fg_thresh
+    bg = (best < bg_thresh_hi) & (best >= bg_thresh_lo) & ~fg
+    k_fg = int(batch_size_per_im * fg_fraction)
+    r1, r2 = jax.random.split(rng)
+    fg_sel = _sample_topk(fg, k_fg, r1)
+    n_fg = jnp.sum(fg_sel.astype(jnp.int32))
+    pri = jnp.where(bg, jax.random.uniform(r2, (r,)), -1.0)
+    order = jnp.argsort(-pri)
+    rank = jnp.zeros((r,), jnp.int32).at[order].set(jnp.arange(r, dtype=jnp.int32))
+    bg_sel = bg & (rank < (batch_size_per_im - n_fg))
+
+    cls = gt_labels[best_gt].astype(jnp.int32)
+    labels = jnp.where(fg_sel, cls, jnp.where(bg_sel, 0, -1))
+    matched = gt_boxes[best_gt]
+    cx, cy, w, h = _cwh(rois)
+    gcx, gcy, gw, gh = _cwh(matched)
+    bbox_targets = jnp.stack(
+        [
+            (gcx - cx) / jnp.maximum(w, 1e-6),
+            (gcy - cy) / jnp.maximum(h, 1e-6),
+            jnp.log(jnp.maximum(gw, 1e-6) / jnp.maximum(w, 1e-6)),
+            jnp.log(jnp.maximum(gh, 1e-6) / jnp.maximum(h, 1e-6)),
+        ],
+        axis=-1,
+    )
+    return labels, bbox_targets, fg_sel.astype(jnp.float32), (fg_sel | bg_sel).astype(jnp.float32)
+
+
+def roi_perspective_transform(
+    x: jax.Array,
+    rois: jax.Array,
+    transformed_height: int,
+    transformed_width: int,
+    spatial_scale: float = 1.0,
+) -> jax.Array:
+    """Warp quadrilateral ROIs to fixed rectangles (reference
+    ``roi_perspective_transform_op.cc``, EAST OCR): each ROI is 8 coords
+    (x1..y4, clockwise from top-left). Solves the 4-point homography per ROI
+    (batched 8x8 ``linalg.solve``) and bilinearly samples the NHWC feature
+    map — no per-pixel CPU loops. rois: [R, 8] + ``roi_batch_idx`` implied 0
+    for the common single-image serving path (pass x gathered per ROI
+    otherwise). Returns [R, th, tw, C]."""
+    n, h, w, c = x.shape
+    quad = rois.reshape(-1, 4, 2) * spatial_scale  # [R, 4, (x,y)]
+    th, tw = transformed_height, transformed_width
+    # destination rect corners (clockwise from top-left), in output coords
+    dst = jnp.asarray(
+        [[0.0, 0.0], [tw - 1.0, 0.0], [tw - 1.0, th - 1.0], [0.0, th - 1.0]],
+        jnp.float32,
+    )
+
+    def homography(src_pts):
+        # solve for H (8 dof) with dst -> src mapping so sampling is a gather
+        rows = []
+        for i in range(4):
+            dx, dy = dst[i, 0], dst[i, 1]
+            sx, sy = src_pts[i, 0], src_pts[i, 1]
+            rows.append(jnp.stack([dx, dy, 1.0, 0.0, 0.0, 0.0, -dx * sx, -dy * sx]))
+            rows.append(jnp.stack([0.0, 0.0, 0.0, dx, dy, 1.0, -dx * sy, -dy * sy]))
+        A = jnp.stack(rows)  # [8, 8]
+        b = src_pts.reshape(-1)  # [sx1, sy1, sx2, sy2, ...] matches row order
+        hvec = jnp.linalg.solve(A, b)
+        return jnp.concatenate([hvec, jnp.ones((1,))]).reshape(3, 3)
+
+    Hs = jax.vmap(homography)(quad.astype(jnp.float32))  # [R, 3, 3]
+    gy, gx = jnp.meshgrid(jnp.arange(th, dtype=jnp.float32),
+                          jnp.arange(tw, dtype=jnp.float32), indexing="ij")
+    ones = jnp.ones_like(gx)
+    grid = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)  # [th*tw, 3]
+
+    def warp_one(H):
+        src = grid @ H.T  # [P, 3]
+        sx = src[:, 0] / jnp.maximum(jnp.abs(src[:, 2]), 1e-8) * jnp.sign(src[:, 2])
+        sy = src[:, 1] / jnp.maximum(jnp.abs(src[:, 2]), 1e-8) * jnp.sign(src[:, 2])
+        x0 = jnp.floor(sx)
+        y0 = jnp.floor(sy)
+        fx = sx - x0
+        fy = sy - y0
+        x0i = jnp.clip(x0.astype(jnp.int32), 0, w - 1)
+        x1i = jnp.clip(x0i + 1, 0, w - 1)
+        y0i = jnp.clip(y0.astype(jnp.int32), 0, h - 1)
+        y1i = jnp.clip(y0i + 1, 0, h - 1)
+        img = x[0]  # [H, W, C]
+        v00 = img[y0i, x0i]
+        v01 = img[y0i, x1i]
+        v10 = img[y1i, x0i]
+        v11 = img[y1i, x1i]
+        top = v00 * (1 - fx)[:, None] + v01 * fx[:, None]
+        bot = v10 * (1 - fx)[:, None] + v11 * fx[:, None]
+        out = top * (1 - fy)[:, None] + bot * fy[:, None]
+        # out-of-bounds samples are 0 (reference in_quad/out-of-range rule)
+        oob = (sx < 0) | (sx > w - 1) | (sy < 0) | (sy > h - 1)
+        return jnp.where(oob[:, None], 0.0, out).reshape(th, tw, c)
+
+    return jax.vmap(warp_one)(Hs).astype(x.dtype)
+
+
+def polygon_box_transform(x: jax.Array) -> jax.Array:
+    """EAST geometry-map transform (reference
+    ``polygon_box_transform_op.cc``): input [B, G, H, W]; even geometry
+    channels hold x-offsets (out = col_index - in), odd channels y-offsets
+    (out = row_index - in)."""
+    b, g, h, w = x.shape
+    cols = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    rows = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    even = (jnp.arange(g) % 2 == 0)[None, :, None, None]
+    return jnp.where(even, cols - x, rows - x)

@@ -1,0 +1,175 @@
+"""Detection-tail tests: RPN target assign, proposal generation/labeling,
+perspective ROI warp, EAST transforms, SSD composites (VERDICT item 4 of
+"What's missing": reference ``operators/detection/``)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu import layers
+from paddle_tpu.ops import detection as odet
+from paddle_tpu.ops import detection_rpn as orpn
+
+
+def _boxes(*rows):
+    return jnp.asarray(np.array(rows, np.float32))
+
+
+def test_rpn_target_assign_basic():
+    anchors = _boxes([0, 0, 10, 10], [20, 20, 30, 30], [100, 100, 110, 110], [0, 0, 9, 9])
+    gt = _boxes([0, 0, 10, 10], [21, 21, 30, 30])
+    valid = jnp.asarray([True, True])
+    labels, tgt, loc_w, score_w = orpn.rpn_target_assign(
+        anchors, gt, valid, jax.random.PRNGKey(0), rpn_batch_size_per_im=4
+    )
+    labels = np.asarray(labels)
+    assert labels[0] == 1  # exact IoU 1 with gt0
+    assert labels[1] == 1  # best anchor for gt1
+    assert labels[2] == 0  # no overlap -> bg
+    # fg rows carry loc weight, encoded target for anchor0 is ~zero offset
+    np.testing.assert_allclose(np.asarray(tgt)[0], 0.0, atol=1e-5)
+    assert float(loc_w[0]) == 1.0 and float(loc_w[2]) == 0.0
+    assert float(score_w[2]) == 1.0
+
+
+def test_generate_proposals_orders_and_clips():
+    anchors = _boxes([0, 0, 10, 10], [5, 5, 15, 15], [0, 0, 4, 4])
+    var = jnp.ones((3, 4), jnp.float32)
+    deltas = jnp.zeros((3, 4), jnp.float32)  # decode = anchors themselves
+    scores = jnp.asarray([0.9, 0.5, 0.1], jnp.float32)
+    props, pscores, count = orpn.generate_proposals(
+        scores, deltas, anchors, var, image_shape=(12.0, 12.0),
+        pre_nms_top_n=3, post_nms_top_n=3, nms_thresh=0.9, min_size=1.0,
+    )
+    assert int(count) == 3
+    np.testing.assert_allclose(np.asarray(props[0]), [0, 0, 10, 10], atol=1e-5)
+    # second-best clipped to image bounds (15 -> 12)
+    np.testing.assert_allclose(np.asarray(props[1]), [5, 5, 12, 12], atol=1e-5)
+    assert float(pscores[0]) == pytest.approx(0.9)
+
+
+def test_generate_proposals_nms_suppresses():
+    anchors = _boxes([0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60])
+    var = jnp.ones((3, 4), jnp.float32)
+    deltas = jnp.zeros((3, 4), jnp.float32)
+    scores = jnp.asarray([0.9, 0.8, 0.7], jnp.float32)
+    props, pscores, count = orpn.generate_proposals(
+        scores, deltas, anchors, var, (100.0, 100.0),
+        pre_nms_top_n=3, post_nms_top_n=3, nms_thresh=0.5,
+    )
+    assert int(count) == 2  # overlapping pair collapses to one
+
+
+def test_generate_proposal_labels():
+    rois = _boxes([0, 0, 10, 10], [0, 0, 9, 10], [40, 40, 50, 50], [100, 100, 110, 110])
+    gt = _boxes([0, 0, 10, 10])
+    gt_labels = jnp.asarray([3], jnp.int32)
+    valid = jnp.asarray([True])
+    labels, tgt, loc_w, w = orpn.generate_proposal_labels(
+        rois, gt, gt_labels, valid, jax.random.PRNGKey(1),
+        batch_size_per_im=4, fg_fraction=0.5,
+    )
+    labels = np.asarray(labels)
+    assert labels[0] == 3 and labels[1] == 3  # high-IoU fg get gt class
+    assert labels[2] == 0 and labels[3] == 0  # background
+    assert float(loc_w[0]) == 1.0 and float(loc_w[2]) == 0.0
+
+
+def test_roi_perspective_transform_identity():
+    rng = np.random.RandomState(0)
+    img = rng.randn(1, 6, 8, 2).astype(np.float32)
+    # axis-aligned quad covering the full feature map = identity resample
+    roi = jnp.asarray([[0, 0, 7, 0, 7, 5, 0, 5]], jnp.float32)
+    out = orpn.roi_perspective_transform(jnp.asarray(img), roi, 6, 8)
+    np.testing.assert_allclose(np.asarray(out[0]), img[0], atol=1e-4)
+
+
+def test_roi_perspective_transform_crop():
+    img = np.zeros((1, 8, 8, 1), np.float32)
+    img[0, 2:6, 2:6, 0] = 5.0
+    roi = jnp.asarray([[2, 2, 5, 2, 5, 5, 2, 5]], jnp.float32)
+    out = orpn.roi_perspective_transform(jnp.asarray(img), roi, 4, 4)
+    np.testing.assert_allclose(np.asarray(out[0, :, :, 0]), 5.0, atol=1e-4)
+
+
+def test_polygon_box_transform():
+    x = np.zeros((1, 2, 2, 3), np.float32)  # [B, G=2, H=2, W=3]
+    out = np.asarray(orpn.polygon_box_transform(jnp.asarray(x)))
+    # even channel: col index; odd channel: row index
+    np.testing.assert_allclose(out[0, 0], [[0, 1, 2], [0, 1, 2]])
+    np.testing.assert_allclose(out[0, 1], [[0, 0, 0], [1, 1, 1]])
+
+
+def test_detection_output_roundtrip():
+    priors = _boxes([0.1, 0.1, 0.3, 0.3], [0.6, 0.6, 0.9, 0.9])
+    var = jnp.full((2, 4), 0.1, jnp.float32)
+    loc = jnp.zeros((2, 4), jnp.float32)  # decode -> priors
+    scores = jnp.asarray([[0.1, 0.9], [0.2, 0.8]], jnp.float32)  # [P, C]
+    dets, count = odet.detection_output(
+        loc, scores, priors, var, background_label=0, keep_top_k=4
+    )
+    assert int(count) == 2
+    d = np.asarray(dets)
+    assert d[0, 0] == 1.0 and d[0, 1] == pytest.approx(0.9)
+    np.testing.assert_allclose(d[0, 2:], [0.1, 0.1, 0.3, 0.3], atol=1e-5)
+
+
+def test_ssd_loss_perfect_prediction_is_small():
+    priors = _boxes([0.1, 0.1, 0.3, 0.3], [0.5, 0.5, 0.8, 0.8], [0.0, 0.7, 0.2, 0.9])
+    var = jnp.full((3, 4), 1.0, jnp.float32)
+    gt = _boxes([0.1, 0.1, 0.3, 0.3])
+    gt_lab = jnp.asarray([1], jnp.int32)
+    valid = jnp.asarray([True])
+    loc_perfect = jnp.zeros((3, 4), jnp.float32)
+    conf_good = jnp.asarray(
+        [[-5.0, 5.0], [5.0, -5.0], [5.0, -5.0]], jnp.float32
+    )
+    good = float(odet.ssd_loss(loc_perfect, conf_good, gt, gt_lab, valid, priors, var))
+    conf_bad = -conf_good
+    bad = float(odet.ssd_loss(loc_perfect, conf_bad, gt, gt_lab, valid, priors, var))
+    assert good < 0.1 and bad > 2.0, (good, bad)
+
+
+def test_detection_map_perfect_and_miss():
+    gt = _boxes([0.1, 0.1, 0.3, 0.3], [0.5, 0.5, 0.8, 0.8])
+    gt_lab = jnp.asarray([1, 2], jnp.int32)
+    valid = jnp.asarray([True, True])
+    dets = jnp.asarray(
+        [
+            [1, 0.9, 0.1, 0.1, 0.3, 0.3],
+            [2, 0.8, 0.5, 0.5, 0.8, 0.8],
+            [-1, 0, 0, 0, 0, 0],
+        ],
+        jnp.float32,
+    )
+    m = float(odet.detection_map(dets, jnp.asarray(2), gt, gt_lab, valid, num_classes=3))
+    assert m == pytest.approx(1.0, abs=1e-5)
+    # wrong locations -> mAP 0
+    dets_bad = dets.at[:, 2:].add(0.5)
+    m2 = float(odet.detection_map(dets_bad, jnp.asarray(2), gt, gt_lab, valid, num_classes=3))
+    assert m2 == pytest.approx(0.0, abs=1e-5)
+
+
+def test_multi_box_head_shapes(rng):
+    import paddle_tpu as pt
+
+    f1 = rng.randn(2, 4, 4, 8).astype(np.float32)
+    f2 = rng.randn(2, 2, 2, 8).astype(np.float32)
+
+    def net(f1, f2):
+        locs, confs, boxes, variances = layers.multi_box_head(
+            [f1, f2], image_shape=(32, 32), num_classes=3,
+            min_sizes=[8.0, 16.0], max_sizes=[16.0, 28.0],
+        )
+        return locs.sum() + confs.sum(), locs, confs, boxes, variances
+
+    model = pt.build(net)
+    v = model.init(0, f1, f2)
+    (loss, locs, confs, boxes, variances), _ = model.apply(v, f1, f2)
+    p = boxes.shape[0]
+    assert locs.shape == (2, p, 4)
+    assert confs.shape == (2, p, 3)
+    assert variances.shape == (p, 4)
+    # per-cell prior count: 1 min * (1 + 2 flip) aspect + 1 max = 4
+    assert p == 4 * 4 * 4 + 2 * 2 * 4
