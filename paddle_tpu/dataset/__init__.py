@@ -28,6 +28,9 @@ from paddle_tpu.dataset import imikolov  # noqa: F401
 from paddle_tpu.dataset import movielens  # noqa: F401
 from paddle_tpu.dataset import wmt16  # noqa: F401
 from paddle_tpu.dataset import conll05  # noqa: F401
+from paddle_tpu.dataset import sentiment  # noqa: F401
+from paddle_tpu.dataset import voc2012  # noqa: F401
+from paddle_tpu.dataset import mq2007  # noqa: F401
 
 __all__ = [
     "common",
@@ -40,4 +43,7 @@ __all__ = [
     "movielens",
     "wmt16",
     "conll05",
+    "sentiment",
+    "voc2012",
+    "mq2007",
 ]

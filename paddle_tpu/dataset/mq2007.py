@@ -1,0 +1,71 @@
+"""MQ2007 learning-to-rank (reference ``dataset/mq2007.py``, LETOR 4.0):
+query groups of documents with 46 ranking features and relevance grades
+0/1/2. Three reader modes matching the reference: ``pointwise`` yields
+(feature [46], label), ``pairwise`` yields (pos_feature, neg_feature),
+``listwise`` yields (label list, feature list) per query. Cache:
+``mq2007/{train,test}.npz`` with ``features`` [N, 46], ``labels`` [N],
+``query_offsets`` [Q+1]; else synthetic with label-correlated features."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+__all__ = ["train", "test", "FEATURE_DIM"]
+
+FEATURE_DIM = 46
+
+
+def _synthetic(split: str, n_queries: int):
+    rng = np.random.RandomState(common.synthetic_seed("mq2007", split))
+    feats, labels, offsets = [], [], [0]
+    w = rng.randn(FEATURE_DIM)  # hidden scoring function
+    for _ in range(n_queries):
+        docs = int(rng.randint(8, 24))
+        f = rng.randn(docs, FEATURE_DIM).astype(np.float32)
+        score = f @ w
+        lbl = np.digitize(score, np.quantile(score, [0.6, 0.9])).astype(np.int64)
+        feats.append(f)
+        labels.append(lbl)
+        offsets.append(offsets[-1] + docs)
+    return {
+        "features": np.concatenate(feats),
+        "labels": np.concatenate(labels),
+        "query_offsets": np.asarray(offsets, np.int64),
+    }
+
+
+def _load(split: str, n_queries: int):
+    return common.cached_npz("mq2007", split) or _synthetic(split, n_queries)
+
+
+def _reader_creator(split: str, n_queries: int, format: str):
+    def reader():
+        data = _load(split, n_queries)
+        f, l, offs = data["features"], data["labels"], data["query_offsets"]
+        for q in range(len(offs) - 1):
+            qf = f[offs[q] : offs[q + 1]]
+            ql = l[offs[q] : offs[q + 1]]
+            if format == "pointwise":
+                for row, lbl in zip(qf, ql):
+                    yield row, int(lbl)
+            elif format == "pairwise":
+                for i in range(len(ql)):
+                    for j in range(len(ql)):
+                        if ql[i] > ql[j]:
+                            yield qf[i], qf[j]
+            elif format == "listwise":
+                yield ql.tolist(), qf
+            else:
+                raise ValueError(f"unknown format {format!r}")
+
+    return reader
+
+
+def train(format: str = "pairwise"):
+    return _reader_creator("train", 48, format)
+
+
+def test(format: str = "pairwise"):
+    return _reader_creator("test", 12, format)

@@ -1,0 +1,54 @@
+"""Movie-review sentiment corpus (reference ``dataset/sentiment.py``: the
+NLTK movie_reviews corpus, pos/neg categories). Examples are
+(word-id list, label 0=neg 1=pos). Cache: ``sentiment/{train,test}.npz``
+ragged encoding (tokens/offsets/labels), else deterministic synthetic."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+__all__ = ["train", "test", "get_word_dict", "NUM_TRAINING_INSTANCES"]
+
+VOCAB_SIZE = 2048  # movie_reviews-scale dictionary
+NUM_TRAINING_INSTANCES = 1600  # reference: 80% of 2000 documents
+
+
+def get_word_dict():
+    """token -> id (reference sorts by frequency; synthetic uses rank ids)."""
+    return {f"w{i}": i for i in range(VOCAB_SIZE)}
+
+
+def _synthetic(split: str, n: int):
+    rng = np.random.RandomState(common.synthetic_seed("sentiment", split))
+    labels = rng.randint(0, 2, n).astype(np.int64)
+    seqs, offsets = [], [0]
+    for lbl in labels:
+        length = int(rng.randint(30, 200))
+        lo, hi = (0, VOCAB_SIZE // 2) if lbl == 0 else (VOCAB_SIZE // 2, VOCAB_SIZE)
+        seqs.append(rng.randint(lo, hi, length))
+        offsets.append(offsets[-1] + length)
+    return {
+        "tokens": np.concatenate(seqs).astype(np.int64),
+        "offsets": np.asarray(offsets, np.int64),
+        "labels": labels,
+    }
+
+
+def _reader_creator(split: str, n: int):
+    def reader():
+        data = common.cached_npz("sentiment", split) or _synthetic(split, n)
+        toks, offs, labels = data["tokens"], data["offsets"], data["labels"]
+        for i, lbl in enumerate(labels):
+            yield toks[offs[i] : offs[i + 1]].tolist(), int(lbl)
+
+    return reader
+
+
+def train():
+    return _reader_creator("train", 200)
+
+
+def test():
+    return _reader_creator("test", 50)

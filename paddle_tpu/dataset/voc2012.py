@@ -1,0 +1,53 @@
+"""PASCAL VOC2012 segmentation (reference ``dataset/voc2012.py``): examples
+are (image HWC uint8, segmentation label HW uint8 with 0=background,
+1..20=classes, 255=void). Cache: ``voc2012/{train,test,val}.npz`` with
+``images`` [N, H, W, 3] and ``labels`` [N, H, W]; else synthetic scenes of
+colored rectangles whose label map matches the drawn class."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+__all__ = ["train", "test", "val"]
+
+NUM_CLASSES = 21
+_H = _W = 64  # synthetic resolution (real VOC images are variable-size)
+
+
+def _synthetic(split: str, n: int):
+    rng = np.random.RandomState(common.synthetic_seed("voc2012", split))
+    images = np.zeros((n, _H, _W, 3), np.uint8)
+    labels = np.zeros((n, _H, _W), np.uint8)
+    for i in range(n):
+        images[i] = rng.randint(0, 40, (_H, _W, 3))  # dark background
+        for _ in range(rng.randint(1, 4)):
+            cls = int(rng.randint(1, NUM_CLASSES))
+            y0, x0 = rng.randint(0, _H - 16), rng.randint(0, _W - 16)
+            h, w = rng.randint(8, 16), rng.randint(8, 16)
+            color = 55 + (cls * 9) % 200
+            images[i, y0 : y0 + h, x0 : x0 + w] = color
+            labels[i, y0 : y0 + h, x0 : x0 + w] = cls
+    return {"images": images, "labels": labels}
+
+
+def _reader_creator(split: str, n: int):
+    def reader():
+        data = common.cached_npz("voc2012", split) or _synthetic(split, n)
+        for img, lbl in zip(data["images"], data["labels"]):
+            yield img, lbl
+
+    return reader
+
+
+def train():
+    return _reader_creator("train", 64)
+
+
+def val():
+    return _reader_creator("val", 16)
+
+
+def test():
+    return _reader_creator("test", 16)

@@ -43,6 +43,7 @@ __all__ = [
     "buffered",
     "firstn",
     "xmap_readers",
+    "multiprocess_reader",
     "batch",
     "stack_batch",
     "cache",
