@@ -32,6 +32,8 @@ class Flags:
     use_bf16_compute: bool = False
     # route unmasked/causal attention through the Pallas flash kernel
     use_flash_attention: bool = False
+    # fused Pallas backward for flash attention (False = recomputed XLA vjp)
+    flash_fused_bwd: bool = True
     # default seed for program-level RNG when none is given
     seed: int = 0
     # host data pipeline: prefetch depth of the device double-buffer

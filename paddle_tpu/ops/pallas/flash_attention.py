@@ -10,9 +10,13 @@ The reference framework composed attention from softmax/matmul ops
 (``python/paddle/fluid/nets.py:332`` scaled_dot_product_attention) and had
 no fused kernel; this replaces that composition on the hot path.
 
-Backward runs as recomputed XLA attention via ``jax.custom_vjp`` — the
-standard memory/FLOPs trade at this scale; a fused backward kernel is a
-later optimization.
+Backward is a fused Pallas kernel pair (FlashAttention-2 schedule): the
+forward additionally emits the per-row logsumexp, and the backward
+recomputes P blockwise from (Q, K, LSE) — one kernel accumulates dK/dV
+streaming over Q blocks, one accumulates dQ streaming over K/V blocks —
+so the [T, T] probability matrix never hits HBM in either direction.
+Set ``flags().flash_fused_bwd = False`` to fall back to the recomputed
+XLA backward.
 """
 
 from __future__ import annotations
@@ -31,7 +35,7 @@ __all__ = ["flash_attention"]
 
 
 def _flash_fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     *, block_q: int, block_k: int, causal: bool, sm_scale: float,
 ):
     """One (batch*head, q_block, kv_block) grid cell. Only the CURRENT
@@ -78,11 +82,13 @@ def _flash_fwd_kernel(
 
     @pl.when(j == n_kv - 1)
     def _():
-        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-20)).astype(o_ref.dtype)
+        l_safe = jnp.maximum(l_ref[:], 1e-20)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:] + jnp.log(l_safe)
 
 
 def _flash_fwd_kernel_resident(
-    q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sm_scale: float
+    q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, sm_scale: float
 ):
     """Fast path for K/V that fit in VMEM: one (batch*head, q_block) grid
     cell holds the whole K/V and loops kv blocks with a fori_loop — the
@@ -124,8 +130,10 @@ def _flash_fwd_kernel_resident(
         jnp.zeros((block_q, 1), jnp.float32),
         jnp.zeros((block_q, d), jnp.float32),
     )
-    _, l, acc = jax.lax.fori_loop(0, n_kv_used, body, init)
-    o_ref[0] = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+    m, l, acc = jax.lax.fori_loop(0, n_kv_used, body, init)
+    l_safe = jnp.maximum(l, 1e-20)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
 
 
 # K+V per (batch, head) beyond this stays in HBM and streams via the grid
@@ -133,6 +141,8 @@ _VMEM_RESIDENT_BYTES = 4 * 1024 * 1024
 
 
 def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool):
+    """Returns ``(out [B,H,T,d], lse [B,H,T,1])`` — lse is the per-row
+    logsumexp of the scaled scores, consumed by the fused backward."""
     B, H, T, d = q.shape
     t_kv = k.shape[2]
     block_q = min(block_q, T)
@@ -145,13 +155,17 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
     vr = v.reshape(B * H, t_kv, d)
     from jax.experimental.pallas import tpu as pltpu
 
+    out_shapes = [
+        jax.ShapeDtypeStruct((B * H, T, d), q.dtype),
+        jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32),
+    ]
     kv_bytes = 2 * t_kv * d * (4 if q.dtype == jnp.float32 else 2)
     if kv_bytes <= _VMEM_RESIDENT_BYTES:
         kernel = functools.partial(
             _flash_fwd_kernel_resident,
             block_k=block_k, causal=causal, sm_scale=sm_scale,
         )
-        out = pl.pallas_call(
+        out, lse = pl.pallas_call(
             kernel,
             grid=(B * H, T // block_q),
             in_specs=[
@@ -159,20 +173,23 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
                 pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
                 pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
             ],
-            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            out_shape=jax.ShapeDtypeStruct((B * H, T, d), q.dtype),
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            ],
+            out_shape=out_shapes,
             compiler_params=None if interpret else pltpu.CompilerParams(
                 dimension_semantics=("parallel", "arbitrary"),
             ),
             interpret=interpret,
         )(qr, kr, vr)
-        return out.reshape(B, H, T, d)
+        return out.reshape(B, H, T, d), lse.reshape(B, H, T, 1)
 
     kernel = functools.partial(
         _flash_fwd_kernel,
         block_q=block_q, block_k=block_k, causal=causal, sm_scale=sm_scale,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, T // block_q, t_kv // block_k),
         in_specs=[
@@ -180,8 +197,11 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=out_shapes,
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -192,7 +212,181 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
         ),
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(B, H, T, d)
+    return out.reshape(B, H, T, d), lse.reshape(B, H, T, 1)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, block_q: int, block_k: int, causal: bool, sm_scale: float,
+):
+    """dK/dV for one kv block, streaming q blocks through the innermost grid
+    dim. P is recomputed from (Q, K, LSE) — FlashAttention-2 eq. (13-16):
+    dV += P^T dO; dS = P ∘ (dO V^T − Δ); dK += dS^T Q·scale."""
+    i = pl.program_id(2)
+    n_q = pl.num_programs(2)
+    j = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # causal: q blocks fully above this kv block's diagonal see none of it
+    live = (i * block_q + block_q - 1 >= j * block_k) if causal else True
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]      # [block_q, 1]
+        delta = delta_ref[0]  # [block_q, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)  # normalized probabilities, [block_q, block_k]
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # P^T dO -> [block_k, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # dO V^T -> [block_q, block_k]
+        ds = p * (dp - delta)
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # dS^T (Q·scale) -> [block_k, d]
+
+    @pl.when(i == n_q - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, block_q: int, block_k: int, causal: bool, sm_scale: float,
+):
+    """dQ for one q block, streaming kv blocks: dQ += dS K·scale."""
+    j = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+    i = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # dS K -> [block_q, d]
+
+    @pl.when(j == n_kv - 1)
+    def _():
+        dq_ref[0] = (dq_acc[:] * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret):
+    """Fused backward: returns (dq, dk, dv), each the dtype of its primal."""
+    B, H, T, d = q.shape
+    t_kv = k.shape[2]
+    block_q = min(block_q, T)
+    block_k = min(block_k, t_kv)
+
+    qr = q.reshape(B * H, T, d)
+    kr = k.reshape(B * H, t_kv, d)
+    vr = v.reshape(B * H, t_kv, d)
+    gr = g.reshape(B * H, T, d)
+    lse_r = lse.reshape(B * H, T, 1)
+    # Δ = rowsum(dO ∘ O): cheap elementwise+reduce, XLA fuses it
+    delta = jnp.sum(
+        gr.astype(jnp.float32) * out.reshape(B * H, T, d).astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )
+    from jax.experimental.pallas import tpu as pltpu
+
+    q_spec3 = pl.BlockSpec((1, block_q, d), lambda b, x, y: (b, x, 0))
+    row_spec3 = pl.BlockSpec((1, block_q, 1), lambda b, x, y: (b, x, 0))
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel,
+        block_q=block_q, block_k=block_k, causal=causal, sm_scale=sm_scale,
+    )
+    # grid: q innermost (sequential accumulate), kv parallel
+    q_stream = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    row_stream = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
+    kv_fixed = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B * H, t_kv // block_k, T // block_q),
+        in_specs=[q_stream, kv_fixed, kv_fixed, q_stream, row_stream, row_stream],
+        out_specs=[kv_fixed, kv_fixed],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, t_kv, d), k.dtype),
+            jax.ShapeDtypeStruct((B * H, t_kv, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qr, kr, vr, gr, lse_r, delta)
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel,
+        block_q=block_q, block_k=block_k, causal=causal, sm_scale=sm_scale,
+    )
+    # grid: kv innermost (sequential accumulate), q parallel
+    q_fixed = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    row_fixed = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    kv_stream = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    (dq,) = pl.pallas_call(
+        dq_kernel,
+        grid=(B * H, T // block_q, t_kv // block_k),
+        in_specs=[q_fixed, kv_stream, kv_stream, q_fixed, row_fixed, row_fixed],
+        out_specs=[q_fixed],
+        out_shape=[jax.ShapeDtypeStruct((B * H, T, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qr, kr, vr, gr, lse_r, delta)
+
+    return (
+        dq.reshape(B, H, T, d),
+        dk.reshape(B, H, t_kv, d),
+        dv.reshape(B, H, t_kv, d),
+    )
 
 
 def _reference_attention(q, k, v, causal: bool, sm_scale: float):
@@ -213,16 +407,21 @@ def _reference_attention(q, k, v, causal: bool, sm_scale: float):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    out, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
+    q, k, v, out, lse = res
+    from paddle_tpu.core.config import flags
+
+    if flags().flash_fused_bwd:
+        return _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret)
     # recomputed XLA attention backward (activations were never stored)
     _, vjp = jax.vjp(lambda a, b, c: _reference_attention(a, b, c, causal, sm_scale), q, k, v)
     return vjp(g)
