@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -172,18 +173,39 @@ def run_benchmark(args) -> dict:
             variables, opt_state = out.variables, out.opt_state
         jax.block_until_ready(out.loss)
 
+        profiled = args.profile and pass_id == 0
         ctx = (
             jax.profiler.trace(args.profile_dir)
-            if args.profile and pass_id == 0
+            if profiled
             else prof.record_event(f"benchmark_pass_{pass_id}")
         )
         t0 = time.perf_counter()
         with ctx:
-            for _ in range(args.iterations):
-                out = step(variables, opt_state)
-                variables, opt_state = out.variables, out.opt_state
-            jax.block_until_ready(out.loss)
+            if profiled:
+                # instrumented loop: per-step host dispatch vs device wait,
+                # synced each step so the phases are attributable (reference
+                # device_tracer correlated kernel/memcpy timeline)
+                prof.enable_profiler()
+                for _ in range(args.iterations):
+                    with prof.record_event("step_dispatch"):
+                        out = step(variables, opt_state)
+                        variables, opt_state = out.variables, out.opt_state
+                    with prof.record_event("device_wait"):
+                        jax.block_until_ready(out.loss)
+            else:
+                for _ in range(args.iterations):
+                    out = step(variables, opt_state)
+                    variables, opt_state = out.variables, out.opt_state
+                jax.block_until_ready(out.loss)
         dt = time.perf_counter() - t0
+        if profiled:
+            timeline = prof.export_chrome_trace(
+                os.path.join(args.profile_dir, "timeline.chrome.json")
+            )
+            breakdown = prof.step_breakdown()
+            print(f"timeline: {timeline}")
+            for phase, mean_s in sorted(breakdown.items(), key=lambda kv: -kv[1]):
+                print(f"  {phase:24s} {mean_s * 1e3:9.3f} ms/step")
         examples_per_sec = args.batch_size * args.iterations / dt
         record = {
             "pass": pass_id,

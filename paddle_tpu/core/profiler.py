@@ -14,6 +14,9 @@ table and emits a TraceAnnotation visible in device traces.
 from __future__ import annotations
 
 import contextlib
+import json
+import os
+import threading
 import time
 from collections import defaultdict
 from typing import Iterator, Optional
@@ -21,6 +24,8 @@ from typing import Iterator, Optional
 import jax
 
 _events: dict[str, list[float]] = defaultdict(list)
+# correlated spans for the timeline export: (name, start_us, dur_us, tid)
+_spans: list[tuple[str, float, float, int]] = []
 _enabled: bool = False
 
 
@@ -34,13 +39,16 @@ def record_event(name: str) -> Iterator[None]:
     t0 = time.perf_counter()
     with jax.profiler.TraceAnnotation(name):
         yield
-    _events[name].append(time.perf_counter() - t0)
+    t1 = time.perf_counter()
+    _events[name].append(t1 - t0)
+    _spans.append((name, t0 * 1e6, (t1 - t0) * 1e6, threading.get_ident()))
 
 
 def enable_profiler() -> None:
     global _enabled
     _enabled = True
     _events.clear()
+    _spans.clear()
 
 
 def disable_profiler() -> dict[str, dict[str, float]]:
@@ -70,6 +78,45 @@ def summary_string(table: Optional[dict] = None) -> str:
     return "\n".join(lines)
 
 
+def export_chrome_trace(path: str) -> str:
+    """Write recorded host spans as a Chrome Trace Event Format file,
+    loadable in chrome://tracing / Perfetto UI — the consumable-timeline
+    artifact the reference's DeviceTracer emitted as a protobuf
+    (``platform/device_tracer.h:49-103`` GenProfile → proto timeline).
+    Device-side kernel timelines come from the jax.profiler XPlane trace;
+    this file carries the correlated host-side step phases."""
+    tids = {}
+    events = []
+    for name, start_us, dur_us, tid in _spans:
+        tids.setdefault(tid, len(tids))
+        events.append({
+            "name": name, "ph": "X", "cat": "host",
+            "ts": start_us, "dur": dur_us,
+            "pid": os.getpid(), "tid": tids[tid],
+        })
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {"producer": "paddle_tpu.core.profiler"},
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.rename(tmp, path)
+    return path
+
+
+def step_breakdown(table: Optional[dict] = None) -> dict[str, float]:
+    """Mean seconds per phase for the canonical step phases
+    (feed/compute/fetch/...), for the benchmark's per-step breakdown
+    table (reference ``fluid_benchmark.py`` profile output)."""
+    table = table if table is not None else {
+        name: {"mean_s": sum(ts) / len(ts)} for name, ts in _events.items() if ts
+    }
+    return {name: s["mean_s"] for name, s in table.items()}
+
+
 @contextlib.contextmanager
 def profiler(log_dir: Optional[str] = None) -> Iterator[None]:
     """Device-trace context manager (fluid.profiler.profiler parity):
@@ -80,9 +127,13 @@ def profiler(log_dir: Optional[str] = None) -> Iterator[None]:
     enable_profiler()
     with jax.profiler.trace(log_dir):
         yield
+    timeline = export_chrome_trace(os.path.join(log_dir, "timeline.chrome.json"))
     from paddle_tpu.core import logging as ptlog
 
-    ptlog.info("profiler trace written to %s\n%s", log_dir, summary_string())
+    ptlog.info(
+        "profiler trace written to %s (host timeline: %s)\n%s",
+        log_dir, timeline, summary_string(),
+    )
 
 
 def start_profiler(log_dir: Optional[str] = None) -> None:
