@@ -19,9 +19,27 @@
 
 namespace ptnative {
 
+// Storage dtypes for program constants/inputs. The interpreter computes in
+// float32 throughout ("universal scalar"): bf16 values round-trip exactly
+// through f32, and integers are exact up to 2^24 — ample for vocab ids,
+// lengths, and class indices on a serving host. BF16 halves weights.bin;
+// I32/I64 make integer programs (embedding lookups, argmax pipelines)
+// representable. The dtype tag governs disk format and convert semantics,
+// not the in-memory compute type.
+enum class DType { F32 = 0, BF16 = 1, I32 = 2, I64 = 3 };
+
+inline size_t dtype_bytes(DType t) {
+  switch (t) {
+    case DType::BF16: return 2;
+    case DType::I64: return 8;
+    default: return 4;
+  }
+}
+
 struct NDArray {
   std::vector<int64_t> shape;
   std::vector<float> data;
+  DType dtype = DType::F32;  // storage/semantic tag; data is always f32
 
   NDArray() = default;
   explicit NDArray(std::vector<int64_t> s) : shape(std::move(s)) {

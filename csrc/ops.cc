@@ -12,6 +12,8 @@
 
 #include <algorithm>
 #include <cmath>
+#include <cstdint>
+#include <cstring>
 #include <limits>
 
 namespace ptnative {
@@ -302,6 +304,215 @@ NDArray pad_op(const NDArray& x, float value, const std::vector<int64_t>& lo,
     }
     if (ok) out.data[dst] = x.data[i];
   }
+  return out;
+}
+
+// XLA gather semantics (the primitive behind embedding lookups and
+// numpy-style indexing; xla_data.proto GatherDimensionNumbers). The index
+// vector dim is the last dim of ``indices`` (jax's lowering convention).
+// ``fill_oob`` selects FILL_OR_DROP (0.0 for out-of-bounds) vs CLIP.
+NDArray gather_op(const NDArray& operand, const NDArray& indices,
+                  const std::vector<int64_t>& offset_dims,
+                  const std::vector<int64_t>& collapsed_slice_dims,
+                  const std::vector<int64_t>& start_index_map,
+                  const std::vector<int64_t>& slice_sizes, bool fill_oob) {
+  const int op_rank = operand.ndim();
+  check(indices.ndim() >= 1, "gather: indices must have an index-vector dim");
+  // batch shape = indices shape minus the trailing index-vector dim
+  std::vector<int64_t> batch_shape(indices.shape.begin(), indices.shape.end() - 1);
+  const int64_t idx_vec = indices.shape.empty() ? 1 : indices.shape.back();
+
+  // slice dims that survive into the output (not collapsed), in operand order
+  std::vector<bool> collapsed(op_rank, false);
+  for (auto d : collapsed_slice_dims) collapsed[d] = true;
+  std::vector<int64_t> kept_slice_dims;
+  for (int d = 0; d < op_rank; ++d)
+    if (!collapsed[d]) kept_slice_dims.push_back(d);
+  check(kept_slice_dims.size() == offset_dims.size(),
+        "gather: offset_dims / collapsed_slice_dims mismatch");
+
+  const int out_rank = static_cast<int>(batch_shape.size() + offset_dims.size());
+  std::vector<bool> is_offset(out_rank, false);
+  for (auto d : offset_dims) is_offset[d] = true;
+  std::vector<int64_t> out_shape(out_rank);
+  {
+    size_t b = 0, o = 0;
+    for (int d = 0; d < out_rank; ++d) {
+      if (is_offset[d]) out_shape[d] = slice_sizes[kept_slice_dims[o++]];
+      else out_shape[d] = batch_shape[b++];
+    }
+  }
+  NDArray out(out_shape);
+  out.dtype = operand.dtype;
+  auto op_strides = operand.strides();
+  auto idx_strides = indices.strides();
+  for (int64_t i = 0; i < out.numel(); ++i) {
+    auto oc = unravel(i, out.shape);
+    // split output coords into batch coords and per-dim slice offsets
+    std::vector<int64_t> bc, offs(op_rank, 0);
+    {
+      size_t o = 0;
+      for (int d = 0; d < out_rank; ++d) {
+        if (is_offset[d]) offs[kept_slice_dims[o++]] = oc[d];
+        else bc.push_back(oc[d]);
+      }
+    }
+    // start vector: indices[bc, :] through start_index_map
+    std::vector<int64_t> start(op_rank, 0);
+    int64_t base = 0;
+    for (size_t d = 0; d < bc.size(); ++d) base += bc[d] * idx_strides[d];
+    bool oob = false;
+    for (int64_t v = 0; v < idx_vec; ++v) {
+      int64_t dim = start_index_map[v];
+      int64_t s = static_cast<int64_t>(indices.data[base + v * idx_strides.back()]);
+      int64_t max_start = operand.shape[dim] - slice_sizes[dim];
+      if (s < 0 || s > max_start) {
+        if (fill_oob) { oob = true; break; }
+        s = std::min(std::max<int64_t>(s, 0), max_start);
+      }
+      start[dim] = s;
+    }
+    if (oob) { out.data[i] = 0.0f; continue; }
+    int64_t src = 0;
+    for (int d = 0; d < op_rank; ++d) src += (start[d] + offs[d]) * op_strides[d];
+    out.data[i] = operand.data[src];
+  }
+  return out;
+}
+
+NDArray concat_op(const std::vector<const NDArray*>& xs, int64_t dim) {
+  check(!xs.empty(), "concat: no inputs");
+  NDArray out;
+  out.shape = xs[0]->shape;
+  out.dtype = xs[0]->dtype;
+  out.shape[dim] = 0;
+  for (auto* x : xs) out.shape[dim] += x->shape[dim];
+  out.data.resize(static_cast<size_t>(out.numel()));
+  // copy contiguous [outer, x_dim * inner] rows per input
+  int64_t outer = 1, inner = 1;
+  for (int64_t d = 0; d < dim; ++d) outer *= out.shape[d];
+  for (int d = static_cast<int>(dim) + 1; d < out.ndim(); ++d) inner *= out.shape[d];
+  int64_t out_row = out.shape[dim] * inner;
+  int64_t off = 0;
+  for (auto* x : xs) {
+    int64_t row = x->shape[dim] * inner;
+    for (int64_t o = 0; o < outer; ++o)
+      std::copy(x->data.begin() + o * row, x->data.begin() + (o + 1) * row,
+                out.data.begin() + o * out_row + off);
+    off += row;
+  }
+  return out;
+}
+
+NDArray argminmax(const NDArray& x, int64_t axis, bool is_max) {
+  std::vector<int64_t> out_shape;
+  for (int d = 0; d < x.ndim(); ++d)
+    if (d != axis) out_shape.push_back(x.shape[d]);
+  NDArray out(out_shape);
+  out.dtype = DType::I32;
+  int64_t outer = 1, inner = 1;
+  for (int64_t d = 0; d < axis; ++d) outer *= x.shape[d];
+  for (int d = static_cast<int>(axis) + 1; d < x.ndim(); ++d) inner *= x.shape[d];
+  int64_t n = x.shape[axis];
+  for (int64_t o = 0; o < outer; ++o)
+    for (int64_t in = 0; in < inner; ++in) {
+      int64_t best = 0;
+      float bv = x.data[o * n * inner + in];
+      for (int64_t j = 1; j < n; ++j) {
+        float v = x.data[(o * n + j) * inner + in];
+        if (is_max ? v > bv : v < bv) { bv = v; best = j; }
+      }
+      out.data[o * inner + in] = static_cast<float>(best);
+    }
+  return out;
+}
+
+NDArray rev_op(const NDArray& x, const std::vector<int64_t>& dims) {
+  NDArray out(x.shape);
+  out.dtype = x.dtype;
+  std::vector<bool> flip(x.ndim(), false);
+  for (auto d : dims) flip[d] = true;
+  auto xs = x.strides();
+  for (int64_t i = 0; i < out.numel(); ++i) {
+    auto oc = unravel(i, out.shape);
+    int64_t src = 0;
+    for (int d = 0; d < x.ndim(); ++d) {
+      int64_t c = flip[d] ? x.shape[d] - 1 - oc[d] : oc[d];
+      src += c * xs[d];
+    }
+    out.data[i] = x.data[src];
+  }
+  return out;
+}
+
+NDArray dynamic_slice_op(const NDArray& x, const std::vector<int64_t>& starts,
+                         const std::vector<int64_t>& sizes) {
+  NDArray out(sizes);
+  out.dtype = x.dtype;
+  auto xs = x.strides();
+  std::vector<int64_t> s(starts);
+  for (int d = 0; d < x.ndim(); ++d)  // XLA clamps starts into range
+    s[d] = std::min(std::max<int64_t>(s[d], 0), x.shape[d] - sizes[d]);
+  for (int64_t i = 0; i < out.numel(); ++i) {
+    auto oc = unravel(i, out.shape);
+    int64_t src = 0;
+    for (int d = 0; d < x.ndim(); ++d) src += (s[d] + oc[d]) * xs[d];
+    out.data[i] = x.data[src];
+  }
+  return out;
+}
+
+NDArray dynamic_update_slice_op(const NDArray& x, const NDArray& update,
+                                const std::vector<int64_t>& starts) {
+  NDArray out = x;
+  auto xs = x.strides();
+  std::vector<int64_t> s(starts);
+  for (int d = 0; d < x.ndim(); ++d)
+    s[d] = std::min(std::max<int64_t>(s[d], 0), x.shape[d] - update.shape[d]);
+  for (int64_t i = 0; i < update.numel(); ++i) {
+    auto uc = unravel(i, update.shape);
+    int64_t dst = 0;
+    for (int d = 0; d < x.ndim(); ++d) dst += (s[d] + uc[d]) * xs[d];
+    out.data[dst] = update.data[i];
+  }
+  return out;
+}
+
+NDArray cumulative(const NDArray& x, int64_t axis, bool reverse,
+                   const std::function<float(float, float)>& f) {
+  NDArray out = x;
+  int64_t outer = 1, inner = 1;
+  for (int64_t d = 0; d < axis; ++d) outer *= x.shape[d];
+  for (int d = static_cast<int>(axis) + 1; d < x.ndim(); ++d) inner *= x.shape[d];
+  int64_t n = x.shape[axis];
+  for (int64_t o = 0; o < outer; ++o)
+    for (int64_t in = 0; in < inner; ++in) {
+      float acc = 0;
+      bool first = true;
+      for (int64_t j = 0; j < n; ++j) {
+        int64_t jj = reverse ? n - 1 - j : j;
+        float v = x.data[(o * n + jj) * inner + in];
+        acc = first ? v : f(acc, v);
+        first = false;
+        out.data[(o * n + jj) * inner + in] = acc;
+      }
+    }
+  return out;
+}
+
+// round-to-nearest-even f32 -> bf16 -> f32 (faithful bf16 emulation)
+float f32_to_bf16_rn(float f) {
+  uint32_t x;
+  std::memcpy(&x, &f, 4);
+  if ((x & 0x7fffffffu) > 0x7f800000u) {  // NaN: quiet, keep payload bit
+    x = (x | 0x00400000u) & 0xffff0000u;
+  } else {
+    uint32_t lsb = (x >> 16) & 1u;
+    x += 0x7fffu + lsb;
+    x &= 0xffff0000u;
+  }
+  float out;
+  std::memcpy(&out, &x, 4);
   return out;
 }
 

@@ -33,5 +33,20 @@ NDArray slice_op(const NDArray& x, const std::vector<int64_t>& start,
 NDArray pad_op(const NDArray& x, float value, const std::vector<int64_t>& lo,
                const std::vector<int64_t>& hi, const std::vector<int64_t>& interior);
 NDArray select_n(const NDArray& which, const std::vector<const NDArray*>& cases);
+NDArray gather_op(const NDArray& operand, const NDArray& indices,
+                  const std::vector<int64_t>& offset_dims,
+                  const std::vector<int64_t>& collapsed_slice_dims,
+                  const std::vector<int64_t>& start_index_map,
+                  const std::vector<int64_t>& slice_sizes, bool fill_oob);
+NDArray concat_op(const std::vector<const NDArray*>& xs, int64_t dim);
+NDArray argminmax(const NDArray& x, int64_t axis, bool is_max);
+NDArray rev_op(const NDArray& x, const std::vector<int64_t>& dims);
+NDArray dynamic_slice_op(const NDArray& x, const std::vector<int64_t>& starts,
+                         const std::vector<int64_t>& sizes);
+NDArray dynamic_update_slice_op(const NDArray& x, const NDArray& update,
+                                const std::vector<int64_t>& starts);
+NDArray cumulative(const NDArray& x, int64_t axis, bool reverse,
+                   const std::function<float(float, float)>& f);
+float f32_to_bf16_rn(float f);
 
 }  // namespace ptnative

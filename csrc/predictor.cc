@@ -8,11 +8,17 @@
 // the op loop interprets the primitive set in ops.cc.
 //
 // Program text format (one instruction per line, '#' comments):
-//   input  <id> <ndim> <dims...>
-//   const  <id> <float_offset> <ndim> <dims...>
+//   input  <id> <ndim> <dims...> [dtype]
+//   const  <id> <offset> <ndim> <dims...> [dtype]
 //   op     <prim> <out_id> <nin> <in_ids...> <attrs>   # attrs: k=v;k=v (csv ints)
 //   output <id>
+// v1 ("program v1" header): f32 only, <offset> counts floats.
+// v2 ("program v2" header): <offset> counts BYTES into weights.bin and the
+// trailing dtype token (f32|bf16|i32|i64) selects the storage format —
+// bf16 weights are half-size on disk and widened on load; integer
+// constants load exactly (see ndarray.h on the f32 compute convention).
 
+#include <cstdint>
 #include <cstring>
 #include <fstream>
 #include <map>
@@ -64,6 +70,13 @@ static std::vector<int64_t> parse_csv(const std::string& s) {
   return out;
 }
 
+static ptnative::DType parse_dtype(const std::string& s) {
+  if (s == "bf16") return ptnative::DType::BF16;
+  if (s == "i32") return ptnative::DType::I32;
+  if (s == "i64") return ptnative::DType::I64;
+  return ptnative::DType::F32;
+}
+
 static std::unique_ptr<Program> load_program(const std::string& dir) {
   auto prog = std::make_unique<Program>();
   std::ifstream wf(dir + "/weights.bin", std::ios::binary);
@@ -71,12 +84,17 @@ static std::unique_ptr<Program> load_program(const std::string& dir) {
   wf.seekg(0, std::ios::end);
   size_t nbytes = static_cast<size_t>(wf.tellg());
   wf.seekg(0);
-  std::vector<float> wdata(nbytes / sizeof(float));
-  wf.read(reinterpret_cast<char*>(wdata.data()), nbytes);
+  std::vector<unsigned char> wbytes(nbytes);
+  wf.read(reinterpret_cast<char*>(wbytes.data()), nbytes);
 
   std::ifstream pf(dir + "/program.txt");
   check(pf.good(), "cannot open program.txt in " + dir);
   std::string line;
+  bool v2 = false;
+  if (std::getline(pf, line)) {  // header comment carries the version
+    v2 = line.find("v2") != std::string::npos;
+    if (!line.empty() && line[0] != '#') pf.seekg(0);
+  }
   while (std::getline(pf, line)) {
     if (line.empty() || line[0] == '#') continue;
     std::stringstream ss(line);
@@ -94,11 +112,47 @@ static std::unique_ptr<Program> load_program(const std::string& dir) {
       ss >> id >> off >> nd;
       std::vector<int64_t> shape(nd);
       for (auto& d : shape) ss >> d;
+      std::string dt;
+      ss >> dt;  // empty on v1 lines
+      ptnative::DType dtype = parse_dtype(dt);
       NDArray arr;
       arr.shape = shape;
+      arr.dtype = dtype;
       int64_t n = arr.numel();
-      check(off + n <= static_cast<int64_t>(wdata.size()), "const out of range");
-      arr.data.assign(wdata.begin() + off, wdata.begin() + off + n);
+      arr.data.resize(static_cast<size_t>(n));
+      int64_t byte_off = v2 ? off : off * 4;
+      int64_t need = n * static_cast<int64_t>(ptnative::dtype_bytes(dtype));
+      check(byte_off + need <= static_cast<int64_t>(wbytes.size()), "const out of range");
+      const unsigned char* src = wbytes.data() + byte_off;
+      switch (dtype) {
+        case ptnative::DType::F32:
+          std::memcpy(arr.data.data(), src, static_cast<size_t>(n) * 4);
+          break;
+        case ptnative::DType::BF16:
+          for (int64_t i = 0; i < n; ++i) {
+            uint16_t h;
+            std::memcpy(&h, src + i * 2, 2);
+            uint32_t u = static_cast<uint32_t>(h) << 16;
+            float f;
+            std::memcpy(&f, &u, 4);
+            arr.data[i] = f;
+          }
+          break;
+        case ptnative::DType::I32:
+          for (int64_t i = 0; i < n; ++i) {
+            int32_t x;
+            std::memcpy(&x, src + i * 4, 4);
+            arr.data[i] = static_cast<float>(x);
+          }
+          break;
+        case ptnative::DType::I64:
+          for (int64_t i = 0; i < n; ++i) {
+            int64_t x;
+            std::memcpy(&x, src + i * 8, 8);
+            arr.data[i] = static_cast<float>(x);
+          }
+          break;
+      }
       prog->consts.emplace(id, std::move(arr));
     } else if (kind == "op") {
       Instr ins;
@@ -167,6 +221,28 @@ static NDArray run_instr(const Instr& ins, const Env& env) {
     float e = static_cast<float>(attr("y")[0]);
     return unary(in(0), [e](float a) { return std::pow(a, e); });
   }
+  if (p == "sin") return unary(in(0), [](float a) { return std::sin(a); });
+  if (p == "cos") return unary(in(0), [](float a) { return std::cos(a); });
+  if (p == "erf") return unary(in(0), [](float a) { return std::erf(a); });
+  if (p == "ceil") return unary(in(0), [](float a) { return std::ceil(a); });
+  if (p == "round") {  // XLA round_nearest_even
+    return unary(in(0), [](float a) { return std::nearbyint(a); });
+  }
+  if (p == "round_away") {  // XLA round_nearest_afz
+    return unary(in(0), [](float a) { return std::round(a); });
+  }
+  if (p == "expm1") return unary(in(0), [](float a) { return std::expm1(a); });
+  if (p == "log1p") return unary(in(0), [](float a) { return std::log1p(a); });
+  if (p == "not") return unary(in(0), [](float a) { return a != 0 ? 0.0f : 1.0f; });
+  if (p == "is_finite") return unary(in(0), [](float a) { return std::isfinite(a) ? 1.0f : 0.0f; });
+  if (p == "rem") return binary(in(0), in(1), [](float a, float b) { return std::fmod(a, b); });
+  if (p == "atan2") return binary(in(0), in(1), [](float a, float b) { return std::atan2(a, b); });
+  if (p == "ne") return binary(in(0), in(1), [](float a, float b) { return a != b ? 1.0f : 0.0f; });
+  if (p == "to_bf16") return unary(in(0), ptnative::f32_to_bf16_rn);
+  if (p == "to_int") return unary(in(0), [](float a) { return std::trunc(a); });
+  if (p == "clamp")  // lax.clamp(min, x, max)
+    return binary(binary(in(1), in(0), [](float a, float b) { return a > b ? a : b; }),
+                  in(2), [](float a, float b) { return a < b ? a : b; });
   if (p == "copy" || p == "convert_element_type" || p == "stop_gradient")
     return env.at(ins.ins[0]);
   if (p == "reshape") return reshape(in(0), attr("shape"));
@@ -209,6 +285,42 @@ static NDArray run_instr(const Instr& ins, const Env& env) {
     for (size_t i = 1; i < ins.ins.size(); ++i) cases.push_back(&env.at(ins.ins[i]));
     return select_n(in(0), cases);
   }
+  if (p == "gather")
+    return gather_op(in(0), in(1), attr("offset_dims"), attr("collapsed_dims"),
+                     attr("start_index_map"), attr("slice_sizes"),
+                     attr("fill_oob")[0] != 0);
+  if (p == "concatenate") {
+    std::vector<const NDArray*> xs;
+    for (int id : ins.ins) xs.push_back(&env.at(id));
+    return concat_op(xs, attr("dim")[0]);
+  }
+  if (p == "argmax") return argminmax(in(0), attr("axis")[0], true);
+  if (p == "argmin") return argminmax(in(0), attr("axis")[0], false);
+  if (p == "rev") return rev_op(in(0), attr("dims"));
+  if (p == "dynamic_slice") {
+    std::vector<int64_t> starts;
+    for (size_t i = 1; i < ins.ins.size(); ++i)
+      starts.push_back(static_cast<int64_t>(env.at(ins.ins[i]).data[0]));
+    return dynamic_slice_op(in(0), starts, attr("sizes"));
+  }
+  if (p == "dynamic_update_slice") {
+    std::vector<int64_t> starts;
+    for (size_t i = 2; i < ins.ins.size(); ++i)
+      starts.push_back(static_cast<int64_t>(env.at(ins.ins[i]).data[0]));
+    return dynamic_update_slice_op(in(0), in(1), starts);
+  }
+  if (p == "cumsum")
+    return cumulative(in(0), attr("axis")[0], attr("reverse")[0] != 0,
+                      [](float a, float b) { return a + b; });
+  if (p == "cumprod")
+    return cumulative(in(0), attr("axis")[0], attr("reverse")[0] != 0,
+                      [](float a, float b) { return a * b; });
+  if (p == "cummax")
+    return cumulative(in(0), attr("axis")[0], attr("reverse")[0] != 0,
+                      [](float a, float b) { return a > b ? a : b; });
+  if (p == "cummin")
+    return cumulative(in(0), attr("axis")[0], attr("reverse")[0] != 0,
+                      [](float a, float b) { return a < b ? a : b; });
   check(false, "unsupported primitive: " + p);
   return NDArray();
 }
@@ -269,6 +381,19 @@ int pt_predictor_run(PTPredictor* p, const float** inputs, int n_inputs) {
     p->error = e.what();
     return 1;
   }
+}
+
+int pt_predictor_num_inputs(PTPredictor* p) {
+  return p->prog ? static_cast<int>(p->prog->inputs.size()) : 0;
+}
+
+int pt_predictor_input_ndim(PTPredictor* p, int i) {
+  return static_cast<int>(p->prog->inputs[i].second.size());
+}
+
+void pt_predictor_input_shape(PTPredictor* p, int i, int64_t* shape) {
+  const auto& s = p->prog->inputs[i].second;
+  for (size_t d = 0; d < s.size(); ++d) shape[d] = s[d];
 }
 
 int pt_predictor_num_outputs(PTPredictor* p) {

@@ -1,0 +1,138 @@
+// Native training demo: load an exported train-step program and drive it
+// from C++ with no Python in the loop.
+//
+// The reference's pure-C++ training demo (train/demo/demo_trainer.cc)
+// replayed a saved ProgramDesc through the Executor per minibatch. The
+// TPU-native equivalent: the train step is a PURE FUNCTION
+//   (params..., batch...) -> (loss, new_params...)
+// exported by paddle_tpu.native.export_train_step, so C++ "training" is
+// just calling the program and feeding output params back as inputs.
+//
+// Usage: pt_train_demo <exported_dir> <iters>
+//   <dir>/program.txt + weights.bin   — the step program
+//   <dir>/init_params.bin             — initial params, concatenated f32
+//   <dir>/train_meta.txt              — "n_params <K>" (first K inputs are
+//                                        params; outputs are loss, params')
+// Exit 0 iff the final loss improved on the first (training happened).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <vector>
+
+extern "C" {
+struct PTPredictor;
+PTPredictor* pt_predictor_create(const char* dir);
+const char* pt_predictor_error(PTPredictor* p);
+void pt_predictor_destroy(PTPredictor* p);
+int pt_predictor_run(PTPredictor* p, const float** inputs, int n_inputs);
+int pt_predictor_num_inputs(PTPredictor* p);
+int pt_predictor_input_ndim(PTPredictor* p, int i);
+void pt_predictor_input_shape(PTPredictor* p, int i, int64_t* shape);
+int pt_predictor_num_outputs(PTPredictor* p);
+int pt_predictor_output_ndim(PTPredictor* p, int i);
+void pt_predictor_output_shape(PTPredictor* p, int i, int64_t* shape);
+void pt_predictor_output_data(PTPredictor* p, int i, float* out);
+}
+
+namespace {
+
+int64_t input_numel(PTPredictor* p, int i) {
+  int nd = pt_predictor_input_ndim(p, i);
+  std::vector<int64_t> shape(nd);
+  pt_predictor_input_shape(p, i, shape.data());
+  int64_t n = 1;
+  for (auto d : shape) n *= d;
+  return n;
+}
+
+int64_t output_numel(PTPredictor* p, int i) {
+  int nd = pt_predictor_output_ndim(p, i);
+  std::vector<int64_t> shape(nd);
+  pt_predictor_output_shape(p, i, shape.data());
+  int64_t n = 1;
+  for (auto d : shape) n *= d;
+  return n;
+}
+
+// deterministic synthetic batch (xorshift), uniform [-1, 1)
+float next_uniform(uint64_t* s) {
+  *s ^= *s << 13;
+  *s ^= *s >> 7;
+  *s ^= *s << 17;
+  return static_cast<float>((*s >> 11) % 2000000) / 1000000.0f - 1.0f;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s <exported_dir> <iters>\n", argv[0]);
+    return 2;
+  }
+  std::string dir = argv[1];
+  int iters = std::atoi(argv[2]);
+
+  int n_params = -1;
+  {
+    std::ifstream mf(dir + "/train_meta.txt");
+    std::string key;
+    while (mf >> key) {
+      if (key == "n_params") mf >> n_params;
+    }
+  }
+  if (n_params < 0) {
+    std::fprintf(stderr, "missing/invalid train_meta.txt in %s\n", dir.c_str());
+    return 2;
+  }
+
+  PTPredictor* pred = pt_predictor_create(dir.c_str());
+  int n_inputs = pt_predictor_num_inputs(pred);
+  if (n_inputs == 0) {
+    std::fprintf(stderr, "load failed: %s\n", pt_predictor_error(pred));
+    return 2;
+  }
+
+  std::vector<std::vector<float>> bufs(n_inputs);
+  for (int i = 0; i < n_inputs; ++i) bufs[i].resize(input_numel(pred, i));
+
+  {  // initial params
+    std::ifstream f(dir + "/init_params.bin", std::ios::binary);
+    if (!f.good()) {
+      std::fprintf(stderr, "missing init_params.bin\n");
+      return 2;
+    }
+    for (int i = 0; i < n_params; ++i)
+      f.read(reinterpret_cast<char*>(bufs[i].data()), bufs[i].size() * sizeof(float));
+  }
+  uint64_t seed = 0x9e3779b97f4a7c15ull;  // fixed batch: loss must shrink
+  for (int i = n_params; i < n_inputs; ++i)
+    for (auto& v : bufs[i]) v = next_uniform(&seed);
+
+  float first_loss = 0, loss = 0;
+  for (int it = 0; it < iters; ++it) {
+    std::vector<const float*> in_ptrs(n_inputs);
+    for (int i = 0; i < n_inputs; ++i) in_ptrs[i] = bufs[i].data();
+    if (pt_predictor_run(pred, in_ptrs.data(), n_inputs) != 0) {
+      std::fprintf(stderr, "run failed: %s\n", pt_predictor_error(pred));
+      return 2;
+    }
+    pt_predictor_output_data(pred, 0, &loss);
+    if (it == 0) first_loss = loss;
+    std::printf("iter %d loss %.6f\n", it, static_cast<double>(loss));
+    for (int pi = 0; pi < n_params; ++pi) {
+      if (output_numel(pred, pi + 1) != static_cast<int64_t>(bufs[pi].size())) {
+        std::fprintf(stderr, "param %d shape mismatch on feedback\n", pi);
+        return 2;
+      }
+      pt_predictor_output_data(pred, pi + 1, bufs[pi].data());
+    }
+  }
+  pt_predictor_destroy(pred);
+  std::printf("first %.6f final %.6f\n", static_cast<double>(first_loss),
+              static_cast<double>(loss));
+  return loss < first_loss ? 0 : 1;
+}

@@ -8,11 +8,24 @@ parameters are baked in as constants (the closure plays the role of the
 pruned persistables), pjit regions are inlined, and the artifact is
 
     <dir>/program.txt    # linearized instructions (see csrc/predictor.cc)
-    <dir>/weights.bin    # all constants, concatenated float32
+    <dir>/weights.bin    # all constants, concatenated (v2: mixed-dtype bytes)
 
-Only the inference-relevant primitive subset is supported; exporting a
-function with an unsupported primitive (e.g. a training op or gather) raises
-with the primitive name.
+Program format v2: constants carry a storage dtype (f32 / bf16 / i32 /
+i64) — bf16 weights are written as raw 2-byte payloads (half-size
+artifacts, the serving win of bf16 on a CPU host) and integer constants
+(embedding ids, sequence bounds) are stored exactly. Gather / argmax /
+concatenate / dynamic-slice / cumulative ops are supported, which covers
+embedding + classification pipelines and exported train steps (the C++
+train demo, ``csrc/train_demo.cc``). Exporting a function with an
+unsupported primitive raises with the primitive name.
+
+On PJRT-vs-interpreter: SURVEY §7 floated executing the exported StableHLO
+via the PJRT C API instead of this interpreter. Decision: not in this
+image — no standalone PJRT CPU plugin (.so) ships here and linking libjax's
+internal copy is unsupported; the linearized-jaxpr interpreter keeps the
+C++ surface dependency-free. The StableHLO artifact is still exported by
+``io.save_inference_model`` so a PJRT path can be added where a plugin
+exists.
 """
 
 from __future__ import annotations
@@ -24,25 +37,40 @@ import jax
 import numpy as np
 from jax.extend import core as jcore
 
-__all__ = ["export_program", "save_native_model"]
+__all__ = ["export_program", "export_train_step", "save_native_model"]
 
 _UNARY = {
     "exp", "log", "neg", "abs", "sign", "floor", "rsqrt", "sqrt", "tanh",
-    "logistic",
+    "logistic", "sin", "cos", "erf", "ceil", "expm1", "log1p", "not",
+    "is_finite",
 }
 _BINARY = {
     "add", "sub", "mul", "div", "max", "min", "pow", "eq", "lt", "gt", "ge",
-    "le", "and", "or",
+    "le", "and", "or", "rem", "atan2", "ne",
 }
-_COPY = {"convert_element_type", "stop_gradient", "copy"}
+_COPY = {"stop_gradient", "copy"}
 _REDUCE = {"reduce_sum", "reduce_max", "reduce_min", "reduce_or", "reduce_and"}
+_CUMULATIVE = {"cumsum", "cumprod", "cummax", "cummin"}
+
+
+def _storage_dtype(arr: np.ndarray):
+    """Map a numpy/ml_dtypes array to (dtype_tag, payload_bytes)."""
+    import ml_dtypes
+
+    if arr.dtype == ml_dtypes.bfloat16:
+        return "bf16", arr.tobytes()
+    if np.issubdtype(arr.dtype, np.integer) or arr.dtype == np.bool_:
+        if arr.dtype == np.int64:
+            return "i64", arr.astype(np.int64).tobytes()
+        return "i32", arr.astype(np.int32).tobytes()
+    return "f32", arr.astype(np.float32).tobytes()
 
 
 class _Emitter:
     def __init__(self):
         self.lines: List[str] = []
-        self.weights: List[np.ndarray] = []
-        self.weight_offset = 0
+        self.weights: List[bytes] = []
+        self.weight_offset = 0  # bytes (v2)
         # scope stack: each inlined call gets its own frame so a cached
         # sub-jaxpr inlined twice (same Var objects) gets FRESH ids per
         # inlining instead of aliasing the first call's results
@@ -71,14 +99,22 @@ class _Emitter:
         return self.next_id - 1
 
     def const(self, value) -> int:
-        arr = np.asarray(value, np.float32)
+        arr = np.asarray(value)
+        if arr.dtype.kind not in "biuf" and str(arr.dtype) != "bfloat16":
+            arr = arr.astype(np.float32)
+        dtag, payload = _storage_dtype(np.ascontiguousarray(arr))
+        if self.weight_offset % 4:  # keep 4-byte alignment after bf16 blobs
+            pad = 4 - self.weight_offset % 4
+            self.weights.append(b"\x00" * pad)
+            self.weight_offset += pad
         cid = self.fresh()
         self.lines.append(
             f"const {cid} {self.weight_offset} {arr.ndim} "
             + " ".join(str(d) for d in arr.shape)
+            + f" {dtag}"
         )
-        self.weights.append(arr.ravel())
-        self.weight_offset += arr.size
+        self.weights.append(payload)
+        self.weight_offset += len(payload)
         return cid
 
     def op(self, prim: str, out: int, ins: Sequence[int], attrs: Dict[str, object] = None, fval=None):
@@ -137,12 +173,23 @@ def _emit_eqn(em: _Emitter, eqn) -> None:
     ins = _in_ids(em, eqn)
     out = em.vid(eqn.outvars[0])
 
-    if prim in _BINARY:
+    if prim == "add_any":  # grad accumulation (lax.add_any) == add
+        em.op("add", out, ins)
+    elif prim in _BINARY:
         em.op(prim, out, ins)
     elif prim in _UNARY:
         em.op(prim, out, ins)
     elif prim in _COPY:
         em.op("copy", out, ins[:1])
+    elif prim == "convert_element_type":
+        new_dtype = np.dtype(params["new_dtype"]) if not hasattr(params["new_dtype"], "name") else params["new_dtype"]
+        name = getattr(new_dtype, "name", str(new_dtype))
+        if name == "bfloat16":
+            em.op("to_bf16", out, ins[:1])
+        elif name.startswith(("int", "uint")):
+            em.op("to_int", out, ins[:1])
+        else:
+            em.op("copy", out, ins[:1])
     elif prim == "integer_pow":
         em.op("integer_pow", out, ins, {"y": params["y"]})
     elif prim == "reshape":
@@ -185,6 +232,40 @@ def _emit_eqn(em: _Emitter, eqn) -> None:
         )
     elif prim == "select_n":
         em.op("select_n", out, ins)
+    elif prim == "gather":
+        dn = params["dimension_numbers"]
+        if getattr(dn, "operand_batching_dims", ()) or getattr(dn, "start_indices_batching_dims", ()):
+            raise NotImplementedError("gather with batching dims not supported natively")
+        mode = params.get("mode")
+        fill_oob = 1 if (mode is not None and "FILL" in str(mode)) else 0
+        em.op(
+            "gather", out, ins,
+            {
+                "offset_dims": dn.offset_dims,
+                "collapsed_dims": dn.collapsed_slice_dims,
+                "start_index_map": dn.start_index_map,
+                "slice_sizes": params["slice_sizes"],
+                "fill_oob": fill_oob,
+            },
+        )
+    elif prim in ("argmax", "argmin"):
+        axes = params["axes"]
+        em.op(prim, out, ins[:1], {"axis": axes[0]})
+    elif prim == "concatenate":
+        em.op("concatenate", out, ins, {"dim": params["dimension"]})
+    elif prim == "rev":
+        em.op("rev", out, ins[:1], {"dims": params["dimensions"]})
+    elif prim == "dynamic_slice":
+        em.op("dynamic_slice", out, ins, {"sizes": params["slice_sizes"]})
+    elif prim == "dynamic_update_slice":
+        em.op("dynamic_update_slice", out, ins)
+    elif prim == "clamp":
+        em.op("clamp", out, ins)
+    elif prim in _CUMULATIVE:
+        em.op(prim, out, ins[:1], {"axis": params["axis"], "reverse": 1 if params.get("reverse") else 0})
+    elif prim == "round":
+        method = str(params.get("rounding_method", ""))
+        em.op("round" if "EVEN" in method.upper() else "round_away", out, ins[:1])
     elif prim == "iota":
         arr = np.zeros(params["shape"], np.float32)
         idx = np.arange(params["shape"][params["dimension"]], dtype=np.float32)
@@ -308,12 +389,46 @@ def export_program(fn: Callable, example_inputs: Sequence, out_dir: str) -> None
             out_lines.append(f"output {em.vid(var)}")
 
     with open(os.path.join(out_dir, "program.txt"), "w") as f:
-        f.write("# paddle_tpu native program v1\n")
+        f.write("# paddle_tpu native program v2\n")
         f.write("\n".join(em.lines + out_lines) + "\n")
+    with open(os.path.join(out_dir, "weights.bin"), "wb") as f:
+        f.write(b"".join(em.weights))
+
+
+def export_train_step(
+    loss_fn: Callable, params, example_batch: Sequence, out_dir: str, lr: float = 0.1
+) -> None:
+    """Export a full SGD train step for the C++ training demo
+    (``csrc/train_demo.cc``; reference ``train/demo/demo_trainer.cc``).
+
+    The exported program is the pure function
+    ``(params..., batch...) -> (loss, new_params...)`` — forward, backward
+    (jax.grad traced into the jaxpr), and the SGD update all inlined — so a
+    C++ host trains by looping the program and feeding output params back.
+    Also writes ``init_params.bin`` (initial params, f32, flattened in input
+    order) and ``train_meta.txt`` (``n_params <K>``).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    n = len(leaves)
+
+    def step(*args):
+        ps = jax.tree_util.tree_unflatten(treedef, args[:n])
+        batch = args[n:]
+        loss, grads = jax.value_and_grad(loss_fn)(ps, *batch)
+        new_leaves = [
+            p - lr * g
+            for p, g in zip(jax.tree_util.tree_leaves(ps), jax.tree_util.tree_leaves(grads))
+        ]
+        return (loss, *new_leaves)
+
+    export_program(step, tuple(leaves) + tuple(example_batch), out_dir)
     blob = (
-        np.concatenate(em.weights) if em.weights else np.zeros((0,), np.float32)
-    ).astype(np.float32)
-    blob.tofile(os.path.join(out_dir, "weights.bin"))
+        np.concatenate([np.asarray(l, np.float32).ravel() for l in leaves])
+        if leaves else np.zeros((0,), np.float32)
+    )
+    blob.tofile(os.path.join(out_dir, "init_params.bin"))
+    with open(os.path.join(out_dir, "train_meta.txt"), "w") as f:
+        f.write(f"n_params {n}\n")
 
 
 def save_native_model(model, variables, example_inputs: Sequence, out_dir: str) -> None:
