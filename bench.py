@@ -109,9 +109,14 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
         pass
 
     from paddle_tpu import models
+    from paddle_tpu.core.config import set_flags
 
     deadline = time.monotonic() + float(os.environ.get("PT_BENCH_CHILD_BUDGET_S", "420"))
     dev = jax.devices()[0]
+    if dev.platform != "cpu":
+        # TPU-native training mode: bf16 matmul/conv on the MXU + the Pallas
+        # flash kernel wherever attention is mask-free/causal
+        set_flags(use_bf16_compute=True, use_flash_attention=True)
     peak = _peak_flops(dev.device_kind)
     result = {
         "metric": "resnet50_train_images_per_sec",
@@ -139,6 +144,50 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
     except Exception as e:  # keep going — transformer number still valuable
         result["notes"].append(f"resnet_failed: {type(e).__name__}: {e}"[:300])
 
+    # --- Flash attention A/B (fused Pallas fwd+bwd vs composed XLA) ---
+    def bench_flash(T: int, iters: int = 8):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from paddle_tpu.ops.pallas import flash_attention
+        from paddle_tpu.ops.pallas.flash_attention import _reference_attention
+
+        B, H, d2 = (4, 16, 64) if T <= 2048 else (1, 16, 64)
+        rng = np.random.RandomState(0)
+        mk = lambda: jax.device_put(
+            jnp.asarray(rng.randn(B, H, T, d2).astype(np.float32)).astype(jnp.bfloat16)
+        )
+        q, k, v = mk(), mk(), mk()
+
+        def time_grad(fn):
+            g = jax.jit(jax.grad(lambda a, b, c: fn(a, b, c).astype(jnp.float32).sum(), (0, 1, 2)))
+            out = g(q, k, v)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = g(q, k, v)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / iters
+
+        t_flash = time_grad(lambda a, b, c: flash_attention(a, b, c, causal=True))
+        result[f"flash_fwdbwd_ms_t{T}"] = round(t_flash * 1e3, 3)
+        # the composed reference materializes [B,H,T,T] and can OOM at long
+        # T — the flash number above must survive that
+        t_xla = time_grad(lambda a, b, c: _reference_attention(a, b, c, True, d2 ** -0.5))
+        return t_flash, t_xla
+
+    if dev.platform != "cpu" and not tiny:
+        for T in (1024, 8192):
+            if time.monotonic() > deadline:
+                result["notes"].append(f"flash_t{T}_skipped_budget")
+                continue
+            try:
+                t_flash, t_xla = bench_flash(T)
+                result[f"flash_speedup_vs_xla_t{T}"] = round(t_xla / t_flash, 3)
+                print(f"flash T={T}: {t_flash*1e3:.2f}ms vs xla {t_xla*1e3:.2f}ms", file=sys.stderr)
+            except Exception as e:
+                result["notes"].append(f"flash_t{T}_failed: {type(e).__name__}: {e}"[:300])
+
     # --- Transformer ---
     if time.monotonic() < deadline:
         tbs, tseq = (4, 64) if tiny else (32, 256)
@@ -154,6 +203,21 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
             result["notes"].append(f"transformer_failed: {type(e).__name__}: {e}"[:300])
     else:
         result["notes"].append("transformer_skipped_budget")
+
+    # --- decoder-only LM (flash + bf16 path, the long-context flagship) ---
+    if time.monotonic() < deadline:
+        lbs, lseq = (2, 128) if tiny else (8, 1024)
+        try:
+            lspec = models.get_model("transformer_lm", seq_len=lseq)
+            dt, flops = _bench_step(lspec, lbs, warmup=1, iters=3 if tiny else 10)
+            result["lm_tokens_per_sec"] = round(lbs * lseq / dt, 1)
+            if peak and flops:
+                result["lm_mfu"] = round(flops / dt / peak, 4)
+            print(f"transformer_lm: {result['lm_tokens_per_sec']} tok/s", file=sys.stderr)
+        except Exception as e:
+            result["notes"].append(f"lm_failed: {type(e).__name__}: {e}"[:300])
+    else:
+        result["notes"].append("lm_skipped_budget")
 
     print(json.dumps(result))
 

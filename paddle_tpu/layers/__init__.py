@@ -106,7 +106,10 @@ def fc(
             in_dim *= s
         x2 = input.reshape((-1, in_dim))
         w = create_parameter([in_dim, size], input.dtype, name="w", attr=param_attr)
-        out = jnp.matmul(x2, w, preferred_element_type=jnp.float32).astype(input.dtype)
+        from paddle_tpu.core.dtypes import mxu_operands
+
+        x2c, wc = mxu_operands(x2, w)
+        out = jnp.matmul(x2c, wc, preferred_element_type=jnp.float32).astype(input.dtype)
         if bias_attr is not False:
             b = create_parameter(
                 [size], input.dtype, name="b", attr=bias_attr, default_initializer=init_mod.Constant(0.0)

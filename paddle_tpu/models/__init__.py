@@ -86,12 +86,19 @@ def _machine_translation(**cfg):
     return machine_translation.get_model(**cfg)
 
 
+def _transformer_lm(**cfg):
+    from paddle_tpu.models import transformer_lm
+
+    return transformer_lm.get_model(**cfg)
+
+
 MODELS: Dict[str, Callable[..., ModelSpec]] = {
     "mnist": _mnist,
     "resnet": _resnet,
     "se_resnext": _se_resnext,
     "vgg": _vgg,
     "transformer": _transformer,
+    "transformer_lm": _transformer_lm,
     "stacked_dynamic_lstm": _stacked_dynamic_lstm,
     "machine_translation": _machine_translation,
 }

@@ -61,6 +61,7 @@ def multi_head_attention(
     dropout_rate: float = 0.0,
     cache: Optional[dict] = None,
     name: str = "mha",
+    causal: bool = False,
 ):
     """Projected multi-head attention (q/k/v/out linear maps + fused core).
 
@@ -82,6 +83,7 @@ def multi_head_attention(
             qh, kh, vh, mask=mask, dropout_rate=dropout_rate,
             is_test=not pt.framework.is_training(),
             dropout_key=pt.framework.next_rng_key() if (dropout_rate > 0 and pt.framework.is_training()) else None,
+            causal=causal,
         )
         out = oattn.combine_heads(ctx)
         return _proj(out, d_model, shard_out=False, name="out")

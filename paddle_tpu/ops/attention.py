@@ -55,12 +55,16 @@ def scaled_dot_product_attention(
     is_test: bool = True,
     dropout_key=None,
     scale: Optional[float] = None,
+    causal: bool = False,
 ) -> jax.Array:
     """Attention over [..., T, D] tensors (head dims lead). ``mask`` is an
-    additive mask broadcastable to [..., Tq, Tk] (0 = keep, -inf = drop).
+    additive mask broadcastable to [..., Tq, Tk] (0 = keep, -inf = drop);
+    ``causal=True`` applies the autoregressive mask structurally — prefer it
+    over an additive causal mask, because the flash kernel then skips the
+    masked blocks' compute entirely instead of materializing [Tq, Tk].
 
     Softmax in fp32; QK^T and PV matmuls accumulate fp32 on the MXU.
-    With ``flags().use_flash_attention``, the unmasked 4-D case routes
+    With ``flags().use_flash_attention``, the mask-free 4-D case routes
     through the Pallas flash kernel (``ops.pallas.flash_attention``) when
     block tiling divides the sequence lengths.
     """
@@ -76,13 +80,29 @@ def scaled_dot_product_attention(
         and q.ndim == 4
         and k.shape == v.shape
         and q.shape[:2] == k.shape[:2]  # no MQA-style broadcast heads
+        # the kernel's causal mask is top-left aligned (q_pos >= k_pos);
+        # causal_mask below is bottom-right aligned for Tq != Tk — only
+        # route equal-length causal calls so the two paths agree
+        and (not causal or q.shape[-2] == k.shape[-2])
     ):
         bq = _flash_block(q.shape[-2])
         bk = _flash_block(k.shape[-2])
         if bq and bk:
+            from paddle_tpu.core.dtypes import mxu_operands
             from paddle_tpu.ops.pallas import flash_attention
 
-            return flash_attention(q, k, v, sm_scale=scale, block_q=bq, block_k=bk)
+            out_dtype = q.dtype
+            q, k, v = mxu_operands(q, k, v)  # bf16 halves K/V HBM traffic
+            return flash_attention(
+                q, k, v, causal=causal, sm_scale=scale, block_q=bq, block_k=bk
+            ).astype(out_dtype)
+    if causal:
+        mask_c = causal_mask(q.shape[-2], k.shape[-2])
+        mask = mask_c if mask is None else mask + mask_c
+    from paddle_tpu.core.dtypes import mxu_operands
+
+    out_dtype = q.dtype
+    q, k, v = mxu_operands(q, k, v)
     logits = jnp.matmul(q, jnp.swapaxes(k, -1, -2), preferred_element_type=jnp.float32)
     logits = logits * scale
     if mask is not None:
@@ -93,4 +113,4 @@ def scaled_dot_product_attention(
 
         weights = _dropout(weights, dropout_rate, is_test=False, key=dropout_key)
     out = jnp.matmul(weights.astype(v.dtype), v, preferred_element_type=jnp.float32)
-    return out.astype(q.dtype)
+    return out.astype(out_dtype)

@@ -271,7 +271,10 @@ def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False, alpha: fl
         x = jnp.swapaxes(x, -1, -2)
     if transpose_y:
         y = jnp.swapaxes(y, -1, -2)
-    out = jnp.matmul(x, y, preferred_element_type=jnp.float32)
+    from paddle_tpu.core.dtypes import mxu_operands
+
+    xc, yc = mxu_operands(x, y)
+    out = jnp.matmul(xc, yc, preferred_element_type=jnp.float32)
     if alpha != 1.0:
         out = out * alpha
     return out.astype(x.dtype if x.dtype == y.dtype else jnp.result_type(x, y))

@@ -102,10 +102,13 @@ def conv2d(
     ``operators/math/im2col.cc``): one lax.conv_general_dilated that XLA maps
     straight onto the MXU — no algo selection, no workspace management.
     """
+    from paddle_tpu.core.dtypes import mxu_operands
+
+    xc, wc = mxu_operands(x, weight)
     dn = lax.conv_dimension_numbers(x.shape, weight.shape, _NHWC_SPEC)
     out = lax.conv_general_dilated(
-        x,
-        weight,
+        xc,
+        wc,
         window_strides=_pair(stride),
         padding=_conv_padding(padding),
         rhs_dilation=_pair(dilation),
@@ -143,9 +146,12 @@ def conv2d_transpose(
     # gradient-of-conv formulation: dilate inputs by stride, flip kernel
     # spatially (weight is [h, w, in, out], so channels already line up)
     w_flipped = jnp.flip(weight, (0, 1))
+    from paddle_tpu.core.dtypes import mxu_operands
+
+    x_c, w_flipped = mxu_operands(x, w_flipped)
     dn = lax.conv_dimension_numbers(x.shape, w_flipped.shape, _NHWC_SPEC)
     out = lax.conv_general_dilated(
-        x,
+        x_c,
         w_flipped,
         window_strides=(1, 1),
         padding=pads,
@@ -498,7 +504,10 @@ def resize_bilinear(x, out_shape: Tuple[int, int], align_corners: bool = False):
 
 
 def matmul_bias(x, w, b=None):
-    out = jnp.matmul(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
+    from paddle_tpu.core.dtypes import mxu_operands
+
+    xc, wc = mxu_operands(x, w)
+    out = jnp.matmul(xc, wc, preferred_element_type=jnp.float32).astype(x.dtype)
     if b is not None:
         out = out + b
     return out
