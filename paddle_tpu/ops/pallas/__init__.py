@@ -8,6 +8,9 @@ that surface is left to XLA, and only attention-style blockwise-softmax
 fusions get custom kernels. Kernels run in interpret mode off-TPU so tests
 exercise them on the CPU mesh."""
 
-from paddle_tpu.ops.pallas.flash_attention import flash_attention  # noqa: F401
+from paddle_tpu.ops.pallas.flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attention_with_lse,
+)
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_with_lse"]

@@ -31,7 +31,7 @@ from jax.experimental import pallas as pl
 from paddle_tpu.core.dtypes import NEG_INF
 from paddle_tpu.core.enforce import enforce
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_with_lse"]
 
 
 def _flash_fwd_kernel(
@@ -428,6 +428,28 @@ def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Forward-only fused attention returning ``(out, lse)`` with lse
+    [B, H, T, 1] — the building block for outer blockwise schedules that
+    merge partials themselves (ring attention merges per-ring-step outputs
+    by lse). NOT differentiable: callers wrap the whole schedule in their
+    own ``jax.custom_vjp``."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_fwd(q, k, v, causal, float(sm_scale), block_q, block_k, interpret)
 
 
 def flash_attention(

@@ -52,17 +52,10 @@ def _merge(m1, l1, o1, m2, l2, o2):
     return m, l, o
 
 
-def ring_attention(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    axis: str,
-    causal: bool = False,
-) -> jax.Array:
-    """Per-device body (call inside shard_map/pjit with ``axis`` a mesh axis
-    over which the SEQUENCE dim is sharded). q/k/v: [B, H, T_local, d].
-    Returns [B, H, T_local, d] — exact softmax(QK^T)V over the GLOBAL
-    sequence."""
+def _ring_composed(q, k, v, axis: str, causal: bool) -> jax.Array:
+    """Composed-einsum ring body — the always-differentiable reference path
+    (scan + ppermute autodiff) and the recompute backward for the flash
+    forward below."""
     n_dev = jax.lax.psum(1, axis)
     rank = jax.lax.axis_index(axis)
     t_local = q.shape[2]
@@ -99,6 +92,106 @@ def ring_attention(
     return out.astype(dtype)
 
 
+def _merge_normalized(o1, lse1, o2, lse2):
+    """Merge two NORMALIZED partials (o_i = softmax-weighted values over
+    block i, lse_i = logsumexp of its scores, [B, H, T, 1])."""
+    m = jnp.maximum(lse1, lse2)
+    a1 = jnp.exp(lse1 - m)
+    a2 = jnp.exp(lse2 - m)
+    l = a1 + a2
+    o = (o1 * a1 + o2 * a2) / l
+    return o, m + jnp.log(l)
+
+
+def _ring_flash_fwd(q, k, v, axis: str, causal: bool) -> jax.Array:
+    """Flash-kernel ring body: each (local-Q, rotating-KV) block pair runs
+    the fused Pallas kernel and partials merge by logsumexp. Step 0 is
+    always the diagonal block (causal kernel, top-left aligned — exact
+    because Q and KV start at the same global offset); later steps are
+    whole blocks: fully visible when the KV block is from an earlier rank,
+    dropped (lse=-inf) when from a later rank."""
+    from paddle_tpu.ops.attention import _flash_block
+    from paddle_tpu.ops.pallas import flash_attention_with_lse
+
+    n_dev = jax.lax.psum(1, axis)
+    rank = jax.lax.axis_index(axis)
+    dtype = q.dtype
+    # q in f32 (merge accumulates in its dtype); k/v keep the input dtype —
+    # they rotate the ring, and bf16 halves the per-step ICI bytes (the
+    # kernel upcasts tiles internally anyway)
+    q32 = q.astype(jnp.float32)
+    perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+    bq = _flash_block(q.shape[-2])
+    bk = _flash_block(k.shape[-2])
+
+    o, lse = flash_attention_with_lse(q32, k, v, causal=causal, block_q=bq, block_k=bk)
+
+    def step(carry, i):
+        o, lse, kk, vv = carry
+        kk = jax.lax.ppermute(kk, axis, perm)
+        vv = jax.lax.ppermute(vv, axis, perm)
+        bo, blse = flash_attention_with_lse(q32, kk, vv, causal=False, block_q=bq, block_k=bk)
+        if causal:
+            kv_rank = (rank - i) % n_dev
+            blse = jnp.where(kv_rank > rank, NEG_INF, blse)
+        o, lse = _merge_normalized(o, lse, bo, blse)
+        return (o, lse, kk, vv), None
+
+    (o, lse, _, _), _ = jax.lax.scan(step, (o, lse, k, v), jnp.arange(1, n_dev))
+    return o.astype(dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_flash(q, k, v, axis, causal):
+    return _ring_flash_fwd(q, k, v, axis, causal)
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis, causal):
+    return _ring_flash_fwd(q, k, v, axis, causal), (q, k, v)
+
+
+def _ring_flash_vjp_bwd(axis, causal, res, g):
+    # recompute backward through the composed ring (activations were never
+    # stored; the fused-backward ring is a later optimization)
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: _ring_composed(a, b, c, axis, causal), q, k, v)
+    return vjp(g)
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis: str,
+    causal: bool = False,
+    use_flash: Optional[bool] = None,
+) -> jax.Array:
+    """Per-device body (call inside shard_map/pjit with ``axis`` a mesh axis
+    over which the SEQUENCE dim is sharded). q/k/v: [B, H, T_local, d].
+    Returns [B, H, T_local, d] — exact softmax(QK^T)V over the GLOBAL
+    sequence.
+
+    ``use_flash`` (default: ``flags().use_flash_attention``) computes each
+    block pair with the fused Pallas kernel instead of composed einsums, so
+    the FORWARD never materializes the [T_local, T_local] score matrix in
+    HBM. The backward currently recomputes through the composed ring (per
+    ring-step probability residuals ARE materialized there) — the memory
+    win applies to inference/forward until the fused-backward ring lands."""
+    if use_flash is None:
+        from paddle_tpu.core.config import flags
+
+        use_flash = flags().use_flash_attention
+    if use_flash and q.ndim == 4:
+        from paddle_tpu.ops.attention import _flash_block
+
+        if _flash_block(q.shape[-2]) and _flash_block(k.shape[-2]):
+            return _ring_flash(q, k, v, axis, causal)
+    return _ring_composed(q, k, v, axis, causal)
+
+
 def ring_attention_sharded(
     q: jax.Array,
     k: jax.Array,
@@ -106,13 +199,14 @@ def ring_attention_sharded(
     mesh: Mesh,
     axis: str = mesh_mod.SEQ_AXIS,
     causal: bool = False,
+    use_flash: Optional[bool] = None,
 ) -> jax.Array:
     """Convenience wrapper: q/k/v are GLOBAL [B, H, T, d] arrays; shards the
     T dim over ``axis``, runs :func:`ring_attention` under shard_map, and
     returns the global result."""
     spec = P(None, None, axis, None)
     return shard_map(
-        partial(ring_attention, axis=axis, causal=causal),
+        partial(ring_attention, axis=axis, causal=causal, use_flash=use_flash),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
