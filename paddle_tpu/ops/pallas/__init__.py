@@ -10,7 +10,8 @@ exercise them on the CPU mesh."""
 
 from paddle_tpu.ops.pallas.flash_attention import (  # noqa: F401
     flash_attention,
+    flash_attention_bwd_block,
     flash_attention_with_lse,
 )
 
-__all__ = ["flash_attention", "flash_attention_with_lse"]
+__all__ = ["flash_attention", "flash_attention_bwd_block", "flash_attention_with_lse"]

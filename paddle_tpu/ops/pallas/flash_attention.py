@@ -31,7 +31,7 @@ from jax.experimental import pallas as pl
 from paddle_tpu.core.dtypes import NEG_INF
 from paddle_tpu.core.enforce import enforce
 
-__all__ = ["flash_attention", "flash_attention_with_lse"]
+__all__ = ["flash_attention", "flash_attention_with_lse", "flash_attention_bwd_block"]
 
 
 def _flash_fwd_kernel(
@@ -318,6 +318,8 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
     t_kv = k.shape[2]
     block_q = min(block_q, T)
     block_k = min(block_k, t_kv)
+    enforce(T % block_q == 0, f"seq len {T} not divisible by block_q {block_q}")
+    enforce(t_kv % block_k == 0, f"kv len {t_kv} not divisible by block_k {block_k}")
 
     qr = q.reshape(B * H, T, d)
     kr = k.reshape(B * H, t_kv, d)
@@ -450,6 +452,33 @@ def flash_attention_with_lse(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     return _flash_fwd(q, k, v, causal, float(sm_scale), block_q, block_k, interpret)
+
+
+def flash_attention_bwd_block(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    out: jax.Array,
+    lse: jax.Array,
+    g: jax.Array,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """One block-pair backward against GLOBAL residuals: returns
+    ``(dq, dk, dv)`` for this (Q, K/V) pair, where ``out``/``lse`` are the
+    FINAL merged attention output and logsumexp over the whole sequence
+    (FlashAttention-2: Δ = rowsum(dO ∘ O) and P = exp(S − lse) both use
+    global statistics, so per-block backward contributions are independent
+    and sum to the exact gradients). The ring-attention backward calls this
+    per ring step, accumulating dK/dV in carriers that rotate with K/V."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_bwd(q, k, v, out, lse, g, causal, float(sm_scale), block_q, block_k, interpret)
 
 
 def flash_attention(

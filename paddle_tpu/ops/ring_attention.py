@@ -103,7 +103,7 @@ def _merge_normalized(o1, lse1, o2, lse2):
     return o, m + jnp.log(l)
 
 
-def _ring_flash_fwd(q, k, v, axis: str, causal: bool) -> jax.Array:
+def _ring_flash_fwd(q, k, v, axis: str, causal: bool) -> tuple[jax.Array, jax.Array]:
     """Flash-kernel ring body: each (local-Q, rotating-KV) block pair runs
     the fused Pallas kernel and partials merge by logsumexp. Step 0 is
     always the diagonal block (causal kernel, top-left aligned — exact
@@ -138,24 +138,87 @@ def _ring_flash_fwd(q, k, v, axis: str, causal: bool) -> jax.Array:
         return (o, lse, kk, vv), None
 
     (o, lse, _, _), _ = jax.lax.scan(step, (o, lse, k, v), jnp.arange(1, n_dev))
-    return o.astype(dtype)
+    return o.astype(dtype), lse
+
+
+def _ring_flash_bwd_ring(q, k, v, out, lse, g, axis: str, causal: bool):
+    """Fused-backward ring (Liu et al. ring attention, backward pass): each
+    ring step runs the Pallas block backward against the GLOBAL (out, lse)
+    residuals — Δ and P need only final statistics, so per-block dQ/dK/dV
+    contributions are exact and independent. dQ accumulates locally; dK/dV
+    accumulate in f32 carriers that rotate WITH k/v, so after the full
+    cycle (n-1 scan steps + one final shift) each block's gradient arrives
+    back at its home device. Nothing [T_local, T_local]-shaped ever hits
+    HBM in the backward either."""
+    from paddle_tpu.ops.attention import _flash_block
+    from paddle_tpu.ops.pallas import flash_attention_bwd_block
+
+    n_dev = jax.lax.psum(1, axis)
+    rank = jax.lax.axis_index(axis)
+    perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+    bq = _flash_block(q.shape[-2])
+    bk = _flash_block(k.shape[-2])
+    q32 = q.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    out32 = out.astype(jnp.float32)
+
+    # step 0: the diagonal block (causal kernel when causal); f32 k/v so the
+    # gradient carriers start and stay full-precision
+    dq, dkk, dvv = flash_attention_bwd_block(
+        q32, k.astype(jnp.float32), v.astype(jnp.float32), out32, lse, g32,
+        causal=causal, block_q=bq, block_k=bk,
+    )
+
+    def step(carry, i):
+        dq, dkk, dvv, kk, vv = carry
+        kk = jax.lax.ppermute(kk, axis, perm)
+        vv = jax.lax.ppermute(vv, axis, perm)
+        dkk = jax.lax.ppermute(dkk, axis, perm)
+        dvv = jax.lax.ppermute(dvv, axis, perm)
+        step_lse = lse
+        if causal:
+            # blocks from later ranks contributed nothing to the merged lse;
+            # substituting a huge lse makes p = exp(s - lse) underflow to an
+            # exact 0 inside the kernel, zeroing this step's contributions
+            # without the inf·0 hazard of masking finished gradients
+            dead = (rank - i) % n_dev > rank
+            step_lse = jnp.where(dead, -NEG_INF, lse)
+        # upcast the rotating K/V at the kernel call (ICI still moves the
+        # input dtype): dk/dv then come back f32, so carrier accumulation
+        # never rounds per step
+        bdq, bdk, bdv = flash_attention_bwd_block(
+            q32, kk.astype(jnp.float32), vv.astype(jnp.float32), out32,
+            step_lse, g32, causal=False, block_q=bq, block_k=bk,
+        )
+        dq = dq + bdq
+        dkk = dkk + bdk
+        dvv = dvv + bdv
+        return (dq, dkk, dvv, kk, vv), None
+
+    (dq, dkk, dvv, _, _), _ = jax.lax.scan(
+        step, (dq, dkk, dvv, k, v), jnp.arange(1, n_dev)
+    )
+    # k/v have rotated n-1 steps; one more shift completes the cycle and
+    # lands each block's accumulated gradient on its home device
+    dkk = jax.lax.ppermute(dkk, axis, perm)
+    dvv = jax.lax.ppermute(dvv, axis, perm)
+    return dq.astype(q.dtype), dkk.astype(k.dtype), dvv.astype(v.dtype)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _ring_flash(q, k, v, axis, causal):
-    return _ring_flash_fwd(q, k, v, axis, causal)
+    out, _ = _ring_flash_fwd(q, k, v, axis, causal)
+    return out
 
 
 def _ring_flash_vjp_fwd(q, k, v, axis, causal):
-    return _ring_flash_fwd(q, k, v, axis, causal), (q, k, v)
+    out, lse = _ring_flash_fwd(q, k, v, axis, causal)
+    return out, (q, k, v, out, lse)
 
 
 def _ring_flash_vjp_bwd(axis, causal, res, g):
-    # recompute backward through the composed ring (activations were never
-    # stored; the fused-backward ring is a later optimization)
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: _ring_composed(a, b, c, axis, causal), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _ring_flash_bwd_ring(q, k, v, out, lse, g, axis, causal)
 
 
 _ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
@@ -175,11 +238,11 @@ def ring_attention(
     sequence.
 
     ``use_flash`` (default: ``flags().use_flash_attention``) computes each
-    block pair with the fused Pallas kernel instead of composed einsums, so
-    the FORWARD never materializes the [T_local, T_local] score matrix in
-    HBM. The backward currently recomputes through the composed ring (per
-    ring-step probability residuals ARE materialized there) — the memory
-    win applies to inference/forward until the fused-backward ring lands."""
+    block pair with the fused Pallas kernel instead of composed einsums —
+    forward AND backward (a second ring of fused block-backwards against
+    the global (out, lse) residuals) — so nothing [T_local, T_local]-shaped
+    materializes in HBM in either direction: long-context training memory
+    stays O(T_local · d) per device."""
     if use_flash is None:
         from paddle_tpu.core.config import flags
 

@@ -232,3 +232,28 @@ def test_ring_attention_flash_matches_composed(rng, causal):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4, err_msg=f"d{name}"
         )
+
+
+def test_ring_attention_flash_bf16_grads(rng):
+    """bf16 q/k/v through the fused-backward ring: grads stay close to the
+    f32 composed ring (carriers accumulate in f32)."""
+    B, H, T, d = 1, 2, 32, 8
+    mesh = make_mesh(seq=4, data=2)
+    q32 = rng.randn(B, H, T, d).astype(np.float32)
+    w = jnp.asarray(rng.randn(B, H, T, d).astype(np.float32))
+    q16 = jnp.asarray(q32).astype(jnp.bfloat16)
+
+    def loss16(q):
+        o = ring_attention_sharded(q, q, q, mesh, causal=True, use_flash=True)
+        return jnp.sum(o.astype(jnp.float32) * w)
+
+    def loss32(q):
+        o = ring_attention_sharded(q, q, q, mesh, causal=True, use_flash=False)
+        return jnp.sum(o * w)
+
+    g16 = jax.jit(jax.grad(loss16))(q16)
+    g32 = jax.grad(loss32)(jnp.asarray(q32))
+    assert g16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(g16, np.float32), np.asarray(g32), rtol=6e-2, atol=6e-2
+    )
