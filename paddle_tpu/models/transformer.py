@@ -62,12 +62,15 @@ def multi_head_attention(
     cache: Optional[dict] = None,
     name: str = "mha",
     causal: bool = False,
+    core=None,
 ):
     """Projected multi-head attention (q/k/v/out linear maps + fused core).
 
     ``cache`` (decode-time) holds accumulated k/v: {"k": [B,N,T,D], "v": ...};
     when given, new k/v are appended (static-size cache with a write index is
-    used in the beam-search decoder)."""
+    used in the beam-search decoder). ``core`` overrides the attention core
+    ``(qh, kh, vh) -> ctx`` — e.g. a ring-attention body for sequence-
+    parallel long context."""
     with name_scope(name):
         q = _proj(queries, d_model, shard_out=True, name="q")
         k = _proj(keys, d_model, shard_out=True, name="k")
@@ -79,12 +82,23 @@ def multi_head_attention(
             kh = jnp.concatenate([cache["k"], kh], axis=2)
             vh = jnp.concatenate([cache["v"], vh], axis=2)
             cache["k"], cache["v"] = kh, vh
-        ctx = oattn.scaled_dot_product_attention(
-            qh, kh, vh, mask=mask, dropout_rate=dropout_rate,
-            is_test=not pt.framework.is_training(),
-            dropout_key=pt.framework.next_rng_key() if (dropout_rate > 0 and pt.framework.is_training()) else None,
-            causal=causal,
-        )
+        if core is not None:
+            from paddle_tpu.core.enforce import enforce
+
+            enforce(
+                mask is None and (dropout_rate == 0.0 or not pt.framework.is_training()),
+                "multi_head_attention: a custom attention core supports neither "
+                "an additive mask nor attention dropout — got "
+                f"mask={'set' if mask is not None else None}, dropout_rate={dropout_rate}",
+            )
+            ctx = core(qh, kh, vh)
+        else:
+            ctx = oattn.scaled_dot_product_attention(
+                qh, kh, vh, mask=mask, dropout_rate=dropout_rate,
+                is_test=not pt.framework.is_training(),
+                dropout_key=pt.framework.next_rng_key() if (dropout_rate > 0 and pt.framework.is_training()) else None,
+                causal=causal,
+            )
         out = oattn.combine_heads(ctx)
         return _proj(out, d_model, shard_out=False, name="out")
 

@@ -38,11 +38,24 @@ from paddle_tpu.models.transformer import (
 __all__ = ["get_model", "lm_forward", "BASE_CFG"]
 
 
+def _ring_core(ring_mesh):
+    """Attention core for sequence-parallel long context: exact causal
+    attention over the seq-sharded global sequence via the ring
+    (``ops/ring_attention.py``) instead of XLA's all-gather lowering."""
+    from paddle_tpu.ops.ring_attention import ring_attention_sharded
+
+    return lambda qh, kh, vh: ring_attention_sharded(
+        qh, kh, vh, ring_mesh, causal=True
+    )
+
+
 def lm_block(x, cfg, name):
+    ring_mesh = cfg.get("ring_mesh")
     with name_scope(name):
         attn = multi_head_attention(
             x, x, x, cfg["d_model"], cfg["num_heads"],
             dropout_rate=cfg["attn_dropout"], causal=True, name="self_attn",
+            core=_ring_core(ring_mesh) if ring_mesh is not None else None,
         )
         x = _post_process(x, attn, cfg["residual_dropout"])
         ffn = positionwise_ffn(x, cfg["d_inner"], cfg["d_model"], cfg["relu_dropout"])
@@ -82,10 +95,17 @@ BASE_CFG = dict(
 )
 
 
-def get_model(seq_len: int = 1024, learning_rate: float = 1e-3, **overrides) -> ModelSpec:
+def get_model(
+    seq_len: int = 1024, learning_rate: float = 1e-3, ring_mesh=None, **overrides
+) -> ModelSpec:
+    """``ring_mesh``: a Mesh with a ``seq`` axis → attention runs as ring
+    attention over it (sequence-parallel exact attention; batch tokens must
+    be fed sharded [data, seq])."""
     cfg = dict(BASE_CFG)
     cfg.update({k: v for k, v in overrides.items() if k in cfg})
     cfg["max_len"] = max(cfg["max_len"], seq_len)
+    if ring_mesh is not None:
+        cfg["ring_mesh"] = ring_mesh
 
     model = pt.build(functools.partial(lm_forward, cfg=cfg), name="transformer_lm")
 

@@ -263,11 +263,17 @@ def ring_attention_sharded(
     axis: str = mesh_mod.SEQ_AXIS,
     causal: bool = False,
     use_flash: Optional[bool] = None,
+    batch_axis: Optional[str] = mesh_mod.DATA_AXIS,
 ) -> jax.Array:
     """Convenience wrapper: q/k/v are GLOBAL [B, H, T, d] arrays; shards the
-    T dim over ``axis``, runs :func:`ring_attention` under shard_map, and
-    returns the global result."""
-    spec = P(None, None, axis, None)
+    T dim over ``axis`` (and the batch dim over ``batch_axis`` when the mesh
+    has it — each data group then rings only its own batch shard instead of
+    all-gathering and redundantly computing the full batch), runs
+    :func:`ring_attention` under shard_map, and returns the global result."""
+    b_axis = batch_axis if (batch_axis and batch_axis in mesh.axis_names) else None
+    if b_axis is not None and q.shape[0] % mesh.shape[b_axis] != 0:
+        b_axis = None  # batch not divisible: replicate it instead
+    spec = P(b_axis, None, axis, None)
     return shard_map(
         partial(ring_attention, axis=axis, causal=causal, use_flash=use_flash),
         mesh=mesh,
