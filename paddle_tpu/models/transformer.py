@@ -86,10 +86,16 @@ def multi_head_attention(
             from paddle_tpu.core.enforce import enforce
 
             enforce(
-                mask is None and (dropout_rate == 0.0 or not pt.framework.is_training()),
+                mask is None
+                and cache is None
+                and (dropout_rate == 0.0 or not pt.framework.is_training()),
                 "multi_head_attention: a custom attention core supports neither "
-                "an additive mask nor attention dropout — got "
-                f"mask={'set' if mask is not None else None}, dropout_rate={dropout_rate}",
+                "an additive mask, nor a decode-time k/v cache (the core "
+                "assumes q and k share global sequence alignment), nor "
+                "attention dropout — got "
+                f"mask={'set' if mask is not None else None}, "
+                f"cache={'set' if cache is not None else None}, "
+                f"dropout_rate={dropout_rate}",
             )
             ctx = core(qh, kh, vh)
         else:

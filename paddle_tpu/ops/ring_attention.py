@@ -272,7 +272,15 @@ def ring_attention_sharded(
     :func:`ring_attention` under shard_map, and returns the global result."""
     b_axis = batch_axis if (batch_axis and batch_axis in mesh.axis_names) else None
     if b_axis is not None and q.shape[0] % mesh.shape[b_axis] != 0:
-        b_axis = None  # batch not divisible: replicate it instead
+        from paddle_tpu.core import logging as ptlog
+
+        ptlog.warning(
+            "ring_attention_sharded: batch %d not divisible by mesh axis "
+            "%r (size %d) — replicating the batch across it (%dx redundant "
+            "attention compute); pad the batch to restore data parallelism",
+            q.shape[0], b_axis, mesh.shape[b_axis], mesh.shape[b_axis],
+        )
+        b_axis = None
     spec = P(b_axis, None, axis, None)
     return shard_map(
         partial(ring_attention, axis=axis, causal=causal, use_flash=use_flash),

@@ -17,6 +17,8 @@ is replaced by multi-host mesh initialization (see
 from __future__ import annotations
 
 import os
+import signal
+import threading
 from typing import Any, Callable, Iterable, Optional, Sequence, Tuple
 
 import jax
@@ -100,6 +102,11 @@ class Trainer:
         self.epoch = 0
         self.global_step = 0
         self._last_saved_step = -1
+        # preemption-aware save (SURVEY §5.3): SIGTERM during train() is
+        # caught at the next step boundary → checkpoint + clean return.
+        # True after train() returned early because of a signal.
+        self.preempted = False
+        self._preempt_requested = False
 
     # -- init / resume ------------------------------------------------------
     def _ensure_initialized(self, first_batch: Sequence[Any]):
@@ -167,28 +174,81 @@ class Trainer:
         trainer.py:404,541)."""
         enforce(reader is not None, "Trainer.train needs a batched reader")
         handler = event_handler or (lambda event: None)
+        # a Trainer may be re-entered after a preempted run (in-process
+        # resume): stale flags must not end the new loop after one step
+        self.preempted = False
+        self._preempt_requested = False
         # initialize (and auto-resume) BEFORE choosing the start epoch, so a
         # fresh Trainer with a checkpoint on disk skips completed epochs
         if self.variables is None:
             first = next(iter(reader()), None)
             enforce(first is not None, "reader yielded no batches")
             self._ensure_initialized(first)
-        for epoch_id in range(self.epoch, num_epochs):
-            self.epoch = epoch_id
-            handler(BeginEpochEvent(epoch_id))
-            for step_id, batch in enumerate(reader()):
-                begin_ev = BeginStepEvent(epoch_id, step_id)
-                handler(begin_ev)
-                out = self._run_step(batch)
-                self.variables, self.opt_state = out.variables, out.opt_state
-                self.global_step += 1
-                # honoring fetch_metrics avoids a host sync per step
-                # (reference BeginStepEvent.fetch_metrics, trainer.py:158)
-                metrics = float(out.loss) if begin_ev.fetch_metrics else None
-                handler(EndStepEvent(epoch_id, step_id, metrics))
-                self._maybe_checkpoint(epoch_id, step=True)
-            handler(EndEpochEvent(epoch_id))
-            self._maybe_checkpoint(epoch_id, step=False)
+        prev_handlers = self._install_preemption_handlers()
+        try:
+            for epoch_id in range(self.epoch, num_epochs):
+                self.epoch = epoch_id
+                handler(BeginEpochEvent(epoch_id))
+                for step_id, batch in enumerate(reader()):
+                    begin_ev = BeginStepEvent(epoch_id, step_id)
+                    handler(begin_ev)
+                    out = self._run_step(batch)
+                    self.variables, self.opt_state = out.variables, out.opt_state
+                    self.global_step += 1
+                    # honoring fetch_metrics avoids a host sync per step
+                    # (reference BeginStepEvent.fetch_metrics, trainer.py:158)
+                    metrics = float(out.loss) if begin_ev.fetch_metrics else None
+                    handler(EndStepEvent(epoch_id, step_id, metrics))
+                    if self._preempt_requested:
+                        self._preemption_save()
+                        return
+                    self._maybe_checkpoint(epoch_id, step=True)
+                handler(EndEpochEvent(epoch_id))
+                self._maybe_checkpoint(epoch_id, step=False)
+                if self._preempt_requested:
+                    self._preemption_save()
+                    return
+        finally:
+            self._restore_signal_handlers(prev_handlers)
+
+    # -- preemption (SURVEY §5.3 failure detection / recovery) --------------
+    def _install_preemption_handlers(self):
+        """Catch SIGTERM (the cluster-preemption signal) during the loop;
+        the actual save happens at the next step boundary, where params are
+        a consistent, fully-materialized tree. Main thread only — signal
+        handlers cannot be installed elsewhere."""
+        if threading.current_thread() is not threading.main_thread():
+            return None
+
+        def on_signal(signum, frame):
+            self._preempt_requested = True
+            ptlog.vlog(0, "signal %d: checkpoint at next step boundary", signum)
+
+        prev = {}
+        for sig in (signal.SIGTERM,):
+            try:
+                prev[sig] = signal.signal(sig, on_signal)
+            except (ValueError, OSError):  # non-main interpreter contexts
+                pass
+        return prev
+
+    def _restore_signal_handlers(self, prev):
+        if not prev:
+            return
+        for sig, old in prev.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):
+                pass
+
+    def _preemption_save(self):
+        """Mid-epoch emergency save: the interrupted epoch restarts on
+        resume (next_epoch = current epoch), matching the reference's
+        mid-epoch checkpoint semantics."""
+        self.preempted = True
+        if self.checkpoint_cfg is not None and self.global_step != self._last_saved_step:
+            self._save_checkpoint({"next_epoch": self.epoch, "preempted": True})
+        ptlog.vlog(0, "preempted: saved at epoch %d step %d", self.epoch, self.global_step)
 
     def _run_step(self, batch) -> StepOutput:
         if self.parallel:
@@ -211,10 +271,9 @@ class Trainer:
         # if a step save already captured this state, don't save a duplicate
         # serial — but an epoch boundary must still bump next_epoch in the
         # metadata so resume skips the completed epoch
-        sharded = cfg.use_sharded()
         if self.global_step == self._last_saved_step:
             if not step:
-                if sharded:
+                if cfg.use_sharded():
                     from paddle_tpu import checkpoint_sharded as cks
 
                     cks.update_manifest(cfg.checkpoint_dir, {"next_epoch": self.epoch + 1})
@@ -223,7 +282,12 @@ class Trainer:
                         cfg.checkpoint_dir, {"next_epoch": self.epoch + 1}
                     )
             return
-        if sharded:
+        self._save_checkpoint({"next_epoch": self.epoch + (0 if step else 1)})
+
+    def _save_checkpoint(self, extra_meta: dict):
+        """Shared sharded/unsharded checkpoint dispatch."""
+        cfg = self.checkpoint_cfg
+        if cfg.use_sharded():
             from paddle_tpu import checkpoint_sharded as cks
 
             cks.save_sharded(
@@ -232,7 +296,7 @@ class Trainer:
                 step=self.global_step,
                 epoch=self.epoch,
                 max_num_checkpoints=cfg.max_num_checkpoints,
-                extra_meta={"next_epoch": self.epoch + (0 if step else 1)},
+                extra_meta=extra_meta,
             )
         else:
             ckpt_mod.save_checkpoint(
@@ -242,7 +306,7 @@ class Trainer:
                 epoch=self.epoch,
                 max_num_checkpoints=cfg.max_num_checkpoints,
                 trainer_id=self.trainer_id,
-                extra_meta={"next_epoch": self.epoch + (0 if step else 1)},
+                extra_meta=extra_meta,
             )
         self._last_saved_step = self.global_step
 
