@@ -35,7 +35,7 @@ from paddle_tpu.models.transformer import (
     prepare_embedding,
 )
 
-__all__ = ["get_model", "lm_forward", "BASE_CFG"]
+__all__ = ["get_model", "lm_forward", "generate", "BASE_CFG"]
 
 
 def _ring_core(ring_mesh):
@@ -80,6 +80,140 @@ def lm_forward(ids, labels, *, cfg):
     nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     n_tok = float(np.prod(labels.shape))
     return jnp.mean(nll), n_tok, logits
+
+
+def generate(
+    variables,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    cfg: dict,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """Autoregressive decode with a static k/v cache — prefill once over the
+    prompt, then one ``lax.scan`` step per new token (single compile, no
+    shape growth; the TPU-idiomatic replacement for the reference's
+    per-step re-run of a decode program). Returns [B, max_new_tokens] int32.
+
+    Implemented directly over the trained params dict (names as created by
+    :func:`lm_forward`) so the decode loop is a plain jittable function —
+    greedy at ``temperature=0``, else softmax sampling with ``rng``
+    (required then). Deliberately NOT built on ``lm_block``: a scan-stepped
+    static cache can't use ``multi_head_attention``'s shape-growing
+    concatenate cache, and re-entering ``name_scope``s inside a scan body
+    would re-uniquify parameter names. The decode math is pinned to
+    ``lm_forward`` by ``test_transformer_lm_generate_matches_naive_decode``
+    — change one, and that exact-match test catches the drift.
+    """
+    from paddle_tpu.core.enforce import enforce
+    from paddle_tpu.models.transformer import sinusoid_position_encoding
+
+    params = variables.params if hasattr(variables, "params") else variables
+    B, Tp = prompt.shape
+    T_max = Tp + max_new_tokens
+    D, H, L = cfg["d_model"], cfg["num_heads"], cfg["n_layers"]
+    dh = D // H
+    enforce(max_new_tokens >= 1, f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    enforce(
+        temperature == 0.0 or rng is not None,
+        "generate: sampling (temperature > 0) needs an explicit rng key — "
+        "a silent fixed default would return identical 'samples' every call",
+    )
+    pe = sinusoid_position_encoding(max(cfg["max_len"], T_max), D)
+    scale = 1.0 / np.sqrt(dh)
+
+    def p(name):
+        return params[name]
+
+    def ln(x, pfx):
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.mean((x - mu) ** 2, -1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-5) * p(f"{pfx}/scale") + p(f"{pfx}/bias")
+
+    def proj(x, pfx, bias=True):
+        out = x @ p(f"{pfx}/w")
+        return out + p(f"{pfx}/b") if bias else out
+
+    def heads(x):  # [B, T, D] -> [B, H, T, dh]
+        return x.reshape(x.shape[0], x.shape[1], H, dh).transpose(0, 2, 1, 3)
+
+    def embed(ids, pos0):
+        e = jnp.take(p("emb/embedding/word_emb"), ids, axis=0) * (D ** 0.5)
+        t = ids.shape[1]
+        return e + jax.lax.dynamic_slice_in_dim(pe, pos0, t, axis=0)
+
+    def block(x, i, attend):
+        pfx = f"layer_{i}/self_attn"
+        q = heads(proj(x, f"{pfx}/q"))
+        k = heads(proj(x, f"{pfx}/k"))
+        v = heads(proj(x, f"{pfx}/v"))
+        ctx = attend(q, k, v, i)  # [B, H, Tq, dh]
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], D)
+        x = ln(x + proj(ctx, f"{pfx}/out"), f"layer_{i}/layer_norm")
+        h = jax.nn.relu(proj(x, f"layer_{i}/ffn/fc1"))
+        return ln(x + proj(h, f"layer_{i}/ffn/fc2"), f"layer_{i}/layer_norm_1")
+
+    def logits_of(x_last):  # [B, D] -> [B, vocab]
+        return ln(x_last, "layer_norm") @ p("project/logits/w")
+
+    def sample(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, -1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature).astype(jnp.int32)
+
+    # ---- prefill: full causal pass over the prompt fills caches [0, Tp)
+    kc0 = jnp.zeros((L, B, H, T_max, dh), jnp.float32)
+    vc0 = jnp.zeros((L, B, H, T_max, dh), jnp.float32)
+    caches = {"k": kc0, "v": vc0}
+
+    def prefill_attend(q, k, v, i):
+        caches["k"] = caches["k"].at[i, :, :, :Tp].set(k)
+        caches["v"] = caches["v"].at[i, :, :, :Tp].set(v)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        mask = jnp.tril(jnp.ones((Tp, Tp), bool))
+        s = jnp.where(mask, s, -1e9)
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+
+    x = embed(prompt, 0)
+    for i in range(L):
+        x = block(x, i, prefill_attend)
+    first_key, scan_rng = (
+        jax.random.split(rng) if rng is not None else (None, None)
+    )
+    first_tok = sample(logits_of(x[:, -1]), first_key)
+
+    # ---- decode: one token per scan step against the cache
+    def step(carry, s):
+        tok, kc, vc, key = carry
+        t = Tp + s  # position of this token
+        xt = embed(tok[:, None], t)  # [B, 1, D] — pos0 is traced; ok for slice
+
+        def attend(q, k, v, i):
+            nonlocal kc, vc
+            kc = jax.lax.dynamic_update_slice(kc, k[None], (i, 0, 0, t, 0))
+            vc = jax.lax.dynamic_update_slice(vc, v[None], (i, 0, 0, t, 0))
+            s_ = jnp.einsum("bhqd,bhkd->bhqk", q, kc[i]) * scale
+            live = jnp.arange(T_max) <= t
+            s_ = jnp.where(live[None, None, None, :], s_, -1e9)
+            return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s_, -1), vc[i])
+
+        y = xt
+        for i in range(L):
+            y = block(y, i, attend)
+        if key is not None:
+            key, sub = jax.random.split(key)
+        else:
+            sub = None
+        nxt = sample(logits_of(y[:, -1]), sub)
+        return (nxt, kc, vc, key), tok
+
+    if max_new_tokens == 1:
+        return first_tok[:, None]
+    carry = (first_tok, caches["k"], caches["v"], scan_rng)
+    (last_tok, _, _, _), toks = jax.lax.scan(
+        step, carry, jnp.arange(max_new_tokens - 1)
+    )
+    return jnp.concatenate([toks.transpose(1, 0), last_tok[:, None]], axis=1)
 
 
 BASE_CFG = dict(

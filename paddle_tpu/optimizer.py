@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from paddle_tpu import lr_scheduler as lrs
 from paddle_tpu import regularizer as reg_mod
+from paddle_tpu.core.enforce import enforce
 from paddle_tpu.framework import Model, ParamInfo, Variables
 
 
@@ -98,6 +99,7 @@ class Optimizer:
         model: Model,
         loss_index: int = 0,
         axis_name: Optional[str] = None,
+        accum_steps: int = 1,
     ) -> Callable:
         """Build the full train-step function (the analogue of
         fluid ``optimizer.minimize(avg_cost)`` + Executor.run of the
@@ -111,18 +113,26 @@ class Optimizer:
         AllReduceOpHandle + ScaleLossGradOpHandle pair
         (``details/all_reduce_op_handle.cc:48``,
         ``scale_loss_grad_op_handle.cc:63``).
+
+        ``accum_steps > 1`` splits each batch arg's leading dim into that
+        many microbatches and accumulates gradients over a ``lax.scan``
+        before the single optimizer update — activation memory then scales
+        with the microbatch, letting a fixed HBM train a larger effective
+        batch. Equivalent to the full-batch step for mean losses; model
+        state (BN stats) threads through microbatches sequentially.
+        ``outputs`` carries a leading [accum_steps] dim.
         """
         param_info = model.param_info
 
-        def step_fn(variables: Variables, opt_state: OptState, *batch, rng=None):
-            params, state = variables.params, variables.state
-
+        def grad_of(params, state, batch, rng):
             def loss_fn(p):
                 out, new_state = model.apply(Variables(p, state), *batch, rng=rng, is_train=True)
                 loss = out[loss_index] if isinstance(out, (tuple, list)) else out
                 return jnp.mean(loss.astype(jnp.float32)), (new_state, out)
 
-            (loss, (new_state, outputs)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+        def finish(params, state, opt_state, loss, new_state, grads, outputs):
             if axis_name is not None:
                 grads = jax.lax.pmean(grads, axis_name)
                 loss = jax.lax.pmean(loss, axis_name)
@@ -135,7 +145,52 @@ class Optimizer:
             new_params, new_opt = self.apply_gradients(params, grads, opt_state, info)
             return StepOutput(Variables(new_params, new_state), new_opt, loss, outputs)
 
-        return step_fn
+        def step_fn(variables: Variables, opt_state: OptState, *batch, rng=None):
+            params, state = variables.params, variables.state
+            (loss, (new_state, outputs)), grads = grad_of(params, state, batch, rng)
+            return finish(params, state, opt_state, loss, new_state, grads, outputs)
+
+        if accum_steps == 1:
+            return step_fn
+
+        enforce(accum_steps > 1, f"accum_steps must be >= 1, got {accum_steps}")
+
+        def accum_step_fn(variables: Variables, opt_state: OptState, *batch, rng=None):
+            params, state = variables.params, variables.state
+            n = accum_steps
+            micro = []
+            for b in batch:
+                b = jnp.asarray(b)
+                enforce(
+                    b.shape[0] % n == 0,
+                    f"batch dim {b.shape[0]} not divisible by accum_steps {n}",
+                )
+                micro.append(b.reshape((n, b.shape[0] // n) + b.shape[1:]))
+            keys = jax.random.split(rng, n) if rng is not None else None
+
+            def body(carry, xs):
+                st, gacc, lacc = carry
+                if rng is not None:
+                    mb, key = xs
+                else:
+                    mb, key = xs, None
+                (loss, (new_st, out)), grads = grad_of(params, st, mb, key)
+                gacc = jax.tree_util.tree_map(jnp.add, gacc, grads)
+                return (new_st, gacc, lacc + loss), out
+
+            init = (
+                state,
+                jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+                jnp.zeros((), jnp.float32),
+            )
+            xs = (tuple(micro), keys) if rng is not None else tuple(micro)
+            (new_state, gsum, lsum), outputs = jax.lax.scan(body, init, xs)
+            grads = jax.tree_util.tree_map(
+                lambda g, p: (g / n).astype(p.dtype), gsum, params
+            )
+            return finish(params, state, opt_state, lsum / n, new_state, grads, outputs)
+
+        return accum_step_fn
 
 
 class SGD(Optimizer):

@@ -200,13 +200,14 @@ class Trainer:
                     metrics = float(out.loss) if begin_ev.fetch_metrics else None
                     handler(EndStepEvent(epoch_id, step_id, metrics))
                     if self._preempt_requested:
-                        self._preemption_save()
+                        self._preemption_save(next_epoch=epoch_id)
                         return
                     self._maybe_checkpoint(epoch_id, step=True)
                 handler(EndEpochEvent(epoch_id))
                 self._maybe_checkpoint(epoch_id, step=False)
                 if self._preempt_requested:
-                    self._preemption_save()
+                    # the epoch just COMPLETED — resume must not re-train it
+                    self._preemption_save(next_epoch=epoch_id + 1)
                     return
         finally:
             self._restore_signal_handlers(prev_handlers)
@@ -241,14 +242,21 @@ class Trainer:
             except (ValueError, OSError):
                 pass
 
-    def _preemption_save(self):
-        """Mid-epoch emergency save: the interrupted epoch restarts on
-        resume (next_epoch = current epoch), matching the reference's
-        mid-epoch checkpoint semantics."""
+    def _preemption_save(self, next_epoch: int):
+        """Emergency save on preemption. ``next_epoch`` is the epoch resume
+        should start at: the interrupted epoch for a mid-epoch save (it
+        restarts, matching the reference's mid-epoch checkpoint semantics),
+        epoch+1 when the signal landed on a completed epoch boundary."""
         self.preempted = True
         if self.checkpoint_cfg is not None and self.global_step != self._last_saved_step:
-            self._save_checkpoint({"next_epoch": self.epoch, "preempted": True})
-        ptlog.vlog(0, "preempted: saved at epoch %d step %d", self.epoch, self.global_step)
+            self._save_checkpoint({"next_epoch": next_epoch, "preempted": True})
+            ptlog.vlog(0, "preempted: saved at epoch %d step %d", self.epoch, self.global_step)
+        else:
+            ptlog.vlog(
+                0, "preempted at epoch %d step %d (no new checkpoint: %s)",
+                self.epoch, self.global_step,
+                "none configured" if self.checkpoint_cfg is None else "state already saved",
+            )
 
     def _run_step(self, batch) -> StepOutput:
         if self.parallel:
