@@ -299,3 +299,16 @@ def test_cpp_train_demo(tmp_path, rng):
     ]
     assert len(losses) == 30
     assert losses[-1] < losses[0] * 0.9, losses[:3] + losses[-3:]
+
+
+def test_cpp_unit_tests():
+    """The cc_test-style native unit suite (csrc/native_test.cc) passes —
+    reference idiom: co-located C++ tests (framework/lod_tensor_test.cc)."""
+    import subprocess
+
+    r = subprocess.run(
+        ["make", "-C", os.path.join(os.path.dirname(__file__), "..", "csrc"), "test"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, (r.stdout[-800:], r.stderr[-800:])
+    assert "ALL NATIVE TESTS PASS" in r.stdout
