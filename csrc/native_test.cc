@@ -119,10 +119,12 @@ void test_dynamic_slice_update() {
 }
 
 void test_bf16_round() {
-  // 1.0 survives exactly; 1 + 2^-9 rounds to nearest bf16
+  // 1.0 survives exactly; 1 + 2^-9 is BELOW the half-step (2^-8 at 1.0),
+  // so round-to-nearest must come back down to exactly 1.0
   CHECK_NEAR(ptnative::f32_to_bf16_rn(1.0f), 1.0f, 0);
-  float r = ptnative::f32_to_bf16_rn(1.001953125f);  // 1 + 2^-9
-  CHECK_TRUE(r == 1.0f || r == 1.0078125f);  // ties-to-even: one of the two
+  CHECK_NEAR(ptnative::f32_to_bf16_rn(1.001953125f), 1.0f, 0);
+  // a true tie (1 + 2^-8) rounds to even mantissa -> 1.0
+  CHECK_NEAR(ptnative::f32_to_bf16_rn(1.00390625f), 1.0f, 0);
   CHECK_NEAR(ptnative::f32_to_bf16_rn(3.14159f), 3.140625f, 1e-6);
   // NaN stays NaN
   CHECK_TRUE(std::isnan(ptnative::f32_to_bf16_rn(std::nanf(""))));

@@ -26,12 +26,13 @@ namespace ptnative {
 // I32/I64 make integer programs (embedding lookups, argmax pipelines)
 // representable. The dtype tag governs disk format and convert semantics,
 // not the in-memory compute type.
-enum class DType { F32 = 0, BF16 = 1, I32 = 2, I64 = 3 };
+enum class DType { F32 = 0, BF16 = 1, I32 = 2, I64 = 3, I8 = 4 };
 
 inline size_t dtype_bytes(DType t) {
   switch (t) {
     case DType::BF16: return 2;
     case DType::I64: return 8;
+    case DType::I8: return 1;
     default: return 4;
   }
 }

@@ -74,6 +74,7 @@ static ptnative::DType parse_dtype(const std::string& s) {
   if (s == "bf16") return ptnative::DType::BF16;
   if (s == "i32") return ptnative::DType::I32;
   if (s == "i64") return ptnative::DType::I64;
+  if (s == "i8") return ptnative::DType::I8;
   return ptnative::DType::F32;
 }
 
@@ -151,6 +152,10 @@ static std::unique_ptr<Program> load_program(const std::string& dir) {
             std::memcpy(&x, src + i * 8, 8);
             arr.data[i] = static_cast<float>(x);
           }
+          break;
+        case ptnative::DType::I8:  // int8 quantized weights: exact in f32
+          for (int64_t i = 0; i < n; ++i)
+            arr.data[i] = static_cast<float>(static_cast<signed char>(src[i]));
           break;
       }
       prog->consts.emplace(id, std::move(arr));

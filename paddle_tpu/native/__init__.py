@@ -25,19 +25,33 @@ _LIB_PATH = os.path.join(_CSRC, "build", "libpaddle_tpu_native.so")
 _lib = None
 
 
+def _stale() -> bool:
+    """True when the .so is missing or older than any csrc source — a stale
+    binary must never parse artifacts written by a newer exporter (e.g. the
+    i8 storage dtype would silently misread as f32)."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    built = os.path.getmtime(_LIB_PATH)
+    for fn in os.listdir(_CSRC):
+        if fn.endswith((".cc", ".h")) or fn == "Makefile":
+            if os.path.getmtime(os.path.join(_CSRC, fn)) > built:
+                return True
+    return False
+
+
 def lib() -> ctypes.CDLL:
-    """Load (building if needed) the native library."""
+    """Load (building/rebuilding if needed) the native library."""
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH):
+    if _stale():
         # file lock: concurrent importers (multi-host trainers, parallel
         # tests) must not race make and dlopen a half-written .so
         lock_path = os.path.join(_CSRC, ".build.lock")
         with open(lock_path, "w") as lock_f:
             fcntl.flock(lock_f, fcntl.LOCK_EX)
             try:
-                if not os.path.exists(_LIB_PATH):
+                if _stale():
                     subprocess.run(["make", "-C", _CSRC], check=True, capture_output=True)
             finally:
                 fcntl.flock(lock_f, fcntl.LOCK_UN)

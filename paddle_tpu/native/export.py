@@ -62,6 +62,8 @@ def _storage_dtype(arr: np.ndarray):
     if np.issubdtype(arr.dtype, np.integer) or arr.dtype == np.bool_:
         if arr.dtype == np.int64:
             return "i64", arr.astype(np.int64).tobytes()
+        if arr.dtype == np.int8:
+            return "i8", arr.tobytes()
         return "i32", arr.astype(np.int32).tobytes()
     return "f32", arr.astype(np.float32).tobytes()
 
@@ -431,12 +433,70 @@ def export_train_step(
         f.write(f"n_params {n}\n")
 
 
-def save_native_model(model, variables, example_inputs: Sequence, out_dir: str) -> None:
-    """save_inference_model-style convenience: bake ``variables`` into the
-    program as constants and export ``model.apply`` in eval mode."""
+def _as_params_state(variables):
+    """Normalize Variables | (params, state) tuple | bare params dict."""
+    if hasattr(variables, "params"):
+        return variables.params, getattr(variables, "state", {}) or {}
+    if isinstance(variables, tuple) and len(variables) == 2:
+        return variables[0], variables[1] or {}
+    return variables, {}
 
-    def predict(*inputs):
-        out, _ = model.apply(variables, *inputs, is_train=False)
-        return out
+
+def quantize_variables_int8(params: dict, min_size: int = 64):
+    """Post-training weight-only int8 quantization (reference
+    ``contrib/quantize`` / ``transpiler`` int8 story, serving-side):
+    per-output-channel symmetric absmax scales for every float (incl.
+    bf16) weight of rank >= 2 with >= ``min_size`` elements; biases/norm
+    params stay as-is. Returns ``(qparams, scales)`` where qparams maps
+    name -> int8 ndarray or the original array, and scales maps quantized
+    names -> f32 scale vector (one per output channel, the trailing
+    axis)."""
+    qparams, scales = {}, {}
+    for name, w in params.items():
+        arr = np.asarray(w)
+        is_float = arr.dtype.kind == "f" or str(arr.dtype) == "bfloat16"
+        if is_float and arr.dtype.kind != "f":
+            arr = arr.astype(np.float32)  # bf16 → f32 before quantizing
+        if arr.ndim >= 2 and arr.size >= min_size and is_float:
+            absmax = np.max(np.abs(arr), axis=tuple(range(arr.ndim - 1)), keepdims=True)
+            scale = (absmax / 127.0 + 1e-12).astype(np.float32)
+            q = np.clip(np.round(arr / scale), -127, 127).astype(np.int8)
+            qparams[name] = q
+            scales[name] = scale
+        else:
+            qparams[name] = arr
+    return qparams, scales
+
+
+def save_native_model(
+    model, variables, example_inputs: Sequence, out_dir: str,
+    quantize_int8: bool = False,
+) -> None:
+    """save_inference_model-style convenience: bake ``variables`` into the
+    program as constants and export ``model.apply`` in eval mode.
+
+    ``quantize_int8=True`` stores large float weights as int8 constants
+    with per-channel scales (~4x smaller weights.bin); dequantization
+    (cast + mul) is part of the traced program, so the C++ predictor needs
+    no special handling."""
+    import jax.numpy as jnp
+
+    params, state = _as_params_state(variables)
+
+    if quantize_int8:
+        qparams, scales = quantize_variables_int8(params)
+
+        def predict(*inputs):
+            deq = {
+                name: (jnp.asarray(q).astype(jnp.float32) * scales[name]
+                       if name in scales else jnp.asarray(q))
+                for name, q in qparams.items()
+            }
+            out, _ = model.apply((deq, state), *inputs, is_train=False)
+            return out
+    else:
+        def predict(*inputs):
+            out, _ = model.apply((params, state), *inputs, is_train=False)
+            return out
 
     export_program(predict, example_inputs, out_dir)

@@ -312,3 +312,31 @@ def test_cpp_unit_tests():
     )
     assert r.returncode == 0, (r.stdout[-800:], r.stderr[-800:])
     assert "ALL NATIVE TESTS PASS" in r.stdout
+
+
+def test_native_int8_quantized_export(tmp_path, rng):
+    """Weight-only int8 export: ~4x smaller artifact, close predictions,
+    same top-1 class on most rows (reference contrib/quantize serving
+    story, done TPU-style: dequant is part of the traced program)."""
+    def net(x):
+        h = pt.layers.fc(x, size=64, act="relu")
+        return pt.layers.fc(h, size=10)
+
+    model = pt.build(net)
+    x = rng.randn(16, 32).astype(np.float32)
+    variables = model.init(0, jnp.asarray(x))
+
+    d32, d8 = str(tmp_path / "f32"), str(tmp_path / "i8")
+    save_native_model(model, variables, [x], d32)
+    save_native_model(model, variables, [x], d8, quantize_int8=True)
+    s32 = os.path.getsize(os.path.join(d32, "weights.bin"))
+    s8 = os.path.getsize(os.path.join(d8, "weights.bin"))
+    assert s8 < s32 * 0.4, (s8, s32)
+
+    p32, p8 = NativePredictor(d32), NativePredictor(d8)
+    (o32,) = p32.run(x)
+    (o8,) = p8.run(x)
+    np.testing.assert_allclose(o8, o32, rtol=0.2, atol=0.15)
+    agree = np.mean(o8.argmax(1) == o32.argmax(1))
+    assert agree >= 0.8, agree
+    p32.close(); p8.close()
