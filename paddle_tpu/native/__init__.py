@@ -45,16 +45,29 @@ def lib() -> ctypes.CDLL:
     if _lib is not None:
         return _lib
     if _stale():
-        # file lock: concurrent importers (multi-host trainers, parallel
-        # tests) must not race make and dlopen a half-written .so
-        lock_path = os.path.join(_CSRC, ".build.lock")
-        with open(lock_path, "w") as lock_f:
-            fcntl.flock(lock_f, fcntl.LOCK_EX)
-            try:
-                if _stale():
-                    subprocess.run(["make", "-C", _CSRC], check=True, capture_output=True)
-            finally:
-                fcntl.flock(lock_f, fcntl.LOCK_UN)
+        try:
+            # file lock: concurrent importers (multi-host trainers, parallel
+            # tests) must not race make and dlopen a half-written .so
+            lock_path = os.path.join(_CSRC, ".build.lock")
+            with open(lock_path, "w") as lock_f:
+                fcntl.flock(lock_f, fcntl.LOCK_EX)
+                try:
+                    if _stale():
+                        subprocess.run(["make", "-C", _CSRC], check=True, capture_output=True)
+                finally:
+                    fcntl.flock(lock_f, fcntl.LOCK_UN)
+        except (OSError, subprocess.CalledProcessError) as e:
+            # read-only install / no toolchain: a prebuilt .so is usable
+            # even if mtimes look stale (archive extraction, branch switch)
+            if not os.path.exists(_LIB_PATH):
+                raise
+            import warnings
+
+            warnings.warn(
+                f"paddle_tpu.native: rebuild failed ({e}); loading existing "
+                f"{_LIB_PATH} — if csrc sources truly changed, artifacts "
+                "may mismatch the runtime"
+            )
     _lib = ctypes.CDLL(_LIB_PATH)
     # recordio
     _lib.pt_recordio_writer_open.restype = ctypes.c_void_p

@@ -455,9 +455,9 @@ def quantize_variables_int8(params: dict, min_size: int = 64):
     for name, w in params.items():
         arr = np.asarray(w)
         is_float = arr.dtype.kind == "f" or str(arr.dtype) == "bfloat16"
-        if is_float and arr.dtype.kind != "f":
-            arr = arr.astype(np.float32)  # bf16 → f32 before quantizing
         if arr.ndim >= 2 and arr.size >= min_size and is_float:
+            if arr.dtype.kind != "f":
+                arr = arr.astype(np.float32)  # bf16 → f32 only when quantizing
             absmax = np.max(np.abs(arr), axis=tuple(range(arr.ndim - 1)), keepdims=True)
             scale = (absmax / 127.0 + 1e-12).astype(np.float32)
             q = np.clip(np.round(arr / scale), -127, 127).astype(np.int8)

@@ -257,6 +257,84 @@ class Adam(Optimizer):
         return new_p, {"moment1": m1, "moment2": m2}
 
 
+class AdamW(Adam):
+    """Adam with DECOUPLED weight decay (Loshchilov & Hutter) — the decay
+    is applied to the parameter directly, scaled by the schedule, not fed
+    through the moments like an L2 regularizer. Post-parity extension (the
+    reference era predates AdamW); the standard for transformer training.
+    ``param_info.regularizer is None`` leaves biases/norms decayed too —
+    exclude them via ParamAttr(regularizer=...) conventions or
+    ``exclude_from_decay`` name substrings."""
+
+    def __init__(
+        self, learning_rate=0.001, beta1: float = 0.9, beta2: float = 0.999,
+        epsilon: float = 1e-8, weight_decay: float = 0.01,
+        exclude_from_decay: Tuple[str, ...] = ("b", "bias", "scale", "norm"),
+        **kw,
+    ):
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kw)
+        self.weight_decay = weight_decay
+        self.exclude_from_decay = tuple(exclude_from_decay)
+
+    def _decay_excluded(self, name: str) -> bool:
+        # match against the LEAF name only — scope components like
+        # 'block_0' must not trip substring tokens like 'b'
+        leaf = name.rsplit("/", 1)[-1]
+        return any(tok == leaf or (len(tok) > 1 and tok in leaf) for tok in self.exclude_from_decay)
+
+    def apply_gradients(self, params, grads, opt_state, param_info=None):
+        lr = self.scheduler(opt_state.step)  # pre-increment step, as base does
+        new_params, new_state = super().apply_gradients(params, grads, opt_state, param_info)
+        if not self.weight_decay:
+            return new_params, new_state
+        # decoupled decay as a post-pass against the PRE-update params:
+        # p_{t+1} = p_t - lr*adam(g) - lr*wd*p_t
+        for name, p in params.items():
+            info = param_info.get(name) if param_info else None
+            if info is not None and not info.trainable:
+                continue
+            if self._decay_excluded(name):
+                continue
+            p_lr = lr * (info.learning_rate if info is not None else 1.0)
+            new_params[name] = (
+                new_params[name].astype(jnp.float32)
+                - p_lr * self.weight_decay * p.astype(jnp.float32)
+            ).astype(p.dtype)
+        return new_params, new_state
+
+
+class Lamb(Optimizer):
+    """LAMB (You et al.) — layerwise adaptive moments for very large batch
+    training: the Adam update direction is rescaled per layer by
+    ||p|| / ||update||. Post-parity extension; pairs with
+    ``minimize(accum_steps=...)`` and data-parallel meshes."""
+
+    def __init__(
+        self, learning_rate=0.001, beta1: float = 0.9, beta2: float = 0.999,
+        epsilon: float = 1e-6, weight_decay: float = 0.01, **kw,
+    ):
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.weight_decay = weight_decay
+
+    def _slot_names(self):
+        return ("moment1", "moment2")
+
+    def _update(self, p, g, lr, slots, step):
+        t = (step + 1).astype(jnp.float32)
+        m1 = self.beta1 * slots["moment1"] + (1 - self.beta1) * g
+        m2 = self.beta2 * slots["moment2"] + (1 - self.beta2) * jnp.square(g)
+        m1_hat = m1 / (1 - self.beta1 ** t)
+        m2_hat = m2 / (1 - self.beta2 ** t)
+        update = m1_hat / (jnp.sqrt(m2_hat) + self.epsilon) + self.weight_decay * p
+        p_norm = jnp.linalg.norm(p)
+        u_norm = jnp.linalg.norm(update)
+        trust = jnp.where(
+            (p_norm > 0) & (u_norm > 0), p_norm / jnp.maximum(u_norm, 1e-12), 1.0
+        )
+        return p - lr * trust * update, {"moment1": m1, "moment2": m2}
+
+
 class Adamax(Optimizer):
     def __init__(self, learning_rate=0.001, beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8, **kw):
         super().__init__(learning_rate, **kw)

@@ -235,3 +235,62 @@ def test_minimize_accum_steps_with_rng_and_state(rng):
     assert np.isfinite(float(out.loss))
     # BN state advanced through both microbatches
     assert out.variables.state
+
+
+def test_adamw_decoupled_decay(rng):
+    """AdamW: decay hits weights (not biases/norm params) and is decoupled
+    — with weight_decay=0 it must equal plain Adam."""
+    import paddle_tpu as pt
+
+    def net(x, y):
+        h = pt.layers.fc(x, size=8, act="tanh")
+        return pt.layers.mean((pt.layers.fc(h, size=1)[:, 0] - y) ** 2)
+
+    model = pt.build(net)
+    x = rng.randn(8, 4).astype(np.float32)
+    y = rng.randn(8).astype(np.float32)
+    v = model.init(0, x, y)
+
+    adamw0 = pt.optimizer.AdamW(learning_rate=0.01, weight_decay=0.0)
+    adam = pt.optimizer.Adam(learning_rate=0.01)
+    o1 = jax.jit(adamw0.minimize(model))(v, adamw0.create_state(v.params), x, y)
+    o2 = jax.jit(adam.minimize(model))(v, adam.create_state(v.params), x, y)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(o1.variables.params),
+        jax.tree_util.tree_leaves(o2.variables.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+    # with decay: weight params differ from plain Adam by exactly lr*wd*p
+    adamw = pt.optimizer.AdamW(learning_rate=0.01, weight_decay=0.1)
+    o3 = jax.jit(adamw.minimize(model))(v, adamw.create_state(v.params), x, y)
+    for name in v.params:
+        a = np.asarray(o3.variables.params[name])
+        b = np.asarray(o2.variables.params[name])
+        p = np.asarray(v.params[name])
+        if any(t in name for t in ("bias", "/b", "scale", "norm")):
+            np.testing.assert_allclose(a, b, rtol=1e-6)
+        else:
+            np.testing.assert_allclose(a, b - 0.01 * 0.1 * p, rtol=1e-5, atol=1e-7)
+
+
+def test_lamb_trains_and_trust_ratio_finite(rng):
+    import paddle_tpu as pt
+
+    def net(x, y):
+        h = pt.layers.fc(x, size=8, act="tanh")
+        return pt.layers.mean((pt.layers.fc(h, size=1)[:, 0] - y) ** 2)
+
+    model = pt.build(net)
+    x = rng.randn(16, 4).astype(np.float32)
+    y = rng.randn(16).astype(np.float32)
+    v = model.init(0, x, y)
+    opt = pt.optimizer.Lamb(learning_rate=0.05, weight_decay=0.01)
+    o = opt.create_state(v.params)
+    step = jax.jit(opt.minimize(model))
+    losses = []
+    for _ in range(10):
+        out = step(v, o, x, y)
+        v, o = out.variables, out.opt_state
+        losses.append(float(out.loss))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
