@@ -63,10 +63,14 @@ def lib() -> ctypes.CDLL:
                 raise
             import warnings
 
+            detail = ""
+            stderr = getattr(e, "stderr", None)
+            if stderr:
+                detail = ": " + stderr.decode(errors="replace")[-500:]
             warnings.warn(
-                f"paddle_tpu.native: rebuild failed ({e}); loading existing "
-                f"{_LIB_PATH} — if csrc sources truly changed, artifacts "
-                "may mismatch the runtime"
+                f"paddle_tpu.native: rebuild failed ({e}{detail}); loading "
+                f"existing {_LIB_PATH} — if csrc sources truly changed, "
+                "artifacts may mismatch the runtime"
             )
     _lib = ctypes.CDLL(_LIB_PATH)
     # recordio

@@ -87,6 +87,9 @@ class Optimizer:
                 continue
             g = grads[name].astype(jnp.float32)
             p_lr = lr * (info.learning_rate if info is not None else 1.0)
+            # per-leaf context for subclasses needing name-aware updates
+            # (Lamb's decay/trust exclusions); set right before each call
+            self._current_param_name = name
             slot_view = {s: new_slots[s][name] for s in self._slot_names()}
             new_p, slot_out = self._update(p.astype(jnp.float32), g, p_lr, slot_view, opt_state.step)
             new_params[name] = new_p.astype(p.dtype)
@@ -257,14 +260,30 @@ class Adam(Optimizer):
         return new_p, {"moment1": m1, "moment2": m2}
 
 
+def _name_excluded(name: str, tokens: Tuple[str, ...]) -> bool:
+    """Decay-exclusion matching: tokens without '/' match the LEAF name
+    (exact, or substring for multi-char tokens) so scope components like
+    'block_0' can't trip the 'b' token; tokens containing '/' match
+    anywhere in the full scoped name for whole-scope exclusions."""
+    leaf = name.rsplit("/", 1)[-1]
+    for tok in tokens:
+        if "/" in tok:
+            if tok in name:
+                return True
+        elif tok == leaf or (len(tok) > 1 and tok in leaf):
+            return True
+    return False
+
+
 class AdamW(Adam):
     """Adam with DECOUPLED weight decay (Loshchilov & Hutter) — the decay
     is applied to the parameter directly, scaled by the schedule, not fed
     through the moments like an L2 regularizer. Post-parity extension (the
     reference era predates AdamW); the standard for transformer training.
-    ``param_info.regularizer is None`` leaves biases/norms decayed too —
-    exclude them via ParamAttr(regularizer=...) conventions or
-    ``exclude_from_decay`` name substrings."""
+    ``exclude_from_decay`` controls which params skip decay: tokens
+    without '/' match the leaf parameter name (so the defaults exempt
+    biases and norm scales), tokens with '/' match anywhere in the scoped
+    name (whole-scope exclusion)."""
 
     def __init__(
         self, learning_rate=0.001, beta1: float = 0.9, beta2: float = 0.999,
@@ -277,10 +296,7 @@ class AdamW(Adam):
         self.exclude_from_decay = tuple(exclude_from_decay)
 
     def _decay_excluded(self, name: str) -> bool:
-        # match against the LEAF name only — scope components like
-        # 'block_0' must not trip substring tokens like 'b'
-        leaf = name.rsplit("/", 1)[-1]
-        return any(tok == leaf or (len(tok) > 1 and tok in leaf) for tok in self.exclude_from_decay)
+        return _name_excluded(name, self.exclude_from_decay)
 
     def apply_gradients(self, params, grads, opt_state, param_info=None):
         lr = self.scheduler(opt_state.step)  # pre-increment step, as base does
@@ -311,11 +327,14 @@ class Lamb(Optimizer):
 
     def __init__(
         self, learning_rate=0.001, beta1: float = 0.9, beta2: float = 0.999,
-        epsilon: float = 1e-6, weight_decay: float = 0.01, **kw,
+        epsilon: float = 1e-6, weight_decay: float = 0.01,
+        exclude_from_decay: Tuple[str, ...] = ("b", "bias", "scale", "norm"),
+        **kw,
     ):
         super().__init__(learning_rate, **kw)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.weight_decay = weight_decay
+        self.exclude_from_decay = tuple(exclude_from_decay)
 
     def _slot_names(self):
         return ("moment1", "moment2")
@@ -326,7 +345,15 @@ class Lamb(Optimizer):
         m2 = self.beta2 * slots["moment2"] + (1 - self.beta2) * jnp.square(g)
         m1_hat = m1 / (1 - self.beta1 ** t)
         m2_hat = m2 / (1 - self.beta2 ** t)
-        update = m1_hat / (jnp.sqrt(m2_hat) + self.epsilon) + self.weight_decay * p
+        # biases/norm params: no decay and trust=1 (LAMB paper / BERT
+        # reference masks) — they're tiny-norm and would be crushed
+        excluded = _name_excluded(
+            getattr(self, "_current_param_name", ""), self.exclude_from_decay
+        )
+        wd = 0.0 if excluded else self.weight_decay
+        update = m1_hat / (jnp.sqrt(m2_hat) + self.epsilon) + wd * p
+        if excluded:
+            return p - lr * update, {"moment1": m1, "moment2": m2}
         p_norm = jnp.linalg.norm(p)
         u_norm = jnp.linalg.norm(update)
         trust = jnp.where(

@@ -2,8 +2,8 @@
 ``python/paddle/fluid/tests/book/``: each config trains a few iterations on
 its dataset, asserts the loss decreases, and round-trips inference export.
 
-Mirrored configs: fit_a_line (uci_housing), recognize_digits (mnist — covered
-in test_models.py), word2vec (imikolov), recommender_system (movielens),
+Mirrored configs: fit_a_line (uci_housing), recognize_digits (mnist),
+image_classification (cifar10), word2vec (imikolov), recommender_system (movielens),
 label_semantic_roles (conll05 + CRF), rnn_encoder_decoder (wmt16),
 understand_sentiment (imdb LSTM)."""
 
@@ -12,7 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 
 import paddle_tpu as pt
-from paddle_tpu import dataset, reader
+from paddle_tpu import dataset, nets, reader
 
 
 def _train(model, opt, batches, rng_key=0):
@@ -280,3 +280,75 @@ def test_machine_translation_beam_decode_end_to_end(tmp_path):
     run, _ = io.load_inference_model(d)
     seqs2, scores2 = run(src, lens)
     np.testing.assert_array_equal(np.asarray(seqs), np.asarray(seqs2))
+
+
+def test_recognize_digits(tmp_path):
+    """Reference book/test_recognize_digits.py: mnist conv net, loss drops,
+    inference export round-trips."""
+    def net(img, label):
+        img = img.reshape(img.shape[0], 28, 28, 1)
+        conv = nets.simple_img_conv_pool(
+            img, num_filters=8, filter_size=3, pool_size=2, pool_stride=2, act="relu")
+        logits = pt.layers.fc(conv.reshape(img.shape[0], -1), size=10)
+        return pt.layers.softmax_with_cross_entropy(logits, label).mean()
+
+    model = pt.build(net)
+    r = reader.stack_batch(dataset.mnist.train(), 32)
+    batches = list(r())[:6]
+    variables, losses = _train(model, pt.optimizer.Adam(learning_rate=1e-3), batches)
+    assert losses[-1] < losses[0], losses
+
+    def infer(img):
+        img = img.reshape(img.shape[0], 28, 28, 1)
+        conv = nets.simple_img_conv_pool(
+            img, num_filters=8, filter_size=3, pool_size=2, pool_stride=2, act="relu")
+        return pt.layers.fc(conv.reshape(img.shape[0], -1), size=10)
+
+    infer_model = pt.build(infer)
+    img = batches[0][0]
+    out_dir = str(tmp_path / "digits")
+    pt.io.save_inference_model(out_dir, infer_model, variables, [img])
+    run, _ = pt.io.load_inference_model(out_dir)
+    np.testing.assert_allclose(
+        np.asarray(run(jnp.asarray(img))),
+        np.asarray(infer_model.apply(variables, jnp.asarray(img))[0]),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_image_classification(tmp_path):
+    """Reference book/test_image_classification.py: small vgg-style net on
+    cifar, loss drops, inference export round-trips."""
+    def net(img, label):
+        img = img.reshape(img.shape[0], 3, 32, 32).transpose(0, 2, 3, 1)
+        x = pt.layers.conv2d(img, num_filters=8, filter_size=3, padding=1, act="relu")
+        x = pt.layers.pool2d(x, pool_size=2, pool_stride=2)
+        x = pt.layers.conv2d(x, num_filters=16, filter_size=3, padding=1, act="relu")
+        x = pt.layers.pool2d(x, pool_size=2, pool_stride=2)
+        logits = pt.layers.fc(x.reshape(img.shape[0], -1), size=10)
+        return pt.layers.softmax_with_cross_entropy(logits, label).mean()
+
+    model = pt.build(net)
+    r = reader.stack_batch(dataset.cifar.train10(), 16)
+    batches = list(r())[:6]
+    variables, losses = _train(model, pt.optimizer.Adam(learning_rate=1e-3), batches)
+    assert losses[-1] < losses[0], losses
+
+    def infer(img):
+        img = img.reshape(img.shape[0], 3, 32, 32).transpose(0, 2, 3, 1)
+        x = pt.layers.conv2d(img, num_filters=8, filter_size=3, padding=1, act="relu")
+        x = pt.layers.pool2d(x, pool_size=2, pool_stride=2)
+        x = pt.layers.conv2d(x, num_filters=16, filter_size=3, padding=1, act="relu")
+        x = pt.layers.pool2d(x, pool_size=2, pool_stride=2)
+        return pt.layers.fc(x.reshape(img.shape[0], -1), size=10)
+
+    infer_model = pt.build(infer)
+    img = batches[0][0]
+    out_dir = str(tmp_path / "cifar")
+    pt.io.save_inference_model(out_dir, infer_model, variables, [img])
+    run, _ = pt.io.load_inference_model(out_dir)
+    np.testing.assert_allclose(
+        np.asarray(run(jnp.asarray(img))),
+        np.asarray(infer_model.apply(variables, jnp.asarray(img))[0]),
+        rtol=1e-4, atol=1e-5,
+    )
