@@ -63,6 +63,7 @@ def multi_head_attention(
     name: str = "mha",
     causal: bool = False,
     core=None,
+    kv_len=None,
 ):
     """Projected multi-head attention (q/k/v/out linear maps + fused core).
 
@@ -104,6 +105,7 @@ def multi_head_attention(
                 is_test=not pt.framework.is_training(),
                 dropout_key=pt.framework.next_rng_key() if (dropout_rate > 0 and pt.framework.is_training()) else None,
                 causal=causal,
+                kv_len=kv_len,
             )
         out = oattn.combine_heads(ctx)
         return _proj(out, d_model, shard_out=False, name="out")
@@ -152,27 +154,30 @@ def prepare_embedding(ids, vocab_size, d_model, max_len, dropout_rate, name, pos
         return emb
 
 
-def encoder_layer(x, self_mask, cfg, name):
+def encoder_layer(x, self_mask, cfg, name, kv_len=None):
     with name_scope(name):
         attn = multi_head_attention(
             x, x, x, cfg["d_model"], cfg["num_heads"], mask=self_mask,
-            dropout_rate=cfg["attn_dropout"], name="self_attn",
+            dropout_rate=cfg["attn_dropout"], name="self_attn", kv_len=kv_len,
         )
         x = _post_process(x, attn, cfg["residual_dropout"])
         ffn = positionwise_ffn(x, cfg["d_inner"], cfg["d_model"], cfg["relu_dropout"])
         return _post_process(x, ffn, cfg["residual_dropout"])
 
 
-def decoder_layer(x, enc_out, self_mask, cross_mask, cfg, name, cache=None):
+def decoder_layer(x, enc_out, self_mask, cross_mask, cfg, name, cache=None,
+                  self_causal=False, cross_kv_len=None):
     with name_scope(name):
         attn = multi_head_attention(
             x, x, x, cfg["d_model"], cfg["num_heads"], mask=self_mask,
             dropout_rate=cfg["attn_dropout"], cache=cache, name="self_attn",
+            causal=self_causal,
         )
         x = _post_process(x, attn, cfg["residual_dropout"])
         cross = multi_head_attention(
             x, enc_out, enc_out, cfg["d_model"], cfg["num_heads"], mask=cross_mask,
             dropout_rate=cfg["attn_dropout"], name="cross_attn",
+            kv_len=cross_kv_len,
         )
         x = _post_process(x, cross, cfg["residual_dropout"])
         ffn = positionwise_ffn(x, cfg["d_inner"], cfg["d_model"], cfg["relu_dropout"])
@@ -184,22 +189,48 @@ def _pad_mask(pad_flags):
     return jnp.where(pad_flags, -jnp.inf, 0.0).astype(jnp.float32)[:, None, None, :]
 
 
+def _structural_masking() -> bool:
+    """With the flash flag on, padding travels as per-row kv_len bounds and
+    causality as the kernel's block structure — no additive [T, T] masks.
+    Valid because padding is a SUFFIX (ragged FeedSpec layout) and the loss
+    zero-weights pad positions: pad QUERIES may compute garbage that never
+    reaches the loss, while pad KEYS are excluded for every valid query."""
+    from paddle_tpu.core import config as _cfg
+
+    return _cfg.flags().use_flash_attention
+
+
+def _lens(pad_flags):
+    return jnp.sum(1 - pad_flags.astype(jnp.int32), axis=1)
+
+
 def encode(src_ids, src_pad, cfg):
-    self_mask = _pad_mask(src_pad)
+    structural = _structural_masking()
+    self_mask = None if structural else _pad_mask(src_pad)
+    src_len = _lens(src_pad) if structural else None
     x = prepare_embedding(
         src_ids, cfg["src_vocab"], cfg["d_model"], cfg["max_len"],
         cfg["residual_dropout"], name="src_emb",
     )
     for i in range(cfg["n_layers"]):
-        x = encoder_layer(x, self_mask, cfg, name=f"enc_layer_{i}")
+        x = encoder_layer(x, self_mask, cfg, name=f"enc_layer_{i}", kv_len=src_len)
     return x
 
 
 def decode(trg_ids, trg_pad, enc_out, src_pad, cfg, caches=None, pos_offset=0):
     t = trg_ids.shape[1]
-    causal = oattn.causal_mask(t, t)[None, None]
-    self_mask = causal + _pad_mask(trg_pad) if caches is None else None
-    cross_mask = _pad_mask(src_pad)
+    structural = _structural_masking() and caches is None
+    if caches is not None:
+        self_mask = None
+    elif structural:
+        # causal alone suffices for decoder self-attention: pad keys sit at
+        # positions >= len, and every valid query q has q < len <= pad key
+        # positions, so causality already excludes them
+        self_mask = None
+    else:
+        self_mask = oattn.causal_mask(t, t)[None, None] + _pad_mask(trg_pad)
+    cross_mask = None if structural else _pad_mask(src_pad)
+    cross_len = _lens(src_pad) if structural else None
     x = prepare_embedding(
         trg_ids, cfg["trg_vocab"], cfg["d_model"], cfg["max_len"],
         cfg["residual_dropout"], name="trg_emb",
@@ -207,7 +238,10 @@ def decode(trg_ids, trg_pad, enc_out, src_pad, cfg, caches=None, pos_offset=0):
     )
     for i in range(cfg["n_layers"]):
         cache = caches[i] if caches is not None else None
-        x = decoder_layer(x, enc_out, self_mask, cross_mask, cfg, name=f"dec_layer_{i}", cache=cache)
+        x = decoder_layer(
+            x, enc_out, self_mask, cross_mask, cfg, name=f"dec_layer_{i}",
+            cache=cache, self_causal=structural, cross_kv_len=cross_len,
+        )
     with name_scope("project"):
         logits = _proj(x, cfg["trg_vocab"], shard_out=True, name="logits", bias=False)
     return logits

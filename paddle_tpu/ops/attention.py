@@ -56,12 +56,16 @@ def scaled_dot_product_attention(
     dropout_key=None,
     scale: Optional[float] = None,
     causal: bool = False,
+    kv_len: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attention over [..., T, D] tensors (head dims lead). ``mask`` is an
     additive mask broadcastable to [..., Tq, Tk] (0 = keep, -inf = drop);
     ``causal=True`` applies the autoregressive mask structurally — prefer it
     over an additive causal mask, because the flash kernel then skips the
     masked blocks' compute entirely instead of materializing [Tq, Tk].
+    ``kv_len`` ([B] int) masks key positions >= kv_len[b] structurally
+    (suffix padding): variable-length batches ride the flash kernel with
+    fully-padded tail blocks skipped, instead of an additive [Tq, Tk] mask.
 
     Softmax in fp32; QK^T and PV matmuls accumulate fp32 on the MXU.
     With ``flags().use_flash_attention``, the mask-free 4-D case routes
@@ -94,8 +98,20 @@ def scaled_dot_product_attention(
             out_dtype = q.dtype
             q, k, v = mxu_operands(q, k, v)  # bf16 halves K/V HBM traffic
             return flash_attention(
-                q, k, v, causal=causal, sm_scale=scale, block_q=bq, block_k=bk
+                q, k, v, causal=causal, sm_scale=scale, block_q=bq, block_k=bk,
+                kv_len=kv_len,
             ).astype(out_dtype)
+    if kv_len is not None:
+        from paddle_tpu.core.dtypes import NEG_INF
+
+        k_pos = jnp.arange(k.shape[-2])
+        len_mask = jnp.where(
+            k_pos[None, :] < kv_len[:, None], 0.0, NEG_INF
+        ).astype(jnp.float32)
+        len_mask = len_mask.reshape(
+            (kv_len.shape[0],) + (1,) * (q.ndim - 2) + (k.shape[-2],)
+        )
+        mask = len_mask if mask is None else mask + len_mask
     if causal:
         mask_c = causal_mask(q.shape[-2], k.shape[-2])
         mask = mask_c if mask is None else mask + mask_c

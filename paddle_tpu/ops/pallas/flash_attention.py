@@ -35,8 +35,9 @@ __all__ = ["flash_attention", "flash_attention_with_lse", "flash_attention_bwd_b
 
 
 def _flash_fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+    q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     *, block_q: int, block_k: int, causal: bool, sm_scale: float,
+    has_kvlen: bool,
 ):
     """One (batch*head, q_block, kv_block) grid cell. Only the CURRENT
     [block_k, d] K/V tiles are VMEM-resident — long sequences stream through
@@ -53,8 +54,11 @@ def _flash_fwd_kernel(
 
     q_blk = pl.program_id(1)
     # causal: kv blocks fully above the diagonal contribute nothing — skip
-    # their compute entirely (half the FLOPs on average)
+    # their compute entirely (half the FLOPs on average); same for kv
+    # blocks entirely past this row's kv_len (padded tails)
     live = (j * block_k <= q_blk * block_q + block_q - 1) if causal else True
+    if has_kvlen:
+        live = jnp.logical_and(live, j * block_k < kvlen_ref[0, 0])
 
     @pl.when(live)
     def _():
@@ -68,6 +72,9 @@ def _flash_fwd_kernel(
             q_pos = q_blk * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if has_kvlen:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos < kvlen_ref[0, 0], s, NEG_INF)
 
         m_prev, l_prev = m_ref[:], l_ref[:]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -88,7 +95,8 @@ def _flash_fwd_kernel(
 
 
 def _flash_fwd_kernel_resident(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, sm_scale: float
+    q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref,
+    *, block_k: int, causal: bool, sm_scale: float, has_kvlen: bool,
 ):
     """Fast path for K/V that fit in VMEM: one (batch*head, q_block) grid
     cell holds the whole K/V and loops kv blocks with a fori_loop — the
@@ -110,6 +118,9 @@ def _flash_fwd_kernel_resident(
             q_pos = q_blk * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if has_kvlen:
+            k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos < kvlen_ref[0, 0], s, NEG_INF)
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)
@@ -125,6 +136,8 @@ def _flash_fwd_kernel_resident(
         n_kv_used = jnp.minimum(n_kv, pl.cdiv((q_blk + 1) * block_q, block_k))
     else:
         n_kv_used = n_kv
+    if has_kvlen:  # fully-padded tail blocks contribute nothing — skip them
+        n_kv_used = jnp.minimum(n_kv_used, pl.cdiv(kvlen_ref[0, 0], block_k))
     init = (
         jnp.full((block_q, 1), NEG_INF, jnp.float32),
         jnp.zeros((block_q, 1), jnp.float32),
@@ -140,9 +153,18 @@ def _flash_fwd_kernel_resident(
 _VMEM_RESIDENT_BYTES = 4 * 1024 * 1024
 
 
-def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool):
+def _kvlen_rows(kv_len, B: int, H: int):
+    """[B] lengths → [B*H, 1] i32 so the kernel grid's combined batch*head
+    dim indexes it directly."""
+    return jnp.repeat(kv_len.astype(jnp.int32), H).reshape(B * H, 1)
+
+
+def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int,
+               interpret: bool, kv_len=None):
     """Returns ``(out [B,H,T,d], lse [B,H,T,1])`` — lse is the per-row
-    logsumexp of the scaled scores, consumed by the fused backward."""
+    logsumexp of the scaled scores, consumed by the fused backward.
+    ``kv_len`` ([B] int) masks key positions >= kv_len[b] (suffix padding,
+    the LoD-replacement layout)."""
     B, H, T, d = q.shape
     t_kv = k.shape[2]
     block_q = min(block_q, T)
@@ -153,6 +175,8 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
     qr = q.reshape(B * H, T, d)
     kr = k.reshape(B * H, t_kv, d)
     vr = v.reshape(B * H, t_kv, d)
+    has_kvlen = kv_len is not None
+    lens = _kvlen_rows(kv_len, B, H) if has_kvlen else jnp.zeros((B * H, 1), jnp.int32)
     from jax.experimental.pallas import tpu as pltpu
 
     out_shapes = [
@@ -163,7 +187,7 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
     if kv_bytes <= _VMEM_RESIDENT_BYTES:
         kernel = functools.partial(
             _flash_fwd_kernel_resident,
-            block_k=block_k, causal=causal, sm_scale=sm_scale,
+            block_k=block_k, causal=causal, sm_scale=sm_scale, has_kvlen=has_kvlen,
         )
         out, lse = pl.pallas_call(
             kernel,
@@ -172,6 +196,7 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
                 pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
                 pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
                 pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, 1), lambda b, i: (b, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -182,12 +207,13 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
                 dimension_semantics=("parallel", "arbitrary"),
             ),
             interpret=interpret,
-        )(qr, kr, vr)
+        )(qr, kr, vr, lens)
         return out.reshape(B, H, T, d), lse.reshape(B, H, T, 1)
 
     kernel = functools.partial(
         _flash_fwd_kernel,
         block_q=block_q, block_k=block_k, causal=causal, sm_scale=sm_scale,
+        has_kvlen=has_kvlen,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -196,6 +222,7 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1), lambda b, i, j: (b, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -211,14 +238,15 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(qr, kr, vr)
+    )(qr, kr, vr, lens)
     return out.reshape(B, H, T, d), lse.reshape(B, H, T, 1)
 
 
 def _flash_bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dk_ref, dv_ref,
     dk_acc, dv_acc,
     *, block_q: int, block_k: int, causal: bool, sm_scale: float,
+    has_kvlen: bool,
 ):
     """dK/dV for one kv block, streaming q blocks through the innermost grid
     dim. P is recomputed from (Q, K, LSE) — FlashAttention-2 eq. (13-16):
@@ -232,8 +260,11 @@ def _flash_bwd_dkv_kernel(
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    # causal: q blocks fully above this kv block's diagonal see none of it
+    # causal: q blocks fully above this kv block's diagonal see none of it;
+    # kv blocks fully past kv_len contribute zero grads — skip both
     live = (i * block_q + block_q - 1 >= j * block_k) if causal else True
+    if has_kvlen:
+        live = jnp.logical_and(live, j * block_k < kvlen_ref[0, 0])
 
     @pl.when(live)
     def _():
@@ -250,6 +281,9 @@ def _flash_bwd_dkv_kernel(
             q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if has_kvlen:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos < kvlen_ref[0, 0], s, NEG_INF)
         p = jnp.exp(s - lse)  # normalized probabilities, [block_q, block_k]
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -269,8 +303,9 @@ def _flash_bwd_dkv_kernel(
 
 
 def _flash_bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dq_ref, dq_acc,
     *, block_q: int, block_k: int, causal: bool, sm_scale: float,
+    has_kvlen: bool,
 ):
     """dQ for one q block, streaming kv blocks: dQ += dS K·scale."""
     j = pl.program_id(2)
@@ -282,6 +317,8 @@ def _flash_bwd_dq_kernel(
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     live = (j * block_k <= i * block_q + block_q - 1) if causal else True
+    if has_kvlen:
+        live = jnp.logical_and(live, j * block_k < kvlen_ref[0, 0])
 
     @pl.when(live)
     def _():
@@ -298,6 +335,9 @@ def _flash_bwd_dq_kernel(
             q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if has_kvlen:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos < kvlen_ref[0, 0], s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -312,7 +352,8 @@ def _flash_bwd_dq_kernel(
         dq_ref[0] = (dq_acc[:] * sm_scale).astype(dq_ref.dtype)
 
 
-def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret):
+def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret,
+               kv_len=None):
     """Fused backward: returns (dq, dk, dv), each the dtype of its primal."""
     B, H, T, d = q.shape
     t_kv = k.shape[2]
@@ -331,23 +372,24 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
         gr.astype(jnp.float32) * out.reshape(B * H, T, d).astype(jnp.float32),
         axis=-1, keepdims=True,
     )
+    has_kvlen = kv_len is not None
+    lens = _kvlen_rows(kv_len, B, H) if has_kvlen else jnp.zeros((B * H, 1), jnp.int32)
     from jax.experimental.pallas import tpu as pltpu
-
-    q_spec3 = pl.BlockSpec((1, block_q, d), lambda b, x, y: (b, x, 0))
-    row_spec3 = pl.BlockSpec((1, block_q, 1), lambda b, x, y: (b, x, 0))
 
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel,
         block_q=block_q, block_k=block_k, causal=causal, sm_scale=sm_scale,
+        has_kvlen=has_kvlen,
     )
     # grid: q innermost (sequential accumulate), kv parallel
     q_stream = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
     row_stream = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
     kv_fixed = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    len_spec3 = pl.BlockSpec((1, 1), lambda b, j, i: (b, 0))
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(B * H, t_kv // block_k, T // block_q),
-        in_specs=[q_stream, kv_fixed, kv_fixed, q_stream, row_stream, row_stream],
+        in_specs=[q_stream, kv_fixed, kv_fixed, q_stream, row_stream, row_stream, len_spec3],
         out_specs=[kv_fixed, kv_fixed],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, t_kv, d), k.dtype),
@@ -361,11 +403,12 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(qr, kr, vr, gr, lse_r, delta)
+    )(qr, kr, vr, gr, lse_r, delta, lens)
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel,
         block_q=block_q, block_k=block_k, causal=causal, sm_scale=sm_scale,
+        has_kvlen=has_kvlen,
     )
     # grid: kv innermost (sequential accumulate), q parallel
     q_fixed = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
@@ -374,7 +417,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
     (dq,) = pl.pallas_call(
         dq_kernel,
         grid=(B * H, T // block_q, t_kv // block_k),
-        in_specs=[q_fixed, kv_stream, kv_stream, q_fixed, row_fixed, row_fixed],
+        in_specs=[q_fixed, kv_stream, kv_stream, q_fixed, row_fixed, row_fixed, len_spec3],
         out_specs=[q_fixed],
         out_shape=[jax.ShapeDtypeStruct((B * H, T, d), q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
@@ -382,7 +425,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(qr, kr, vr, gr, lse_r, delta)
+    )(qr, kr, vr, gr, lse_r, delta, lens)
 
     return (
         dq.reshape(B, H, T, d),
@@ -391,7 +434,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
     )
 
 
-def _reference_attention(q, k, v, causal: bool, sm_scale: float):
+def _reference_attention(q, k, v, causal: bool, sm_scale: float, kv_len=None):
     # f32 accumulation in both einsums — bf16 inputs must not produce
     # bf16-precision scores in the recomputed backward
     s = jnp.einsum(
@@ -401,32 +444,57 @@ def _reference_attention(q, k, v, causal: bool, sm_scale: float):
         T, S = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((T, S), bool))
         s = jnp.where(mask, s, NEG_INF)
+    if kv_len is not None:
+        k_pos = jnp.arange(s.shape[-1])
+        s = jnp.where(k_pos[None, None, None, :] < kv_len[:, None, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum(
         "bhqk,bhkd->bhqd", p.astype(q.dtype), v, preferred_element_type=jnp.float32
     ).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+def _float0_like(x):
+    import numpy as _np
+
+    return _np.zeros(x.shape, jax.dtypes.float0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, kv_len, causal, sm_scale, block_q, block_k, interpret, has_kvlen):
+    out, _ = _flash_fwd(
+        q, k, v, causal, sm_scale, block_q, block_k, interpret,
+        kv_len if has_kvlen else None,
+    )
     return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def _flash_vjp_fwd(q, k, v, kv_len, causal, sm_scale, block_q, block_k, interpret, has_kvlen):
+    out, lse = _flash_fwd(
+        q, k, v, causal, sm_scale, block_q, block_k, interpret,
+        kv_len if has_kvlen else None,
+    )
+    return out, (q, k, v, kv_len, out, lse)
 
 
-def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, has_kvlen, res, g):
+    q, k, v, kv_len, out, lse = res
     from paddle_tpu.core.config import flags
 
     if flags().flash_fused_bwd:
-        return _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret)
-    # recomputed XLA attention backward (activations were never stored)
-    _, vjp = jax.vjp(lambda a, b, c: _reference_attention(a, b, c, causal, sm_scale), q, k, v)
-    return vjp(g)
+        dq, dk, dv = _flash_bwd(
+            q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret,
+            kv_len if has_kvlen else None,
+        )
+    else:
+        # recomputed XLA attention backward (activations were never stored)
+        _, vjp = jax.vjp(
+            lambda a, b, c: _reference_attention(
+                a, b, c, causal, sm_scale, kv_len if has_kvlen else None
+            ),
+            q, k, v,
+        )
+        dq, dk, dv = vjp(g)
+    return dq, dk, dv, _float0_like(kv_len)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -441,6 +509,7 @@ def flash_attention_with_lse(
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    kv_len: Optional[jax.Array] = None,
 ):
     """Forward-only fused attention returning ``(out, lse)`` with lse
     [B, H, T, 1] — the building block for outer blockwise schedules that
@@ -451,7 +520,7 @@ def flash_attention_with_lse(
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash_fwd(q, k, v, causal, float(sm_scale), block_q, block_k, interpret)
+    return _flash_fwd(q, k, v, causal, float(sm_scale), block_q, block_k, interpret, kv_len)
 
 
 def flash_attention_bwd_block(
@@ -490,13 +559,23 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    kv_len: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Fused attention: ``softmax(QK^T * sm_scale) V``.
 
-    q/k/v: [B, H, T, d]. ``interpret`` defaults to True off-TPU so the same
+    q/k/v: [B, H, T, d]. ``kv_len`` ([B] int, values >= 1) masks key
+    positions >= kv_len[b] — suffix padding, the framework's LoD
+    replacement — in forward AND fused backward, with fully-padded tail
+    blocks skipped. ``interpret`` defaults to True off-TPU so the same
     code path runs under the CPU test mesh."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, causal, float(sm_scale), block_q, block_k, interpret)
+    has_kvlen = kv_len is not None
+    if not has_kvlen:
+        kv_len = jnp.zeros((q.shape[0],), jnp.int32)
+    return _flash(
+        q, k, v, kv_len.astype(jnp.int32), causal, float(sm_scale),
+        block_q, block_k, interpret, has_kvlen,
+    )

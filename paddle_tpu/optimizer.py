@@ -81,17 +81,20 @@ class Optimizer:
         lr = self.scheduler(opt_state.step)
         new_params = dict(params)
         new_slots = {s: dict(d) for s, d in opt_state.slots.items()}
+        # name-aware updates (Lamb's decay/trust exclusions) declare a
+        # `name` parameter on _update; plain optimizers keep the short form
+        import inspect
+
+        accepts_name = "name" in inspect.signature(self._update).parameters
         for name, p in params.items():
             info = param_info.get(name) if param_info else None
             if info is not None and not info.trainable:
                 continue
             g = grads[name].astype(jnp.float32)
             p_lr = lr * (info.learning_rate if info is not None else 1.0)
-            # per-leaf context for subclasses needing name-aware updates
-            # (Lamb's decay/trust exclusions); set right before each call
-            self._current_param_name = name
             slot_view = {s: new_slots[s][name] for s in self._slot_names()}
-            new_p, slot_out = self._update(p.astype(jnp.float32), g, p_lr, slot_view, opt_state.step)
+            kw = {"name": name} if accepts_name else {}
+            new_p, slot_out = self._update(p.astype(jnp.float32), g, p_lr, slot_view, opt_state.step, **kw)
             new_params[name] = new_p.astype(p.dtype)
             for s, v in slot_out.items():
                 new_slots[s][name] = v
@@ -339,7 +342,7 @@ class Lamb(Optimizer):
     def _slot_names(self):
         return ("moment1", "moment2")
 
-    def _update(self, p, g, lr, slots, step):
+    def _update(self, p, g, lr, slots, step, name=""):
         t = (step + 1).astype(jnp.float32)
         m1 = self.beta1 * slots["moment1"] + (1 - self.beta1) * g
         m2 = self.beta2 * slots["moment2"] + (1 - self.beta2) * jnp.square(g)
@@ -347,9 +350,7 @@ class Lamb(Optimizer):
         m2_hat = m2 / (1 - self.beta2 ** t)
         # biases/norm params: no decay and trust=1 (LAMB paper / BERT
         # reference masks) — they're tiny-norm and would be crushed
-        excluded = _name_excluded(
-            getattr(self, "_current_param_name", ""), self.exclude_from_decay
-        )
+        excluded = _name_excluded(name, self.exclude_from_decay)
         wd = 0.0 if excluded else self.weight_decay
         update = m1_hat / (jnp.sqrt(m2_hat) + self.epsilon) + wd * p
         if excluded:

@@ -216,3 +216,25 @@ def test_transformer_lm_generate_sampling_shapes():
     )
     assert out.shape == (2, 4)
     assert np.all((np.asarray(out) >= 0) & (np.asarray(out) < 32))
+
+
+def test_transformer_nmt_structural_masking_matches_additive():
+    """With use_flash_attention on, the NMT transformer swaps additive
+    pad/causal masks for kv_len bounds + kernel causality; the loss (which
+    zero-weights pad tokens) must match the mask path to kernel precision."""
+    spec = models.get_model(
+        "transformer", seq_len=16, src_vocab=64, trg_vocab=64, d_model=32,
+        d_inner=64, num_heads=2, n_layers=2, max_len=32,
+        attn_dropout=0.0, relu_dropout=0.0, residual_dropout=0.0,
+    )
+    rng = np.random.RandomState(0)
+    batch = spec.synth_batch(4, rng)
+    variables = spec.model.init(0, *batch)
+
+    (loss_mask, _, _), _ = spec.model.apply(variables, *batch, is_train=False)
+    pt.core.config.set_flags(use_flash_attention=True)
+    try:
+        (loss_flash, _, _), _ = spec.model.apply(variables, *batch, is_train=False)
+    finally:
+        pt.core.config.set_flags(use_flash_attention=False)
+    np.testing.assert_allclose(float(loss_mask), float(loss_flash), rtol=1e-4)
