@@ -123,6 +123,14 @@ def is_training() -> bool:
     return _current_frame().is_train
 
 
+def is_initializing() -> bool:
+    """Whether the current trace is Model.init (parameter creation).
+    Transform wrappers that re-trace their body (jax.checkpoint) must be
+    skipped here — param initializer outputs created inside the inner trace
+    would escape it as leaked tracers."""
+    return _current_frame().mode == "init"
+
+
 @contextlib.contextmanager
 def name_scope(prefix: str):
     """Hierarchical name scope (fluid.name_scope parity, ``framework.py`` tail).

@@ -62,6 +62,29 @@ def lm_block(x, cfg, name):
         return _post_process(x, ffn, cfg["residual_dropout"])
 
 
+def _block_caller(cfg):
+    """Returns ``call(x, name) -> x``; with cfg['remat'] each layer runs
+    under jax.checkpoint — activations recompute in backward, so training
+    memory scales with ONE layer's activations instead of n_layers (the
+    standard long-context trade; transpiler/memory.py holds the
+    named-policy variants). cfg/name are closed over (static); the
+    framework's trace-time param creation fires inside the checkpointed
+    region, which is safe — creation is name-keyed and idempotent across
+    the fwd/bwd re-traces."""
+    if not cfg.get("remat"):
+        return lambda x, name: lm_block(x, cfg, name)
+
+    def call(x, name):
+        # remat only matters for the backward pass: during init the param
+        # initializer outputs would leak out of checkpoint's inner trace,
+        # and in eval mode checkpoint's CSE barriers are a pure slowdown
+        if pt.framework.is_initializing() or not pt.framework.is_training():
+            return lm_block(x, cfg, name)
+        return jax.checkpoint(lambda y: lm_block(y, cfg, name))(x)
+
+    return call
+
+
 def lm_forward(ids, labels, *, cfg):
     """Next-token LM training forward: returns (loss, token_count, logits).
 
@@ -71,8 +94,9 @@ def lm_forward(ids, labels, *, cfg):
         ids, cfg["vocab"], cfg["d_model"], cfg["max_len"],
         cfg["residual_dropout"], name="emb",
     )
+    block = _block_caller(cfg)
     for i in range(cfg["n_layers"]):
-        x = lm_block(x, cfg, name=f"layer_{i}")
+        x = block(x, name=f"layer_{i}")
     x = layers.layer_norm(x, begin_norm_axis=x.ndim - 1)
     with name_scope("project"):
         logits = _proj(x, cfg["vocab"], shard_out=True, name="logits", bias=False)
@@ -226,6 +250,7 @@ BASE_CFG = dict(
     attn_dropout=0.0,
     relu_dropout=0.0,
     residual_dropout=0.0,
+    remat=False,
 )
 
 
