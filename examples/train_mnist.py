@@ -36,7 +36,7 @@ def main():
             if i % 20 == 0:
                 print(f"epoch {epoch} step {i}: loss={float(out.loss):.4f}")
 
-    # export for serving (StableHLO + native C++ predictor artifact)
+    # export for serving (StableHLO; native=True adds the C++ predictor artifact)
     def infer(img):
         img = img.reshape(img.shape[0], 28, 28, 1)
         conv = nets.simple_img_conv_pool(
@@ -44,7 +44,7 @@ def main():
         return pt.layers.fc(conv.reshape(img.shape[0], -1), size=10)
 
     infer_model = pt.build(infer)
-    pt.io.save_inference_model("/tmp/mnist_model", infer_model, variables, [first[0]])
+    pt.io.save_inference_model("/tmp/mnist_model", infer_model, variables, [first[0]], native=True)
     print("saved inference model to /tmp/mnist_model")
 
 

@@ -36,7 +36,7 @@ from paddle_tpu.parallel.pipeline import (
     stack_stage_params,
     split_microbatches,
 )
-from paddle_tpu.parallel.moe import moe_ffn, switch_gate, MoEOutput
+from paddle_tpu.parallel.moe import moe_ffn, switch_gate, top2_gate, MoEOutput
 
 __all__ = [
     "make_mesh",
@@ -58,5 +58,6 @@ __all__ = [
     "split_microbatches",
     "moe_ffn",
     "switch_gate",
+    "top2_gate",
     "MoEOutput",
 ]

@@ -24,7 +24,7 @@ from paddle_tpu.core.enforce import enforce
 from paddle_tpu.framework import ParamAttr, create_parameter, name_scope
 from paddle_tpu.parallel import mesh as mesh_mod
 
-__all__ = ["switch_gate", "moe_ffn", "MoEOutput"]
+__all__ = ["switch_gate", "top2_gate", "moe_ffn", "MoEOutput"]
 
 
 class MoEOutput(NamedTuple):
@@ -68,6 +68,68 @@ def switch_gate(
     return dispatch, combine, aux_loss
 
 
+def top2_gate(
+    logits: jax.Array, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-2 (GShard) routing: each token goes to its two highest-prob
+    experts, gate weights renormalized over the pair; second-choice tokens
+    queue AFTER all first choices in each expert's buffer (GShard's
+    priority rule), so overflow drops second choices first. Same
+    ``(dispatch [N,E,C], combine [N,E,C], aux_loss)`` contract as
+    :func:`switch_gate`."""
+    N, E = logits.shape
+    enforce(E >= 2, f"top2_gate needs >= 2 experts, got {E}")
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    idx1 = jnp.argmax(probs, axis=-1)  # [N]
+    mask1 = jax.nn.one_hot(idx1, E, dtype=probs.dtype)
+    probs2 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs2, axis=-1)
+    # saturated softmax: probs2 can be exactly zero everywhere — argmax then
+    # points at expert 0 and a phantom zero-gate route would eat a real
+    # capacity slot there; drop the second route entirely in that case
+    has2 = (jnp.max(probs2, axis=-1) > 0).astype(probs.dtype)
+    mask2 = jax.nn.one_hot(idx2, E, dtype=probs.dtype) * has2[:, None]
+
+    # aux loss uses FIRST-choice density (GShard eq. for l_aux)
+    density = jnp.mean(mask1, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux_loss = E * jnp.sum(density * density_proxy)
+
+    # renormalized pair gates
+    g1 = jnp.sum(probs * mask1, axis=-1)
+    g2 = jnp.sum(probs * mask2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    # buffer positions: first choices rank first, then second choices
+    m1_i = mask1.astype(jnp.int32)
+    m2_i = mask2.astype(jnp.int32)
+    pos1 = (jnp.cumsum(m1_i, axis=0) - 1) * m1_i  # [N, E]
+    count1 = jnp.sum(m1_i, axis=0, keepdims=True)  # [1, E]
+    pos2 = (jnp.cumsum(m2_i, axis=0) - 1) * m2_i + count1 * m2_i
+
+    def one_route(mask_i, pos_ne, gate):
+        pos = jnp.sum(pos_ne, axis=-1).astype(jnp.int32)  # [N]
+        keep = pos < capacity
+        dispatch = (
+            mask_i.astype(bool) & keep[:, None]
+        )[..., None] & (
+            jax.nn.one_hot(pos, capacity, dtype=jnp.int32).astype(bool)
+        )[:, None, :]
+        combine = (gate * keep)[:, None, None] * dispatch.astype(probs.dtype)
+        return dispatch, combine
+
+    d1, c1 = one_route(m1_i, pos1, g1)
+    d2, c2 = one_route(m2_i, pos2, g2)
+    return d1 | d2, c1 + c2, aux_loss
+
+
+# router table: (gate_fn, dispatched routes per token) — capacity scales
+# with the route count, so new routers declare it here
+_ROUTERS = {"top1": (switch_gate, 1), "switch": (switch_gate, 1), "top2": (top2_gate, 2)}
+
+
 def moe_ffn(
     x: jax.Array,
     num_experts: int,
@@ -75,21 +137,25 @@ def moe_ffn(
     capacity_factor: float = 1.25,
     act=jax.nn.relu,
     name: Optional[str] = None,
+    router: str = "top1",
 ) -> MoEOutput:
     """Expert-parallel FFN layer: ``x`` [B, T, D] (or [N, D]) through
-    ``num_experts`` independent two-layer FFNs selected by a Switch router.
+    ``num_experts`` independent two-layer FFNs selected by a router —
+    ``router='top1'`` (Switch) or ``'top2'`` (GShard pair dispatch).
 
     Per-expert weights are created as [E, D, d_ff] / [E, d_ff, D] with
     sharding ('expert', None, None) — under a mesh with an ``expert`` axis
     the dispatch einsums compile to all_to_all over ICI.
     """
+    enforce(router in _ROUTERS, f"unknown router {router!r}; known: {sorted(_ROUTERS)}")
     squeeze = x.ndim == 2
     if squeeze:
         x = x[None]
     B, T, D = x.shape
     N = B * T
     tokens = x.reshape(N, D)
-    capacity = max(1, int(math.ceil(N / num_experts * capacity_factor)))
+    gate_fn, routes = _ROUTERS[router]
+    capacity = max(1, int(math.ceil(routes * N / num_experts * capacity_factor)))
 
     with name_scope(name or "moe"):
         wg = create_parameter([D, num_experts], x.dtype, name="w_gate")
@@ -111,7 +177,7 @@ def moe_ffn(
         )
 
     logits = jnp.matmul(tokens, wg, preferred_element_type=jnp.float32)
-    dispatch, combine, aux = switch_gate(logits.astype(jnp.float32), capacity)
+    dispatch, combine, aux = gate_fn(logits.astype(jnp.float32), capacity)
 
     # dispatch: [N, E, C] × [N, D] → expert inputs [E, C, D] (all_to_all #1)
     expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), tokens)

@@ -281,3 +281,61 @@ def test_transformer_lm_ring_mesh_matches_plain(rng):
     step = jax.jit(opt.minimize(ringm.model))
     out = step(variables, opt_state, *batch, rng=jax.random.PRNGKey(0))
     assert np.isfinite(float(out.loss))
+
+
+def test_top2_gate_pair_dispatch():
+    """Each token reaches its two top experts with renormalized gates."""
+    from paddle_tpu.parallel.moe import top2_gate
+
+    logits = jnp.asarray(np.array(
+        [[3.0, 2.0, -5.0], [0.0, 1.0, 2.0]], np.float32))
+    dispatch, combine, aux = top2_gate(logits, capacity=4)
+    d = np.asarray(dispatch)
+    # token 0 -> experts 0,1; token 1 -> experts 2,1
+    assert d[0, 0].any() and d[0, 1].any() and not d[0, 2].any()
+    assert d[1, 2].any() and d[1, 1].any() and not d[1, 0].any()
+    c = np.asarray(combine).sum(axis=(1, 2))
+    np.testing.assert_allclose(c, [1.0, 1.0], rtol=1e-5)  # gates renormalized
+    assert float(aux) > 0
+
+
+def test_top2_gate_drops_second_choices_first():
+    """Overflow: first choices occupy the buffer before any second choice."""
+    from paddle_tpu.parallel.moe import top2_gate
+
+    # all 4 tokens: first choice expert 0, second choice expert 1
+    logits = jnp.asarray(np.array([[5.0, 4.0]] * 4, np.float32))
+    dispatch, combine, aux = top2_gate(logits, capacity=4)
+    d = np.asarray(dispatch)
+    # expert 0 holds all 4 first choices; expert 1 all 4 second choices
+    assert d[:, 0].sum() == 4 and d[:, 1].sum() == 4
+    dispatch2, _, _ = top2_gate(logits, capacity=2)
+    d2 = np.asarray(dispatch2)
+    assert d2[:, 0].sum() == 2  # first choices kept up to capacity
+    assert d2[:, 1].sum() == 2
+
+
+def test_moe_top2_identical_experts_equal_dense(rng):
+    """With identical experts and ample capacity, top-2 MoE equals the plain
+    FFN exactly (pair gates renormalize to 1)."""
+    B, T, D, F, E = 2, 4, 8, 16, 4
+
+    def net(x):
+        out = moe_ffn(x, num_experts=E, d_ff=F, capacity_factor=8.0, router="top2")
+        return out.output, out.aux_loss
+
+    model = pt.build(net)
+    x = jnp.asarray(rng.randn(B, T, D).astype(np.float32))
+    variables = model.init(0, x)
+    params = dict(variables.params)
+    for nm in ("w_in", "b_in", "w_out", "b_out"):
+        full = f"moe/{nm}"
+        p = np.array(params[full])
+        p[:] = p[0:1]
+        params[full] = jnp.asarray(p)
+    (out, aux), _ = model.apply((params, variables.state), x)
+    h = np.maximum(np.asarray(x) @ np.asarray(params["moe/w_in"][0]) + np.asarray(params["moe/b_in"][0]), 0)
+    ffn = h @ np.asarray(params["moe/w_out"][0]) + np.asarray(params["moe/b_out"][0])
+    # gates renormalize over the pair -> exactly the dense FFN
+    np.testing.assert_allclose(np.asarray(out), ffn, rtol=1e-4, atol=1e-5)
+    assert np.isfinite(float(aux))
