@@ -39,6 +39,7 @@ class CheckpointConfig:
         epoch_interval: int = 1,
         step_interval: int = 10,
         sharded: Optional[bool] = None,
+        async_save: bool = False,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.max_num_checkpoints = max_num_checkpoints
@@ -47,6 +48,15 @@ class CheckpointConfig:
         # None = auto: process-local shard files when running multi-host
         # (the trainer.py:663 per-shard layout); full-tree npz single-host
         self.sharded = sharded
+        # overlap checkpoint IO with training (sharded path, single-process):
+        # device->host snapshot is synchronous, file writing is backgrounded
+        self.async_save = async_save
+        if async_save and sharded is None:
+            # async lives in the sharded module; the single-host auto
+            # default (unsharded) would silently disable it
+            self.sharded = True
+        if async_save and sharded is False:
+            raise ValueError("async_save=True requires the sharded checkpoint layout")
 
     def use_sharded(self) -> bool:
         if self.sharded is not None:

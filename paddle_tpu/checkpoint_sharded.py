@@ -23,6 +23,7 @@ import glob
 import json
 import os
 import shutil
+import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
@@ -55,27 +56,10 @@ def _parse_key(key: str):
     return leaf_i, tuple(slices)
 
 
-def save_sharded(
-    root: str,
-    tree: Any,
-    step: int,
-    epoch: int = 0,
-    max_num_checkpoints: int = 3,
-    extra_meta: Optional[dict] = None,
-) -> str:
-    """Save the training pytree with each process writing only its own
-    shards. Returns the published checkpoint dir (all processes)."""
-    pid = jax.process_index()
-    nproc = jax.process_count()
-    final_dir = os.path.join(root, f"checkpoint_{step}")
-    tmp_dir = final_dir + ".tmp"
-    if pid == 0:
-        os.makedirs(root, exist_ok=True)
-        if os.path.exists(tmp_dir):
-            shutil.rmtree(tmp_dir)
-        os.makedirs(tmp_dir)
-    _barrier("ckpt_mkdir")
-
+def _snapshot(tree: Any, step: int, epoch: int, extra_meta: Optional[dict]):
+    """Device->host shard snapshot + manifest (the shared half of sync and
+    async saves — ONE owner of the replica_id==0 dedup rule, the
+    _index_key layout, and the manifest schema)."""
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     shard_data: Dict[str, np.ndarray] = {}
     manifest_leaves = []
@@ -87,22 +71,50 @@ def save_sharded(
             if shard.replica_id != 0:
                 continue  # dedup replicated shards: one owner writes
             shard_data[_index_key(i, shard.index, shape)] = np.asarray(shard.data)
-    np.savez(os.path.join(tmp_dir, f"shards_p{pid}.npz"), **shard_data)
+    manifest = {
+        "step": int(step),
+        "epoch": int(epoch),
+        "time": time.time(),
+        "num_processes": jax.process_count(),
+        "num_leaves": len(leaves),
+        "leaves": manifest_leaves,
+        "treedef": str(treedef),
+    }
+    if extra_meta:
+        manifest.update(extra_meta)
+    return shard_data, manifest
 
-    if pid == 0:
-        manifest = {
-            "step": int(step),
-            "epoch": int(epoch),
-            "time": time.time(),
-            "num_processes": nproc,
-            "num_leaves": len(leaves),
-            "leaves": manifest_leaves,
-            "treedef": str(treedef),
-        }
-        if extra_meta:
-            manifest.update(extra_meta)
+
+def _write_local(tmp_dir: str, pid: int, shard_data, manifest, write_manifest: bool):
+    np.savez(os.path.join(tmp_dir, f"shards_p{pid}.npz"), **shard_data)
+    if write_manifest:
         with open(os.path.join(tmp_dir, _MANIFEST), "w") as f:
             json.dump(manifest, f, indent=1)
+
+
+def save_sharded(
+    root: str,
+    tree: Any,
+    step: int,
+    epoch: int = 0,
+    max_num_checkpoints: int = 3,
+    extra_meta: Optional[dict] = None,
+) -> str:
+    """Save the training pytree with each process writing only its own
+    shards. Returns the published checkpoint dir (all processes)."""
+    wait_pending_save()  # never interleave with an in-flight async save
+    pid = jax.process_index()
+    final_dir = os.path.join(root, f"checkpoint_{step}")
+    tmp_dir = final_dir + ".tmp"
+    if pid == 0:
+        os.makedirs(root, exist_ok=True)
+        if os.path.exists(tmp_dir):
+            shutil.rmtree(tmp_dir)
+        os.makedirs(tmp_dir)
+    _barrier("ckpt_mkdir")
+
+    shard_data, manifest = _snapshot(tree, step, epoch, extra_meta)
+    _write_local(tmp_dir, pid, shard_data, manifest, write_manifest=pid == 0)
     _barrier("ckpt_written")
     if pid == 0:
         os.rename(tmp_dir, final_dir)  # atomic publish
@@ -110,6 +122,90 @@ def save_sharded(
     _barrier("ckpt_published")
     ptlog.vlog(1, "sharded checkpoint step %d -> %s (process %d)", step, final_dir, pid)
     return final_dir
+
+
+class AsyncSaveHandle:
+    """Handle for an in-flight async save: ``result()`` blocks until the
+    checkpoint is published and returns its dir (re-raising any writer
+    error); ``done`` polls."""
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._dir: Optional[str] = None
+        self._error: Optional[BaseException] = None
+
+    @property
+    def done(self) -> bool:
+        return self._thread is None or not self._thread.is_alive()
+
+    def result(self, timeout: Optional[float] = None) -> str:
+        if self._thread is not None:
+            self._thread.join(timeout)
+            enforce(not self._thread.is_alive(), "async checkpoint save timed out")
+        if self._error is not None:
+            raise self._error
+        return self._dir
+
+
+_pending: Optional[AsyncSaveHandle] = None
+
+
+def wait_pending_save(timeout: Optional[float] = None) -> Optional[str]:
+    """Block until a previous :func:`save_sharded_async` finishes (no-op if
+    none is in flight). Call before process exit so the last checkpoint is
+    durable."""
+    global _pending
+    if _pending is None:
+        return None
+    pending, _pending = _pending, None  # clear even if the writer errored —
+    return pending.result(timeout)      # one failure must not re-raise forever
+
+
+def save_sharded_async(
+    root: str,
+    tree: Any,
+    step: int,
+    epoch: int = 0,
+    max_num_checkpoints: int = 3,
+    extra_meta: Optional[dict] = None,
+) -> AsyncSaveHandle:
+    """Orbax-style async save: device->host shard snapshots are taken
+    SYNCHRONOUSLY (cheap, and the arrays may be donated/overwritten by the
+    next step), then file writing + atomic publish run in a background
+    thread so checkpoint IO overlaps training compute. A new save first
+    waits for the previous one (ordering). Single-process path only — with
+    multiple processes the cross-host publish barrier cannot run off the
+    main thread, so it falls back to the synchronous save."""
+    global _pending
+    wait_pending_save()
+    if jax.process_count() > 1:
+        h = AsyncSaveHandle()
+        h._dir = save_sharded(root, tree, step, epoch, max_num_checkpoints, extra_meta)
+        return h
+
+    shard_data, manifest = _snapshot(tree, step, epoch, extra_meta)
+    handle = AsyncSaveHandle()
+    final_dir = os.path.join(root, f"checkpoint_{step}")
+    tmp_dir = final_dir + ".tmp"
+
+    def writer():
+        try:
+            os.makedirs(root, exist_ok=True)
+            if os.path.exists(tmp_dir):
+                shutil.rmtree(tmp_dir)
+            os.makedirs(tmp_dir)
+            _write_local(tmp_dir, 0, shard_data, manifest, write_manifest=True)
+            os.rename(tmp_dir, final_dir)
+            _prune(root, max_num_checkpoints)
+            handle._dir = final_dir
+            ptlog.vlog(1, "async sharded checkpoint step %d -> %s", step, final_dir)
+        except BaseException as e:  # surfaced on result()
+            handle._error = e
+
+    handle._thread = threading.Thread(target=writer, daemon=True, name=f"ckpt-save-{step}")
+    handle._thread.start()
+    _pending = handle
+    return handle
 
 
 def latest_sharded_checkpoint(root: str) -> Optional[str]:
@@ -243,6 +339,10 @@ def _barrier(tag: str) -> None:
 def update_manifest(path_or_root: str, updates: dict) -> None:
     """Merge fields into the latest checkpoint's manifest (process 0 only;
     atomic tmp+rename, same contract as checkpoint.update_meta)."""
+    # an in-flight async save is about to publish a NEWER checkpoint —
+    # updating "latest" before it lands would write to a stale dir (and
+    # race its prune); wait for the publish first
+    wait_pending_save()
     if jax.process_index() != 0:
         _barrier("manifest_update")
         return

@@ -211,6 +211,10 @@ class Trainer:
                     return
         finally:
             self._restore_signal_handlers(prev_handlers)
+            if self.checkpoint_cfg is not None and getattr(self.checkpoint_cfg, "async_save", False):
+                from paddle_tpu import checkpoint_sharded as cks
+
+                cks.wait_pending_save()  # train() returning => saves durable
 
     # -- preemption (SURVEY §5.3 failure detection / recovery) --------------
     def _install_preemption_handlers(self):
@@ -298,7 +302,8 @@ class Trainer:
         if cfg.use_sharded():
             from paddle_tpu import checkpoint_sharded as cks
 
-            cks.save_sharded(
+            save = cks.save_sharded_async if getattr(cfg, "async_save", False) else cks.save_sharded
+            save(
                 cfg.checkpoint_dir,
                 (self.variables, self.opt_state),
                 step=self.global_step,
@@ -341,4 +346,7 @@ class Trainer:
         io_mod.save_params(dirname, self.variables)
 
     def stop(self):
+        from paddle_tpu import checkpoint_sharded as cks
+
+        cks.wait_pending_save()  # last async checkpoint must be durable
         self.exe.close()
