@@ -25,6 +25,8 @@ from paddle_tpu.framework import (
     build,
     name_scope,
     Model,
+    ParamAttr,
+    WeightNormParamAttr,
     create_parameter,
     create_state,
 )
@@ -44,7 +46,19 @@ from paddle_tpu import checkpoint
 from paddle_tpu import parallel
 from paddle_tpu.parallel import DataParallel
 from paddle_tpu import trainer
-from paddle_tpu.trainer import Trainer, CheckpointConfig
+from paddle_tpu.trainer import (
+    BeginEpochEvent,
+    BeginStepEvent,
+    CheckpointConfig,
+    EndEpochEvent,
+    EndStepEvent,
+    Trainer,
+)
+from paddle_tpu import nets
+from paddle_tpu import tensor
+from paddle_tpu.tensor import create_lod_tensor, create_random_int_lodtensor
+from paddle_tpu.inferencer import Inferencer
+from paddle_tpu.reader.feeder import DataFeeder, FeedSpec
 from paddle_tpu import transpiler
 from paddle_tpu.transpiler import memory_optimize, release_memory
 from paddle_tpu import dataset

@@ -70,7 +70,10 @@ def _snapshot(tree: Any, step: int, epoch: int, extra_meta: Optional[dict]):
         for shard in arr.addressable_shards:
             if shard.replica_id != 0:
                 continue  # dedup replicated shards: one owner writes
-            shard_data[_index_key(i, shard.index, shape)] = np.asarray(shard.data)
+            # copy=True: np.asarray can be a zero-copy VIEW of the device
+            # buffer (CPU backend) — a donated train step would overwrite
+            # it under the async writer
+            shard_data[_index_key(i, shard.index, shape)] = np.array(shard.data, copy=True)
     manifest = {
         "step": int(step),
         "epoch": int(epoch),
@@ -92,6 +95,22 @@ def _write_local(tmp_dir: str, pid: int, shard_data, manifest, write_manifest: b
             json.dump(manifest, f, indent=1)
 
 
+def _write_publish_local(root: str, step: int, shard_data, manifest, max_num: int) -> str:
+    """Single-process write + atomic publish + prune — ONE owner of the
+    tmp-dir/rename/prune protocol, shared by the sync path and the async
+    writer thread."""
+    final_dir = os.path.join(root, f"checkpoint_{step}")
+    tmp_dir = final_dir + ".tmp"
+    os.makedirs(root, exist_ok=True)
+    if os.path.exists(tmp_dir):
+        shutil.rmtree(tmp_dir)
+    os.makedirs(tmp_dir)
+    _write_local(tmp_dir, 0, shard_data, manifest, write_manifest=True)
+    os.rename(tmp_dir, final_dir)  # atomic publish
+    _prune(root, max_num)
+    return final_dir
+
+
 def save_sharded(
     root: str,
     tree: Any,
@@ -104,6 +123,12 @@ def save_sharded(
     shards. Returns the published checkpoint dir (all processes)."""
     wait_pending_save()  # never interleave with an in-flight async save
     pid = jax.process_index()
+    if jax.process_count() == 1:
+        shard_data, manifest = _snapshot(tree, step, epoch, extra_meta)
+        final_dir = _write_publish_local(root, step, shard_data, manifest, max_num_checkpoints)
+        ptlog.vlog(1, "sharded checkpoint step %d -> %s", step, final_dir)
+        return final_dir
+
     final_dir = os.path.join(root, f"checkpoint_{step}")
     tmp_dir = final_dir + ".tmp"
     if pid == 0:
@@ -153,12 +178,20 @@ _pending: Optional[AsyncSaveHandle] = None
 def wait_pending_save(timeout: Optional[float] = None) -> Optional[str]:
     """Block until a previous :func:`save_sharded_async` finishes (no-op if
     none is in flight). Call before process exit so the last checkpoint is
-    durable."""
+    durable. On writer ERROR the pending slot is cleared (one failure must
+    not re-raise forever); on TIMEOUT it stays pending — the writer thread
+    is still alive and must not be raced by a new save."""
     global _pending
     if _pending is None:
         return None
-    pending, _pending = _pending, None  # clear even if the writer errored —
-    return pending.result(timeout)      # one failure must not re-raise forever
+    pending = _pending
+    if pending._thread is not None:
+        pending._thread.join(timeout)
+        enforce(not pending._thread.is_alive(), "async checkpoint save timed out")
+    _pending = None  # joined (or never started): done or errored
+    if pending._error is not None:
+        raise pending._error
+    return pending._dir
 
 
 def save_sharded_async(
@@ -185,20 +218,13 @@ def save_sharded_async(
 
     shard_data, manifest = _snapshot(tree, step, epoch, extra_meta)
     handle = AsyncSaveHandle()
-    final_dir = os.path.join(root, f"checkpoint_{step}")
-    tmp_dir = final_dir + ".tmp"
 
     def writer():
         try:
-            os.makedirs(root, exist_ok=True)
-            if os.path.exists(tmp_dir):
-                shutil.rmtree(tmp_dir)
-            os.makedirs(tmp_dir)
-            _write_local(tmp_dir, 0, shard_data, manifest, write_manifest=True)
-            os.rename(tmp_dir, final_dir)
-            _prune(root, max_num_checkpoints)
-            handle._dir = final_dir
-            ptlog.vlog(1, "async sharded checkpoint step %d -> %s", step, final_dir)
+            handle._dir = _write_publish_local(
+                root, step, shard_data, manifest, max_num_checkpoints
+            )
+            ptlog.vlog(1, "async sharded checkpoint step %d -> %s", step, handle._dir)
         except BaseException as e:  # surfaced on result()
             handle._error = e
 

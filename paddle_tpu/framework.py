@@ -65,6 +65,23 @@ class ParamAttr:
         return ParamAttr()
 
 
+class WeightNormParamAttr(ParamAttr):
+    """Weight normalization (Salimans & Kingma; reference
+    ``param_attr.py WeightNormParamAttr``): the effective weight is
+    ``w = g * v / ||v||`` with direction ``v`` and per-output-slice
+    magnitude ``g`` as the trainable parameters. ``dim`` is the axis kept
+    by the norm (the output dim; None = one global scalar g).
+
+    Divergence from the reference noted: ``g`` initializes to 1 (so the
+    initial effective weight is the normalized direction) rather than to
+    ``||v_init||`` — the reparameterized training dynamics, which are the
+    point of weight norm, are identical."""
+
+    def __init__(self, dim: Optional[int] = None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+
 @dataclasses.dataclass
 class ParamInfo:
     """Static metadata recorded at creation time for each parameter."""
@@ -152,6 +169,44 @@ def _full_name(frame: _Frame, key: str, given: Optional[str]) -> str:
     return frame.generator.generate("/".join(frame.name_stack + [key]))
 
 
+def _weight_norm_parameter(shape, dtype, name, attr: "WeightNormParamAttr", default_initializer):
+    """Create the (v, g) pair behind a WeightNormParamAttr and return the
+    effective weight ``g * v / ||v||`` (norm over all axes except ``dim``)."""
+    from paddle_tpu import initializer as init_mod
+
+    base = attr.name or name or "param"
+    v_attr = ParamAttr(
+        initializer=attr.initializer, regularizer=attr.regularizer,
+        trainable=attr.trainable, learning_rate=attr.learning_rate,
+        sharding=attr.sharding,
+    )
+    v = create_parameter(shape, dtype, name=f"{base}_v", attr=v_attr,
+                         default_initializer=default_initializer)
+    ndim = len(shape)
+    if attr.dim is None:
+        g_shape: Tuple[int, ...] = ()
+        axes = tuple(range(ndim))
+        bshape = (1,) * ndim
+    else:
+        if not (-ndim <= attr.dim < ndim):
+            raise EnforceError(
+                f"WeightNormParamAttr dim={attr.dim} out of range for a "
+                f"rank-{ndim} parameter"
+            )
+        dim = attr.dim % ndim
+        g_shape = (shape[dim],)
+        axes = tuple(a for a in range(ndim) if a != dim)
+        bshape = tuple(shape[d] if d == dim else 1 for d in range(ndim))
+    g = create_parameter(
+        g_shape, dtype, name=f"{base}_g",
+        attr=ParamAttr(trainable=attr.trainable, learning_rate=attr.learning_rate),
+        default_initializer=init_mod.Constant(1.0),
+    )
+    norm = jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32)), axis=axes, keepdims=True) + 1e-12)
+    w = jnp.reshape(g.astype(jnp.float32), bshape) * v.astype(jnp.float32) / norm
+    return w.astype(v.dtype)
+
+
 def next_rng_key() -> jax.Array:
     """Fold a fresh PRNG key off the frame key (dropout, random ops)."""
     frame = _current_frame()
@@ -182,6 +237,8 @@ def create_parameter(
 
     frame = _current_frame()
     attr = ParamAttr.to_attr(attr)
+    if isinstance(attr, WeightNormParamAttr):
+        return _weight_norm_parameter(shape, dtype, name, attr, default_initializer)
     np_dtype = dtypes_mod.convert(dtype)
     full = _full_name(frame, "param", attr.name or name)
     shape = tuple(int(s) for s in shape)

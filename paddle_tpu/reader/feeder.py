@@ -4,7 +4,7 @@ Reference: ``python/paddle/fluid/data_feeder.py:292`` (DataFeeder converts
 per-sample tuples into LoDTensors per feed target, inferring batch layout).
 TPU-native: produces dense numpy batches (and (padded, lengths) pairs for
 ragged slots) ready for jit arguments; no LoD — see
-``paddle_tpu.tensor.ragged``.
+``paddle_tpu.tensor`` (RaggedBatch / create_lod_tensor).
 """
 
 from __future__ import annotations

@@ -252,6 +252,16 @@ class Trainer:
         restarts, matching the reference's mid-epoch checkpoint semantics),
         epoch+1 when the signal landed on a completed epoch boundary."""
         self.preempted = True
+        if self.checkpoint_cfg is not None and getattr(self.checkpoint_cfg, "async_save", False):
+            # an async save may be in flight or may have FAILED — "already
+            # saved" is only true once the publish is confirmed durable
+            from paddle_tpu import checkpoint_sharded as cks
+
+            try:
+                cks.wait_pending_save()
+            except Exception as e:
+                ptlog.warning("pending async checkpoint failed (%s); re-saving", e)
+                self._last_saved_step = -1
         if self.checkpoint_cfg is not None and self.global_step != self._last_saved_step:
             self._save_checkpoint({"next_epoch": next_epoch, "preempted": True})
             ptlog.vlog(0, "preempted: saved at epoch %d step %d", self.epoch, self.global_step)

@@ -128,3 +128,72 @@ def test_param_info_records_metadata():
     assert info.regularizer is reg
     assert info.learning_rate == 0.5
     assert model.param_info["fc/b"].regularizer is None
+
+
+# -------------------------------------------------- API-parity tail
+
+
+def test_weight_norm_param_attr(rng):
+    """fc with WeightNormParamAttr trains through the (v, g) pair; the
+    effective weight's per-output-column norm equals g."""
+    def net(x, y):
+        pred = pt.layers.fc(
+            x, size=4, param_attr=pt.WeightNormParamAttr(dim=1), bias_attr=False)
+        return pt.layers.mean((pred - y) ** 2)
+
+    model = pt.build(net)
+    x = rng.randn(8, 6).astype(np.float32)
+    y = rng.randn(8, 4).astype(np.float32)
+    variables = model.init(0, x, y)
+    names = list(variables.params)
+    assert any(n.endswith("w_v") for n in names), names
+    assert any(n.endswith("w_g") for n in names), names
+
+    opt = pt.optimizer.SGD(learning_rate=0.1)
+    step = jax.jit(opt.minimize(model))
+    o = step(variables, opt.create_state(variables.params), x, y)
+    o2 = step(o.variables, o.opt_state, x, y)
+    assert float(o2.loss) < float(o.loss)
+
+    # effective weight column norms == g (reparameterization invariant)
+    p = o2.variables.params
+    v = np.asarray([p[n] for n in names if n.endswith("w_v")][0])
+    g = np.asarray([p[n] for n in names if n.endswith("w_g")][0])
+    w = g[None, :] * v / np.linalg.norm(v, axis=0, keepdims=True)
+    np.testing.assert_allclose(np.linalg.norm(w, axis=0), np.abs(g), rtol=1e-5)
+
+
+def test_create_lod_tensor_compat():
+    rb = pt.create_lod_tensor([np.arange(3), np.arange(5)])
+    assert rb.data.shape == (2, 5)
+    assert list(rb.lengths) == [3, 5]
+    assert rb.mask().sum() == 8
+
+    flat = np.arange(8).reshape(8, 1)
+    rb2 = pt.create_lod_tensor(flat, recursive_seq_lens=[[3, 5]])
+    assert rb2.data.shape == (2, 5, 1)
+    np.testing.assert_array_equal(rb2.data[0, :3, 0], [0, 1, 2])
+
+    rb3 = pt.create_random_int_lodtensor([[2, 4]], base_shape=[1], high=9, seed=0)
+    assert rb3.data.shape == (2, 4, 1)
+    assert rb3.data.max() <= 9
+
+
+def test_inferencer_round_trip(tmp_path, rng):
+    def net(x, y):
+        pred = pt.layers.fc(x, size=1, name="fc")
+        return pt.layers.mean((pred[:, 0] - y) ** 2)
+
+    model = pt.build(net)
+    x = rng.randn(8, 4).astype(np.float32)
+    y = rng.randn(8).astype(np.float32)
+    variables = model.init(0, x, y)
+    pt.io.save_params(str(tmp_path / "params"), variables)
+
+    def infer_net(x):
+        return pt.layers.fc(x, size=1, name="fc")
+
+    inf = pt.Inferencer(infer_net, str(tmp_path / "params"))
+    out = inf.infer([x])
+    expect, _ = pt.build(infer_net).apply(variables, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect), rtol=1e-5)
