@@ -41,6 +41,9 @@ class Flags:
     prefetch_depth: int = 2
     # directory for profiler traces
     profile_dir: str = "/tmp/paddle_tpu_profile"
+    # persistent XLA compilation cache (big TPU compile-time win across
+    # runs); empty = disabled. Applied at first Executor/jit use.
+    compilation_cache_dir: str = ""
 
     @staticmethod
     def _coerce(value: str, typ):

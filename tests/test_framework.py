@@ -197,3 +197,29 @@ def test_inferencer_round_trip(tmp_path, rng):
     out = inf.infer([x])
     expect, _ = pt.build(infer_net).apply(variables, jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(out), np.asarray(expect), rtol=1e-5)
+
+
+def test_persistent_compile_cache_flag(tmp_path, rng):
+    """flags().compilation_cache_dir routes jit compiles through the
+    persistent cache: artifacts appear in the directory."""
+    cache_dir = str(tmp_path / "jaxcache")
+    pt.core.config.set_flags(compilation_cache_dir=cache_dir)
+    try:
+        import paddle_tpu.executor as ex
+
+        ex._compile_cache_applied = False  # re-apply with this dir
+        exe = pt.Executor()
+
+        def net(x):
+            return pt.layers.fc(x, size=3).sum()
+
+        model = pt.build(net)
+        x = rng.randn(4, 5).astype(np.float32)
+        variables = model.init(0, x)
+        fn = exe.prepare(lambda v, x: model.apply(v, x)[0], key="cache_probe")
+        float(fn(variables, jnp.asarray(x)))
+        import os as _os
+
+        assert _os.path.isdir(cache_dir) and len(_os.listdir(cache_dir)) >= 1
+    finally:
+        pt.core.config.set_flags(compilation_cache_dir="")
