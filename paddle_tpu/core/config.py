@@ -72,6 +72,36 @@ def set_flags(**kwargs) -> None:
         if not hasattr(_flags, k):
             raise AttributeError(f"unknown flag {k!r}")
         setattr(_flags, k, v)
+    if kwargs.get("compilation_cache_dir"):
+        apply_compile_cache()
+
+
+_compile_cache_applied = False
+
+
+def apply_compile_cache() -> None:
+    """Apply flags().compilation_cache_dir to JAX's persistent compilation
+    cache — repeat runs then skip XLA compilation entirely (the
+    20-40s-per-program TPU compile cost; the reference's op-loop executor
+    had no compile step to cache). Called from set_flags and from every
+    framework entry that jits (Executor, Inferencer, DataParallel), so
+    direct-jit workloads honor the flag too."""
+    global _compile_cache_applied
+    dir_ = _flags.compilation_cache_dir
+    if _compile_cache_applied or not dir_:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_compilation_cache_dir", dir_)  # enables last —
+        # a failure above leaves the cache fully off, never half-configured
+        _compile_cache_applied = True
+    except Exception as e:  # older jax without the knobs: soft-disable
+        from paddle_tpu.core import logging as ptlog
+
+        ptlog.warning("persistent compile cache unavailable: %s", e)
+        _compile_cache_applied = True
 
 
 # ---------------------------------------------------------------------------

@@ -25,30 +25,6 @@ from paddle_tpu.core import profiler as prof
 from paddle_tpu.core.enforce import EnforceError
 
 
-_compile_cache_applied = False
-
-
-def _enable_persistent_compile_cache() -> None:
-    """Apply flags().compilation_cache_dir to JAX's persistent compilation
-    cache once — repeat runs then skip XLA compilation entirely (the
-    20-40s-per-program TPU compile cost, reference analogue: none — the
-    op-loop executor had no compile step to cache)."""
-    global _compile_cache_applied
-    dir_ = cfg.flags().compilation_cache_dir
-    if _compile_cache_applied or not dir_:
-        return
-    try:
-        jax.config.update("jax_compilation_cache_dir", dir_)
-        # cache every program, even fast-compiling ones
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-        _compile_cache_applied = True
-    except Exception as e:  # older jax without the knobs: soft-disable
-        from paddle_tpu.core import logging as ptlog
-
-        ptlog.warning("persistent compile cache unavailable: %s", e)
-        _compile_cache_applied = True
-
-
 class Executor:
     """Compile-and-run driver bound to a Place.
 
@@ -62,7 +38,7 @@ class Executor:
         self.place = place or cfg.default_place()
         self._device = self.place.device()
         self._cache: Dict[Any, Callable] = {}
-        _enable_persistent_compile_cache()
+        cfg.apply_compile_cache()
         self._max_cache = max_cache
 
     @property

@@ -33,6 +33,10 @@ class Inferencer:
             inputs = list(inputs.values())
         enforce(isinstance(inputs, (list, tuple)), "inputs must be a sequence or dict")
         if self._jitted is None:
+            from paddle_tpu.core import config as _cfg
+
+            _cfg.apply_compile_cache()
+
             def fwd(variables, *args):
                 out, _ = self.model.apply(variables, *args, is_train=False)
                 return out

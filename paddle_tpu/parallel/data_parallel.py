@@ -51,6 +51,9 @@ class DataParallel:
         the default leading-dim data sharding — e.g. shard the sequence dim of
         token inputs over the ``seq`` axis: ``P('data', 'seq')`` (sequence
         parallelism; the activation sharding the reference never had)."""
+        from paddle_tpu.core import config as _cfg
+
+        _cfg.apply_compile_cache()
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh if mesh is not None else mesh_mod.default_mesh()

@@ -203,11 +203,11 @@ def test_persistent_compile_cache_flag(tmp_path, rng):
     """flags().compilation_cache_dir routes jit compiles through the
     persistent cache: artifacts appear in the directory."""
     cache_dir = str(tmp_path / "jaxcache")
-    pt.core.config.set_flags(compilation_cache_dir=cache_dir)
+    cfg_mod = pt.core.config
+    prev_applied = cfg_mod._compile_cache_applied
+    cfg_mod._compile_cache_applied = False
     try:
-        import paddle_tpu.executor as ex
-
-        ex._compile_cache_applied = False  # re-apply with this dir
+        pt.core.config.set_flags(compilation_cache_dir=cache_dir)
         exe = pt.Executor()
 
         def net(x):
@@ -222,4 +222,9 @@ def test_persistent_compile_cache_flag(tmp_path, rng):
 
         assert _os.path.isdir(cache_dir) and len(_os.listdir(cache_dir)) >= 1
     finally:
+        # restore GLOBAL jax config — later tests must not write cache
+        # artifacts into this test's tmp dir
         pt.core.config.set_flags(compilation_cache_dir="")
+        jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        cfg_mod._compile_cache_applied = prev_applied
