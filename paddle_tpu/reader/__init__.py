@@ -212,6 +212,77 @@ def xmap_readers(mapper: Callable, reader: Reader, process_num: int, buffer_size
     return xreader
 
 
+def multiprocess_reader(readers: Sequence[Reader], use_pipe: bool = True, queue_size: int = 1000) -> Reader:
+    """Run each reader in its own OS PROCESS, interleaving their samples
+    (reference ``decorator.py:338`` multiprocess_reader) — sidesteps the
+    GIL for CPU-heavy decode, unlike the thread-based ``xmap_readers``.
+    Samples must be picklable; ``use_pipe`` is accepted for API parity
+    (one shared queue serves both modes here). Worker exceptions re-raise
+    in the consumer."""
+    from paddle_tpu.core.enforce import enforce as _enforce
+
+    _enforce(len(readers) > 0, "multiprocess_reader needs at least one reader")
+
+    def combined():
+        import multiprocessing as mp
+        import pickle
+        import queue as _qm
+
+        # fork lets closure readers cross the boundary; workers run only
+        # the reader (no jax/XLA use), so forking after runtime init is
+        # safe here. Platforms without fork (Windows) get the default
+        # context — readers must then be module-level picklables.
+        ctx = mp.get_context("fork") if "fork" in mp.get_all_start_methods() else mp.get_context()
+        q = ctx.Queue(queue_size)
+
+        def work(r):
+            try:
+                for sample in r():
+                    # pickle HERE, not in mp.Queue's feeder thread — a
+                    # feeder-thread pickling error silently drops the item;
+                    # this way it raises into the except and reaches the
+                    # consumer as an error message
+                    q.put(("item", pickle.dumps(sample)))
+            except Exception as e:  # picklable summary, not the traceback
+                q.put(("error", f"{type(e).__name__}: {e}"))
+            finally:
+                q.put(("end", None))
+
+        procs = [ctx.Process(target=work, args=(r,), daemon=True) for r in readers]
+        for p in procs:
+            p.start()
+        finished = 0
+        try:
+            while finished < len(procs):
+                try:
+                    kind, payload = q.get(timeout=1.0)
+                except _qm.Empty:
+                    # a worker killed hard (OOM/segfault) never posts its
+                    # sentinel — detect instead of blocking forever
+                    if not any(p.is_alive() for p in procs) and q.empty():
+                        raise RuntimeError(
+                            "multiprocess_reader: worker process died without "
+                            "finishing (killed or crashed)"
+                        )
+                    continue
+                if kind == "end":
+                    finished += 1
+                elif kind == "error":
+                    raise RuntimeError(f"multiprocess_reader worker failed: {payload}")
+                else:
+                    yield pickle.loads(payload)
+        finally:
+            # early close: workers may be blocked on a full queue — stop
+            # them first, then reap (no multi-second join stall per worker)
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=2)
+
+    return combined
+
+
 def batch(reader: Reader, batch_size: int, drop_last: bool = True) -> Reader:
     """Group samples into lists of batch_size (reference paddle.batch).
     drop_last defaults True on TPU: static shapes make ragged final batches

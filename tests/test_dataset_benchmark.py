@@ -2,6 +2,7 @@
 python/paddle/dataset/tests/*, benchmark/fluid/fluid_benchmark.py driver)."""
 
 import numpy as np
+import pytest
 
 from paddle_tpu import dataset, reader
 from paddle_tpu.benchmark import main as bench_main, parse_args
@@ -144,3 +145,61 @@ def test_benchmark_real_data_mnist():
          "--skip_batch_num", "1", "--use_real_data", "--no_random"]
     )
     assert np.isfinite(result["last_loss"])
+
+
+def test_dataset_tail_voc_sentiment_mq2007():
+    """voc2012 / sentiment / mq2007 readers yield well-formed samples
+    (reference python/paddle/dataset/{voc2012,sentiment,mq2007}.py)."""
+    from paddle_tpu import dataset
+
+    img, seg = next(dataset.voc2012.train()())
+    assert img.ndim == 3 and seg.shape == img.shape[:2]
+
+    words, label = next(dataset.sentiment.train()())
+    assert len(words) > 0 and label in (0, 1)
+    assert len(dataset.sentiment.get_word_dict()) > 0
+
+    sample = next(dataset.mq2007.train(format="pairwise")())
+    assert len(sample) == 2 and sample[0].shape == sample[1].shape
+
+
+def test_multiprocess_reader_interleaves_and_completes():
+    from paddle_tpu import reader
+
+    def make(lo, hi):
+        def r():
+            for i in range(lo, hi):
+                yield i
+        return r
+
+    out = list(reader.multiprocess_reader([make(0, 50), make(100, 150)])())
+    assert sorted(out) == list(range(0, 50)) + list(range(100, 150))
+
+
+def test_multiprocess_reader_propagates_worker_error():
+    from paddle_tpu import reader
+
+    def bad():
+        yield 1
+        raise ValueError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        list(reader.multiprocess_reader([bad])())
+
+
+def test_multiprocess_reader_early_close_fast():
+    """Breaking out early terminates blocked workers promptly."""
+    import time as _t
+
+    from paddle_tpu import reader
+
+    def big():
+        for i in range(100000):
+            yield i
+
+    t0 = _t.time()
+    it = reader.multiprocess_reader([big, big], queue_size=8)()
+    got = [next(it) for _ in range(5)]
+    it.close()
+    assert len(got) == 5
+    assert _t.time() - t0 < 10, "early close stalled"
