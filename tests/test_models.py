@@ -260,3 +260,33 @@ def test_transformer_lm_remat_matches_plain():
         jax.tree_util.tree_leaves(o2.variables.params),
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_transformer_nmt_structural_masking_training_trajectory():
+    """Training trajectories under structural masking (flash flag) are
+    IDENTICAL to the additive-mask path — gradient-level equivalence of
+    kv_len + kernel causality on the NMT transformer."""
+    def run(flag):
+        pt.core.config.set_flags(use_flash_attention=flag)
+        try:
+            spec = models.get_model(
+                "transformer", seq_len=16, src_vocab=64, trg_vocab=64,
+                d_model=32, d_inner=64, num_heads=2, n_layers=1, max_len=32,
+                learning_rate=0.5, warmup_steps=2,
+            )
+            rng = np.random.RandomState(0)
+            batch = spec.synth_batch(4, rng)
+            v = spec.model.init(0, *batch)
+            opt = spec.optimizer()
+            o = opt.create_state(v.params)
+            step = jax.jit(opt.minimize(spec.model))
+            losses = []
+            for i in range(5):
+                out = step(v, o, *batch, rng=jax.random.PRNGKey(i))
+                v, o = out.variables, out.opt_state
+                losses.append(float(out.loss))
+            return losses
+        finally:
+            pt.core.config.set_flags(use_flash_attention=False)
+
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-5)
