@@ -269,23 +269,15 @@ def test_transformer_nmt_structural_masking_training_trajectory():
     def run(flag):
         pt.core.config.set_flags(use_flash_attention=flag)
         try:
+            # dropout must be 0: the flash routing gate rejects training-mode
+            # dropout, and the whole point is to exercise the kernel path
             spec = models.get_model(
                 "transformer", seq_len=16, src_vocab=64, trg_vocab=64,
                 d_model=32, d_inner=64, num_heads=2, n_layers=1, max_len=32,
                 learning_rate=0.5, warmup_steps=2,
+                attn_dropout=0.0, relu_dropout=0.0, residual_dropout=0.0,
             )
-            rng = np.random.RandomState(0)
-            batch = spec.synth_batch(4, rng)
-            v = spec.model.init(0, *batch)
-            opt = spec.optimizer()
-            o = opt.create_state(v.params)
-            step = jax.jit(opt.minimize(spec.model))
-            losses = []
-            for i in range(5):
-                out = step(v, o, *batch, rng=jax.random.PRNGKey(i))
-                v, o = out.variables, out.opt_state
-                losses.append(float(out.loss))
-            return losses
+            return _train_steps(spec, batch_size=4, steps=5)
         finally:
             pt.core.config.set_flags(use_flash_attention=False)
 
