@@ -73,9 +73,12 @@ def default_policy() -> Policy:
 def mxu_operands(*xs):
     """Cast floating operands to the active compute dtype before an MXU op
     (matmul/conv): with ``flags().use_bf16_compute`` this halves the MXU
-    cycle count and HBM traffic for weights/activations; call sites keep
-    f32 accumulation via ``preferred_element_type`` and cast the result
-    back to the caller's dtype. No-op under the FP32 policy."""
+    cycle count and HBM traffic for weights/activations. Matmul call sites
+    keep an f32 result via ``preferred_element_type``; conv call sites over
+    bf16 operands emit a bf16 result instead (the conv transpose rule can't
+    mix an f32 cotangent with bf16 primals) — standard mixed-precision
+    rounding; the MXU still accumulates partial products in f32 internally.
+    No-op under the FP32 policy."""
     p = default_policy()
     return tuple(p.cast_to_compute(x) if x is not None else None for x in xs)
 

@@ -27,6 +27,7 @@ __all__ = [
     "conv2d",
     "conv2d_transpose",
     "depthwise_conv2d",
+    "maxout",
     "pool2d",
     "adaptive_pool2d",
     "batch_norm_infer",
@@ -114,7 +115,10 @@ def conv2d(
         rhs_dilation=_pair(dilation),
         dimension_numbers=dn,
         feature_group_count=groups,
-        preferred_element_type=jnp.float32,
+        # only request f32 output for f32 operands: with bf16 operands the
+        # conv transpose (VJP) rule can't mix the f32 cotangent with bf16
+        # primals, and the MXU accumulates partial products in f32 anyway
+        preferred_element_type=jnp.float32 if xc.dtype == jnp.float32 else None,
     )
     return out.astype(x.dtype)
 
@@ -157,9 +161,20 @@ def conv2d_transpose(
         padding=pads,
         lhs_dilation=(sh, sw),
         dimension_numbers=dn,
-        preferred_element_type=jnp.float32,
+        # see conv2d: no preferred_element_type over bf16 operands
+        preferred_element_type=jnp.float32 if x_c.dtype == jnp.float32 else None,
     )
     return out.astype(x.dtype)
+
+
+def maxout(x, groups: int):
+    """Maxout over channel groups (reference ``maxout_op.cc``): with C input
+    channels (last axis, NHWC here vs the reference's NCHW), output channel
+    ``i`` is ``max_k x[..., i*groups + k]`` and Co = C // groups."""
+    c = x.shape[-1]
+    if c % groups:
+        raise ValueError(f"maxout: channels {c} not divisible by groups {groups}")
+    return jnp.max(x.reshape(x.shape[:-1] + (c // groups, groups)), axis=-1)
 
 
 def pool2d(

@@ -45,6 +45,7 @@ def _flash_fwd_kernel(
     kv dim iterates sequentially per core, so scratch persists across j)."""
     j = pl.program_id(2)
     n_kv = pl.num_programs(2)
+    kv_limit = kvlen_ref[pl.program_id(0), 0] if has_kvlen else None
 
     @pl.when(j == 0)
     def _():
@@ -58,7 +59,7 @@ def _flash_fwd_kernel(
     # blocks entirely past this row's kv_len (padded tails)
     live = (j * block_k <= q_blk * block_q + block_q - 1) if causal else True
     if has_kvlen:
-        live = jnp.logical_and(live, j * block_k < kvlen_ref[0, 0])
+        live = jnp.logical_and(live, j * block_k < kv_limit)
 
     @pl.when(live)
     def _():
@@ -74,7 +75,7 @@ def _flash_fwd_kernel(
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         if has_kvlen:
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(k_pos < kvlen_ref[0, 0], s, NEG_INF)
+            s = jnp.where(k_pos < kv_limit, s, NEG_INF)
 
         m_prev, l_prev = m_ref[:], l_ref[:]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -104,6 +105,7 @@ def _flash_fwd_kernel_resident(
     _, block_q, d = q_ref.shape
     t_kv = k_ref.shape[1]
     q_blk = pl.program_id(1)
+    kv_limit = kvlen_ref[pl.program_id(0), 0] if has_kvlen else None
 
     q = q_ref[0].astype(jnp.float32) * sm_scale
 
@@ -120,7 +122,7 @@ def _flash_fwd_kernel_resident(
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         if has_kvlen:
             k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(k_pos < kvlen_ref[0, 0], s, NEG_INF)
+            s = jnp.where(k_pos < kv_limit, s, NEG_INF)
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)
@@ -137,7 +139,7 @@ def _flash_fwd_kernel_resident(
     else:
         n_kv_used = n_kv
     if has_kvlen:  # fully-padded tail blocks contribute nothing — skip them
-        n_kv_used = jnp.minimum(n_kv_used, pl.cdiv(kvlen_ref[0, 0], block_k))
+        n_kv_used = jnp.minimum(n_kv_used, pl.cdiv(kv_limit, block_k))
     init = (
         jnp.full((block_q, 1), NEG_INF, jnp.float32),
         jnp.zeros((block_q, 1), jnp.float32),
@@ -196,7 +198,7 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
                 pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
                 pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
                 pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, 1), lambda b, i: (b, 0)),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
             ],
             out_specs=[
                 pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -222,7 +224,7 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, 1), lambda b, i, j: (b, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -254,6 +256,7 @@ def _flash_bwd_dkv_kernel(
     i = pl.program_id(2)
     n_q = pl.num_programs(2)
     j = pl.program_id(1)
+    kv_limit = kvlen_ref[pl.program_id(0), 0] if has_kvlen else None
 
     @pl.when(i == 0)
     def _():
@@ -264,7 +267,7 @@ def _flash_bwd_dkv_kernel(
     # kv blocks fully past kv_len contribute zero grads — skip both
     live = (i * block_q + block_q - 1 >= j * block_k) if causal else True
     if has_kvlen:
-        live = jnp.logical_and(live, j * block_k < kvlen_ref[0, 0])
+        live = jnp.logical_and(live, j * block_k < kv_limit)
 
     @pl.when(live)
     def _():
@@ -283,7 +286,7 @@ def _flash_bwd_dkv_kernel(
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         if has_kvlen:
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(k_pos < kvlen_ref[0, 0], s, NEG_INF)
+            s = jnp.where(k_pos < kv_limit, s, NEG_INF)
         p = jnp.exp(s - lse)  # normalized probabilities, [block_q, block_k]
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -311,6 +314,7 @@ def _flash_bwd_dq_kernel(
     j = pl.program_id(2)
     n_kv = pl.num_programs(2)
     i = pl.program_id(1)
+    kv_limit = kvlen_ref[pl.program_id(0), 0] if has_kvlen else None
 
     @pl.when(j == 0)
     def _():
@@ -318,7 +322,7 @@ def _flash_bwd_dq_kernel(
 
     live = (j * block_k <= i * block_q + block_q - 1) if causal else True
     if has_kvlen:
-        live = jnp.logical_and(live, j * block_k < kvlen_ref[0, 0])
+        live = jnp.logical_and(live, j * block_k < kv_limit)
 
     @pl.when(live)
     def _():
@@ -337,7 +341,7 @@ def _flash_bwd_dq_kernel(
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         if has_kvlen:
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(k_pos < kvlen_ref[0, 0], s, NEG_INF)
+            s = jnp.where(k_pos < kv_limit, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -385,7 +389,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
     q_stream = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
     row_stream = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
     kv_fixed = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
-    len_spec3 = pl.BlockSpec((1, 1), lambda b, j, i: (b, 0))
+    len_spec3 = pl.BlockSpec(memory_space=pltpu.SMEM)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(B * H, t_kv // block_k, T // block_q),
