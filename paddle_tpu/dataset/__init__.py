@@ -26,6 +26,7 @@ from paddle_tpu.dataset import flowers  # noqa: F401
 from paddle_tpu.dataset import imdb  # noqa: F401
 from paddle_tpu.dataset import imikolov  # noqa: F401
 from paddle_tpu.dataset import movielens  # noqa: F401
+from paddle_tpu.dataset import wmt14  # noqa: F401
 from paddle_tpu.dataset import wmt16  # noqa: F401
 from paddle_tpu.dataset import conll05  # noqa: F401
 from paddle_tpu.dataset import sentiment  # noqa: F401
@@ -41,6 +42,7 @@ __all__ = [
     "imdb",
     "imikolov",
     "movielens",
+    "wmt14",
     "wmt16",
     "conll05",
     "sentiment",

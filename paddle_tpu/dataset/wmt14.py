@@ -1,0 +1,67 @@
+"""WMT14 fr-en translation (reference ``python/paddle/dataset/wmt14.py``):
+the dataset the reference's ``benchmark/fluid/models/machine_translation.py:212``
+feeds from. Examples are (src_ids, trg_ids, trg_ids_next); unlike wmt16 the
+*source* sentence is wrapped in <s>/<e> too (reference ``wmt14.py:98-99``).
+Cache-or-synthetic design: a local ``cached_npz`` corpus is used when present,
+else a deterministic synthetic corpus with the same id conventions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+__all__ = ["train", "test", "gen", "get_dict"]
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+START_IDX, END_IDX, UNK_IDX = 0, 1, 2
+
+
+def get_dict(dict_size: int, reverse: bool = True):
+    """Source+target word dicts (reference ``wmt14.py:155``). Synthetic vocab
+    mirrors the id layout: 0=<s>, 1=<e>, 2=<unk>."""
+    src = {START: START_IDX, END: END_IDX, UNK: UNK_IDX}
+    trg = dict(src)
+    for i in range(3, dict_size):
+        src[f"fr{i}"] = i
+        trg[f"en{i}"] = i
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg
+
+
+def _reader_creator(split: str, dict_size: int, n: int):
+    def reader():
+        cache = common.cached_npz("wmt14", split)
+        if cache is not None:
+            for s, t, tn in zip(cache["src"], cache["trg"], cache["trg_next"]):
+                yield list(s), list(t), list(tn)
+            return
+        rng = np.random.RandomState(common.synthetic_seed("wmt14", split))
+        for _ in range(n):
+            length = int(rng.randint(4, 20))
+            words = rng.randint(3, dict_size, length).tolist()
+            src = [START_IDX] + words + [END_IDX]
+            trg = [3 + (5 * w + 11) % (dict_size - 3) for w in words]
+            trg_next = trg + [END_IDX]
+            trg_in = [START_IDX] + trg
+            yield src, trg_in, trg_next
+
+    return reader
+
+
+def train(dict_size: int = 30000):
+    return _reader_creator("train", dict_size, 2048)
+
+
+def test(dict_size: int = 30000):
+    return _reader_creator("test", dict_size, 256)
+
+
+def gen(dict_size: int = 30000):
+    """Held-out generation split (reference ``wmt14.py:149``)."""
+    return _reader_creator("gen", dict_size, 256)

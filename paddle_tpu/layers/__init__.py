@@ -457,6 +457,7 @@ from paddle_tpu.layers.io_layers import __all__ as _io_all
 
 # functional op re-exports under their fluid names
 from paddle_tpu.ops.nn import (  # noqa: F401
+    maxout,
     multiplex,
     pad_constant_like,
     rank_loss,

@@ -67,6 +67,115 @@ class Average(MetricBase):
         return self.total / max(self.count, 1e-12)
 
 
+class Precision(MetricBase):
+    """Binary precision = tp / (tp + fp) over accumulated batches
+    (reference ``metrics.py:208`` Precision)."""
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds).reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        pred_pos = np.rint(preds).astype(np.int64) == 1
+        label_pos = labels.astype(np.int64) == 1
+        self.tp += int(np.sum(pred_pos & label_pos))
+        self.fp += int(np.sum(pred_pos & ~label_pos))
+
+    def eval(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Recall(MetricBase):
+    """Binary recall = tp / (tp + fn) over accumulated batches
+    (reference ``metrics.py:255`` Recall)."""
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds).reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        pred_pos = np.rint(preds).astype(np.int64) == 1
+        label_pos = labels.astype(np.int64) == 1
+        self.tp += int(np.sum(pred_pos & label_pos))
+        self.fn += int(np.sum(~pred_pos & label_pos))
+
+    def eval(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+
+class ChunkEvaluator(MetricBase):
+    """Accumulates ``ops.chunk_eval`` per-batch counts into pass-level
+    precision/recall/F1 (reference ``metrics.py:355`` ChunkEvaluator)."""
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self.num_infer_chunks += int(np.sum(num_infer_chunks))
+        self.num_label_chunks += int(np.sum(num_label_chunks))
+        self.num_correct_chunks += int(np.sum(num_correct_chunks))
+
+    def eval(self):
+        precision = (
+            self.num_correct_chunks / self.num_infer_chunks
+            if self.num_infer_chunks
+            else 0.0
+        )
+        recall = (
+            self.num_correct_chunks / self.num_label_chunks
+            if self.num_label_chunks
+            else 0.0
+        )
+        f1 = (
+            2 * precision * recall / (precision + recall)
+            if self.num_correct_chunks
+            else 0.0
+        )
+        return precision, recall, f1
+
+
+class DetectionMAP(MetricBase):
+    """Weighted running mean of per-batch ``ops.detection_map`` values
+    (reference ``metrics.py:481`` DetectionMAP)."""
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        self.value += float(np.sum(value))
+        self.weight += float(weight)
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("no updates to DetectionMAP metric")
+        return self.value / self.weight
+
+
 class EditDistance(MetricBase):
     def __init__(self, name: str = ""):
         super().__init__(name)

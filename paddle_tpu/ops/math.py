@@ -93,6 +93,8 @@ __all__ = [
     "linspace",
     "uniform_random",
     "gaussian_random",
+    "uniform_random_batch_size_like",
+    "gaussian_random_batch_size_like",
     "truncated_gaussian_random",
     "sampling_id",
     "isfinite",
@@ -533,6 +535,36 @@ def gaussian_random(shape, dtype="float32", mean=0.0, std=1.0, key=None):
 
     key = key if key is not None else framework.next_rng_key()
     return mean + std * jax.random.normal(key, tuple(shape), dtype=_d.convert(dtype))
+
+
+def _batch_size_like_shape(input, shape, input_dim_idx, output_dim_idx):
+    shp = [int(s) for s in shape]
+    shp[output_dim_idx] = input.shape[input_dim_idx]
+    return tuple(shp)
+
+
+def uniform_random_batch_size_like(
+    input, shape, dtype="float32", input_dim_idx=0, output_dim_idx=0,
+    min=-1.0, max=1.0, key=None,  # noqa: A002
+):
+    """Uniform tensor whose ``output_dim_idx`` dim tracks ``input``'s
+    ``input_dim_idx`` dim (reference ``uniform_random_batch_size_like_op.cc``)."""
+    return uniform_random(
+        _batch_size_like_shape(input, shape, input_dim_idx, output_dim_idx),
+        dtype=dtype, min=min, max=max, key=key,
+    )
+
+
+def gaussian_random_batch_size_like(
+    input, shape, dtype="float32", input_dim_idx=0, output_dim_idx=0,
+    mean=0.0, std=1.0, key=None,
+):
+    """Gaussian tensor whose ``output_dim_idx`` dim tracks ``input``'s
+    ``input_dim_idx`` dim (reference ``gaussian_random_batch_size_like_op.cc``)."""
+    return gaussian_random(
+        _batch_size_like_shape(input, shape, input_dim_idx, output_dim_idx),
+        dtype=dtype, mean=mean, std=std, key=key,
+    )
 
 
 def truncated_gaussian_random(shape, dtype="float32", mean=0.0, std=1.0, key=None):

@@ -521,3 +521,77 @@ def test_open_files_recordio_roundtrip(tmp_path, rng):
     assert len(items) == 3
     np.testing.assert_allclose(items[0][0], arr)
     np.testing.assert_array_equal(items[0][1], lab)
+
+
+# ---------------------------------------------------------------------------
+# round-3 catalog tail: maxout + *_batch_size_like randoms (VERDICT r2 item 5)
+# ---------------------------------------------------------------------------
+
+
+def test_maxout(rng):
+    x = rng.randn(2, 3, 3, 6).astype(np.float32)
+    out = np.asarray(on.maxout(jnp.asarray(x), groups=2))
+    ref = x.reshape(2, 3, 3, 3, 2).max(-1)
+    assert out.shape == (2, 3, 3, 3)
+    np.testing.assert_allclose(out, ref)
+    with pytest.raises(ValueError):
+        on.maxout(jnp.asarray(x), groups=4)
+
+
+def test_random_batch_size_like(rng):
+    ref_in = jnp.zeros((5, 7))
+    u = layers.uniform_random_batch_size_like(
+        ref_in, [0, 3], min=2.0, max=4.0, key=jax.random.PRNGKey(0)
+    )
+    assert u.shape == (5, 3)
+    assert float(u.min()) >= 2.0 and float(u.max()) <= 4.0
+    g = layers.gaussian_random_batch_size_like(
+        ref_in, [4, 0, 2], input_dim_idx=1, output_dim_idx=1,
+        mean=1.0, std=0.1, key=jax.random.PRNGKey(1),
+    )
+    assert g.shape == (4, 7, 2)
+    assert abs(float(g.mean()) - 1.0) < 0.1
+
+
+# ---------------------------------------------------------------------------
+# metric accumulators tail (reference metrics.py:208-481)
+# ---------------------------------------------------------------------------
+
+
+def test_precision_recall_metrics():
+    from paddle_tpu import metrics as M
+
+    p, r = M.Precision(), M.Recall()
+    preds = np.array([1, 1, 0, 1, 0, 0], np.float32)
+    labels = np.array([1, 0, 0, 1, 1, 0], np.int64)
+    p.update(preds, labels)
+    r.update(preds, labels)
+    # tp=2 fp=1 fn=1
+    assert p.eval() == pytest.approx(2 / 3)
+    assert r.eval() == pytest.approx(2 / 3)
+    p.reset()
+    assert p.eval() == 0.0
+
+
+def test_chunk_evaluator_metric():
+    from paddle_tpu import metrics as M
+
+    m = M.ChunkEvaluator()
+    m.update(10, 8, 6)
+    m.update(np.array([5]), np.array([7]), np.array([4]))
+    prec, rec, f1 = m.eval()
+    assert prec == pytest.approx(10 / 15)
+    assert rec == pytest.approx(10 / 15)
+    assert f1 == pytest.approx(2 * prec * rec / (prec + rec))
+
+
+def test_detection_map_metric():
+    from paddle_tpu import metrics as M
+
+    m = M.DetectionMAP()
+    m.update(0.5, 2)
+    m.update(np.array(0.7), 2)
+    assert m.eval() == pytest.approx(1.2 / 4)
+    m.reset()
+    with pytest.raises(ValueError):
+        m.eval()
