@@ -26,6 +26,7 @@ import jax
 _events: dict[str, list[float]] = defaultdict(list)
 # correlated spans for the timeline export: (name, start_us, dur_us, tid)
 _spans: list[tuple[str, float, float, int]] = []
+_MAX_SPANS = 1_000_000
 _enabled: bool = False
 
 
@@ -41,7 +42,8 @@ def record_event(name: str) -> Iterator[None]:
         yield
     t1 = time.perf_counter()
     _events[name].append(t1 - t0)
-    _spans.append((name, t0 * 1e6, (t1 - t0) * 1e6, threading.get_ident()))
+    if len(_spans) < _MAX_SPANS:  # bound timeline memory on long runs
+        _spans.append((name, t0 * 1e6, (t1 - t0) * 1e6, threading.get_ident()))
 
 
 def enable_profiler() -> None:

@@ -60,10 +60,23 @@ def _storage_dtype(arr: np.ndarray):
     if arr.dtype == ml_dtypes.bfloat16:
         return "bf16", arr.tobytes()
     if np.issubdtype(arr.dtype, np.integer) or arr.dtype == np.bool_:
-        if arr.dtype == np.int64:
+        if arr.dtype == np.int8 or arr.dtype == np.bool_:
+            return "i8", arr.astype(np.int8).tobytes()
+        # the predictor is f32-universal (csrc/predictor.cc loads i32/i64 via
+        # static_cast<float>), so ANY stored integer must be exactly
+        # representable in f32 — enforce the 2^24 bound at export time or
+        # gather indices/ids would silently misindex. Check on the original
+        # dtype (uint64 would wrap under a premature int64 cast).
+        if arr.size:
+            lo, hi = int(arr.min()), int(arr.max())
+            if hi >= (1 << 24) or lo <= -(1 << 24):
+                raise ValueError(
+                    f"integer weight {arr.dtype} has values outside ±2^24, "
+                    "not exactly representable in the native predictor's "
+                    "f32 compute convention"
+                )
+        if arr.dtype in (np.int64, np.uint64, np.uint32):
             return "i64", arr.astype(np.int64).tobytes()
-        if arr.dtype == np.int8:
-            return "i8", arr.tobytes()
         return "i32", arr.astype(np.int32).tobytes()
     return "f32", arr.astype(np.float32).tobytes()
 

@@ -38,6 +38,10 @@ class StepOutput(NamedTuple):
     opt_state: OptState
     loss: jax.Array
     outputs: Any
+    # set (scalar bool array) when flags().check_nan_inf was on at trace
+    # time: in-step isfinite over loss+grads — the compiled-in analogue of
+    # the reference's per-op FLAGS_check_nan_inf (operator.cc:725-737)
+    finite: Any = None
 
 
 class Optimizer:
@@ -149,7 +153,16 @@ class Optimizer:
                 ) if new_state else new_state
             info = param_info or model.param_info
             new_params, new_opt = self.apply_gradients(params, grads, opt_state, info)
-            return StepOutput(Variables(new_params, new_state), new_opt, loss, outputs)
+            finite = None
+            from paddle_tpu.core import config as _cfg
+
+            if _cfg.flags().check_nan_inf:
+                finite = jnp.isfinite(loss)
+                for g in jax.tree_util.tree_leaves(grads):
+                    finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+            return StepOutput(
+                Variables(new_params, new_state), new_opt, loss, outputs, finite
+            )
 
         def step_fn(variables: Variables, opt_state: OptState, *batch, rng=None):
             params, state = variables.params, variables.state
@@ -265,15 +278,15 @@ class Adam(Optimizer):
 
 def _name_excluded(name: str, tokens: Tuple[str, ...]) -> bool:
     """Decay-exclusion matching: tokens without '/' match the LEAF name
-    (exact, or substring for multi-char tokens) so scope components like
-    'block_0' can't trip the 'b' token; tokens containing '/' match
-    anywhere in the full scoped name for whole-scope exclusions."""
+    EXACTLY (so a trainable 'logit_scale' weight is not silently swept up by
+    the 'scale' token); tokens containing '/' match anywhere in the full
+    scoped name for whole-scope exclusions."""
     leaf = name.rsplit("/", 1)[-1]
     for tok in tokens:
         if "/" in tok:
             if tok in name:
                 return True
-        elif tok == leaf or (len(tok) > 1 and tok in leaf):
+        elif tok == leaf:
             return True
     return False
 

@@ -27,7 +27,7 @@ import numpy as np
 from paddle_tpu import checkpoint as ckpt_mod
 from paddle_tpu.checkpoint import CheckpointConfig
 from paddle_tpu.core import logging as ptlog
-from paddle_tpu.core.enforce import enforce
+from paddle_tpu.core.enforce import EnforceError, enforce
 from paddle_tpu.executor import Executor
 from paddle_tpu.framework import Model, Variables
 from paddle_tpu.optimizer import Optimizer, OptState, StepOutput
@@ -193,6 +193,11 @@ class Trainer:
                     begin_ev = BeginStepEvent(epoch_id, step_id)
                     handler(begin_ev)
                     out = self._run_step(batch)
+                    if out.finite is not None and not bool(out.finite):
+                        raise EnforceError(
+                            f"NaN/Inf in loss or gradients at epoch {epoch_id} "
+                            f"step {step_id} (check_nan_inf)"
+                        )
                     self.variables, self.opt_state = out.variables, out.opt_state
                     self.global_step += 1
                     # honoring fetch_metrics avoids a host sync per step
@@ -214,7 +219,17 @@ class Trainer:
             if self.checkpoint_cfg is not None and getattr(self.checkpoint_cfg, "async_save", False):
                 from paddle_tpu import checkpoint_sharded as cks
 
-                cks.wait_pending_save()  # train() returning => saves durable
+                import sys as _sys
+
+                unwinding = _sys.exc_info()[1] is not None
+                try:
+                    cks.wait_pending_save()  # train() returning => saves durable
+                except Exception as e:
+                    if not unwinding:  # clean exit: surface it — "train()
+                        raise  # returned" must imply a durable save
+                    # the loop is already unwinding with its own exception —
+                    # log the writer failure instead of masking the cause
+                    ptlog.error("async checkpoint writer failed during train() exit: %s", e)
 
     # -- preemption (SURVEY §5.3 failure detection / recovery) --------------
     def _install_preemption_handlers(self):
@@ -358,5 +373,7 @@ class Trainer:
     def stop(self):
         from paddle_tpu import checkpoint_sharded as cks
 
-        cks.wait_pending_save()  # last async checkpoint must be durable
-        self.exe.close()
+        try:
+            cks.wait_pending_save()  # last async checkpoint must be durable
+        finally:
+            self.exe.close()  # a failed writer must not leak the executor
