@@ -102,11 +102,9 @@ class DataParallel:
             for b in batch
         )
 
-    def put_batch(self, *batch):
-        """Shard a global host batch across the mesh (the per-device feed
-        split of ParallelExecutor.run, parallel_executor.py:173). Validates
-        each arg dim against the mesh-axis sizes its spec shards it over."""
-        shards = self._batch_shardings(batch)
+    def _validate_batch(self, batch, shards):
+        """Friendly divisibility check of each arg dim against the mesh-axis
+        sizes its spec shards it over (beats XLA's uneven-sharding error)."""
         for b, s in zip(batch, shards):
             shape = jax.numpy.shape(b)
             for dim, axes in enumerate(s.spec[: len(shape)]):
@@ -121,27 +119,77 @@ class DataParallel:
                     f"mesh axes {axes} (size {size}) (static shapes: drop or "
                     "pad the last partial batch)",
                 )
+
+    def put_batch(self, *batch):
+        """Shard a global host batch across the mesh (the per-device feed
+        split of ParallelExecutor.run, parallel_executor.py:173)."""
+        shards = self._batch_shardings(batch)
+        self._validate_batch(batch, shards)
         return tuple(jax.device_put(b, s) for b, s in zip(batch, shards))
+
+    def _state_shardings(self, variables: Variables, opt_state: OptState):
+        """Sharding pytrees matching (variables, opt_state): params/slots per
+        their annotated specs, everything else replicated."""
+        p_sh = param_shardings(self.mesh, self.model.param_info, variables.params)
+        rep = replicated(self.mesh)
+        var_sh = Variables(
+            dict(p_sh), jax.tree_util.tree_map(lambda _: rep, variables.state)
+        )
+        opt_sh = OptState(
+            step=rep,
+            slots={
+                s: {k: p_sh[k] for k in d} for s, d in opt_state.slots.items()
+            },
+        )
+        return var_sh, opt_sh
 
     # -- compiled steps -----------------------------------------------------
     def step(self, variables: Variables, opt_state: OptState, *batch, rng=None) -> StepOutput:
+        """One compiled data-parallel train step. The jit carries explicit
+        ``in_shardings`` built from ``batch_specs`` (default: leading-dim
+        ``data`` sharding), so a raw host-numpy batch is fed SHARDED across
+        the mesh — not silently replicated — matching the per-device feed
+        split of ``FeedTensorsIntoLocalScopes``
+        (``framework/parallel_executor.cc:330``). ``put_batch`` first is still
+        the efficient path (it also validates divisibility)."""
         if self._step_fn is None:
             raw = self.optimizer.minimize(self.model, loss_index=self.loss_index)
+
+            def positional(variables, opt_state, rng, *b):
+                return raw(variables, opt_state, *b, rng=rng)
+
             donate = (0, 1) if self.donate else ()
-            self._step_fn = jax.jit(raw, donate_argnums=donate)
+            var_sh, opt_sh = self._state_shardings(variables, opt_state)
+            rep = replicated(self.mesh)
+            in_sh = (var_sh, opt_sh, rep) + self._batch_shardings(batch)
+            # pin outputs too: without this XLA may propagate a different
+            # sharding onto updated params (e.g. expert-sharded router
+            # weights) and the NEXT step's declared in_shardings would
+            # reject them. loss/outputs/finite replicate — FetchOpHandle
+            # gathered per-device outputs the same way (fetch_op_handle.cc)
+            out_sh = StepOutput(var_sh, opt_sh, rep, rep, rep)
+            self._step_fn = jax.jit(
+                positional, donate_argnums=donate, in_shardings=in_sh,
+                out_shardings=out_sh,
+            )
+        self._validate_batch(batch, self._batch_shardings(batch))
         with self.mesh:
-            return self._step_fn(variables, opt_state, *batch, rng=rng)
+            return self._step_fn(variables, opt_state, rng, *batch)
 
     def eval_step(self, variables: Variables, *batch, rng=None):
         if self._eval_fn is None:
 
-            def raw(variables, *b, rng=None):
+            def raw(variables, rng, *b):
                 out, _ = self.model.apply(variables, *b, rng=rng, is_train=False)
                 return out
 
-            self._eval_fn = jax.jit(raw)
+            var_sh, _ = self._state_shardings(
+                variables, OptState(step=jax.numpy.zeros(()), slots={})
+            )
+            in_sh = (var_sh, replicated(self.mesh)) + self._batch_shardings(batch)
+            self._eval_fn = jax.jit(raw, in_shardings=in_sh)
         with self.mesh:
-            return self._eval_fn(variables, *batch, rng=rng)
+            return self._eval_fn(variables, rng, *batch)
 
     @property
     def num_devices(self) -> int:

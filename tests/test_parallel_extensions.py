@@ -339,3 +339,65 @@ def test_moe_top2_identical_experts_equal_dense(rng):
     # gates renormalize over the pair -> exactly the dense FFN
     np.testing.assert_allclose(np.asarray(out), ffn, rtol=1e-4, atol=1e-5)
     assert np.isfinite(float(aux))
+
+
+def test_dataparallel_enforces_input_shardings(rng):
+    """VERDICT r2 item 4: a raw host-numpy batch (no put_batch) must be fed
+    SHARDED on the data axis — not silently replicated — and the compiled
+    step must contain the gradient all-reduce (the XLA form of
+    AllReduceOpHandle, ``details/all_reduce_op_handle.cc:48``)."""
+    from paddle_tpu import models
+    from paddle_tpu.parallel.data_parallel import DataParallel
+
+    spec = models.get_model("mnist")
+    dp = DataParallel(spec.model, spec.optimizer(), mesh=make_mesh(data=-1))
+    batch = spec.synth_batch(16, rng)
+    variables, opt_state = dp.init(0, *batch)
+
+    out = dp.step(variables, opt_state, *batch, rng=jax.random.PRNGKey(0))
+    assert np.isfinite(float(out.loss))
+
+    lowered = dp._step_fn.lower(
+        variables, opt_state, jax.random.PRNGKey(0), *batch
+    ).compile()
+    flat_in = lowered.input_shardings[0]
+    # the last two inputs are (images, labels): both sharded on 'data'
+    for s in flat_in[-2:]:
+        assert "data" in str(s.spec), f"batch input not data-sharded: {s}"
+    assert "all-reduce" in lowered.as_text()
+
+    # rng=None replicated-path still compiles and runs
+    out2 = dp.step(out.variables, out.opt_state, *batch, rng=None)
+    assert np.isfinite(float(out2.loss))
+
+
+def test_dp8_vs_dp1_loss_trajectory(rng):
+    """VERDICT r2 item 9 / reference ``parallel_executor_test_base.py``: the
+    same model trained dp=8 vs dp=1 must follow the same loss trajectory
+    over >= 10 steps (mean-grad psum == AllReduce+ScaleLossGrad)."""
+    from paddle_tpu import models
+    from paddle_tpu.parallel.data_parallel import DataParallel
+
+    spec = models.get_model("mnist")
+    batch = spec.synth_batch(16, rng)
+
+    v = spec.model.init(0, *batch)
+    opt = spec.optimizer()
+    step = jax.jit(opt.minimize(spec.model))
+    v1, o1 = v, opt.create_state(v.params)
+    base = []
+    for i in range(12):
+        out = step(v1, o1, *[jnp.asarray(b) for b in batch], rng=jax.random.PRNGKey(i))
+        v1, o1 = out.variables, out.opt_state
+        base.append(float(out.loss))
+
+    dp = DataParallel(spec.model, spec.optimizer(), mesh=make_mesh(data=-1))
+    v8, o8 = dp.init(0, *batch, variables=v)
+    dp8 = []
+    for i in range(12):
+        out = dp.step(v8, o8, *batch, rng=jax.random.PRNGKey(i))
+        v8, o8 = out.variables, out.opt_state
+        dp8.append(float(out.loss))
+
+    assert base[-1] < base[0]  # training is actually moving
+    np.testing.assert_allclose(base, dp8, rtol=5e-4, atol=1e-5)
