@@ -11,14 +11,40 @@
 #include "ops.h"
 
 #include <algorithm>
+#include <atomic>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <limits>
+#include <thread>
 
 namespace ptnative {
 
 // ---------------------------------------------------------------- helpers
+
+// Static-partition parallel_for over [0, n): the serving-throughput analogue
+// of the reference's ThreadPool (framework/threadpool.h:49). Grain keeps tiny
+// problems single-threaded so per-op dispatch stays cheap.
+void parallel_for(int64_t n, int64_t grain,
+                  const std::function<void(int64_t, int64_t)>& body) {
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t max_threads = hw ? static_cast<int64_t>(hw) : 1;
+  int64_t threads = std::min<int64_t>(max_threads, (n + grain - 1) / grain);
+  if (threads <= 1) {
+    body(0, n);
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(static_cast<size_t>(threads - 1));
+  int64_t chunk = (n + threads - 1) / threads;
+  for (int64_t t = 1; t < threads; ++t) {
+    int64_t lo = t * chunk, hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    pool.emplace_back([&body, lo, hi] { body(lo, hi); });
+  }
+  body(0, std::min(n, chunk));
+  for (auto& th : pool) th.join();
+}
 
 static std::vector<int64_t> unravel(int64_t idx, const std::vector<int64_t>& shape) {
   std::vector<int64_t> out(shape.size());
@@ -181,21 +207,31 @@ NDArray dot_general(const NDArray& lhs, const NDArray& rhs,
   out.shape = out_shape.empty() ? std::vector<int64_t>{} : out_shape;
   out.data.assign(static_cast<size_t>(std::max<int64_t>(out.numel(), 1)), 0.0f);
 
-  // R viewed as [B, N, K]; compute out[b, m, n] = sum_k L[b,m,k] * R[b,n,k]
-  for (int64_t b = 0; b < B; ++b) {
-    const float* Lp = L.data.data() + b * M * K;
-    const float* Rp = R.data.data() + b * N * K;
-    float* Op = out.data.data() + b * M * N;
-    for (int64_t m = 0; m < M; ++m) {
-      for (int64_t n = 0; n < N; ++n) {
-        float acc = 0.0f;
-        const float* lrow = Lp + m * K;
-        const float* rrow = Rp + n * K;
-        for (int64_t k = 0; k < K; ++k) acc += lrow[k] * rrow[k];
-        Op[m * N + n] = acc;
+  // R viewed as [B, N, K]; compute out[b, m, n] = sum_k L[b,m,k] * R[b,n,k].
+  // Both operands are K-contiguous after arrange(), so the inner dot
+  // auto-vectorizes; rows are threaded across B*M and the n-loop is blocked
+  // so the active R panel stays in cache.
+  const float* Ld = L.data.data();
+  const float* Rd = R.data.data();
+  float* Od = out.data.data();
+  constexpr int64_t NB = 64;  // n-panel: NB rows of R (NB*K floats) per pass
+  parallel_for(B * M, 8, [&](int64_t lo, int64_t hi) {
+    for (int64_t bm = lo; bm < hi; ++bm) {
+      int64_t b = bm / M, m = bm % M;
+      const float* lrow = Ld + (b * M + m) * K;
+      const float* Rp = Rd + b * N * K;
+      float* orow = Od + (b * M + m) * N;
+      for (int64_t n0 = 0; n0 < N; n0 += NB) {
+        int64_t n1 = std::min(N, n0 + NB);
+        for (int64_t n = n0; n < n1; ++n) {
+          const float* rrow = Rp + n * K;
+          float acc = 0.0f;
+          for (int64_t k = 0; k < K; ++k) acc += lrow[k] * rrow[k];
+          orow[n] = acc;
+        }
       }
     }
-  }
+  });
   return out;
 }
 
@@ -212,8 +248,53 @@ NDArray conv2d_nhwc(const NDArray& x, const NDArray& w,
   int64_t OW = (W + pad_lo[1] + pad_hi[1] - KW) / strides[1] + 1;
   int64_t co_per_g = CO / groups;
   NDArray out({Nb, OH, OW, CO});
-  for (int64_t n = 0; n < Nb; ++n)
-    for (int64_t oh = 0; oh < OH; ++oh)
+  if (groups == 1) {
+    // im2col + GEMM (the reference's gemm-conv path,
+    // operators/math/im2col.cc): patches [Nb*OH*OW, KH*KW*CI] are built
+    // per-thread row range, each multiplied against the K-contiguous
+    // transposed filter panel [CO, KH*KW*CI].
+    const int64_t K = KH * KW * CI;
+    std::vector<float> wt(static_cast<size_t>(CO * K));
+    for (int64_t k = 0; k < K; ++k)
+      for (int64_t oc = 0; oc < CO; ++oc) wt[oc * K + k] = w.data[k * CO + oc];
+    const int64_t rows = Nb * OH * OW;
+    parallel_for(rows, 4, [&](int64_t lo, int64_t hi) {
+      std::vector<float> patch(static_cast<size_t>(K));
+      for (int64_t r = lo; r < hi; ++r) {
+        int64_t ow = r % OW, oh = (r / OW) % OH, n = r / (OW * OH);
+        float* p = patch.data();
+        for (int64_t kh = 0; kh < KH; ++kh) {
+          int64_t ih = oh * strides[0] + kh - pad_lo[0];
+          if (ih < 0 || ih >= H) {
+            std::memset(p, 0, sizeof(float) * KW * CI);
+            p += KW * CI;
+            continue;
+          }
+          for (int64_t kw = 0; kw < KW; ++kw) {
+            int64_t iw = ow * strides[1] + kw - pad_lo[1];
+            if (iw < 0 || iw >= W) {
+              std::memset(p, 0, sizeof(float) * CI);
+            } else {
+              std::memcpy(p, &x.data[((n * H + ih) * W + iw) * C],
+                          sizeof(float) * CI);
+            }
+            p += CI;
+          }
+        }
+        float* orow = &out.data[static_cast<size_t>(r) * CO];
+        for (int64_t oc = 0; oc < CO; ++oc) {
+          const float* wrow = &wt[oc * K];
+          float acc = 0.0f;
+          for (int64_t k = 0; k < K; ++k) acc += patch[k] * wrow[k];
+          orow[oc] = acc;
+        }
+      }
+    });
+    return out;
+  }
+  parallel_for(Nb * OH, 1, [&](int64_t lo, int64_t hi) {
+    for (int64_t noh = lo; noh < hi; ++noh) {
+      int64_t n = noh / OH, oh = noh % OH;
       for (int64_t ow = 0; ow < OW; ++ow)
         for (int64_t g = 0; g < groups; ++g)
           for (int64_t oc = 0; oc < co_per_g; ++oc) {
@@ -233,6 +314,8 @@ NDArray conv2d_nhwc(const NDArray& x, const NDArray& w,
             }
             out.data[((n * OH + oh) * OW + ow) * CO + g * co_per_g + oc] = acc;
           }
+    }
+  });
   return out;
 }
 

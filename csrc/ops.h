@@ -8,6 +8,10 @@
 
 namespace ptnative {
 
+// threaded static-partition loop over [0, n) (ThreadPool parity)
+void parallel_for(int64_t n, int64_t grain,
+                  const std::function<void(int64_t, int64_t)>& body);
+
 NDArray transpose(const NDArray& x, const std::vector<int64_t>& perm);
 NDArray reshape(const NDArray& x, const std::vector<int64_t>& shape);
 NDArray broadcast_in_dim(const NDArray& x, const std::vector<int64_t>& out_shape,
