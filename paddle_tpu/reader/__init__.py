@@ -222,6 +222,13 @@ def multiprocess_reader(readers: Sequence[Reader], use_pipe: bool = True, queue_
     from paddle_tpu.core.enforce import enforce as _enforce
 
     _enforce(len(readers) > 0, "multiprocess_reader needs at least one reader")
+    if not use_pipe:
+        from paddle_tpu.core import logging as _ptlog
+
+        _ptlog.warning(
+            "multiprocess_reader(use_pipe=False): pipe/queue selection is a "
+            "no-op here — one shared mp.Queue serves both modes"
+        )
 
     def combined():
         import multiprocessing as mp
