@@ -18,6 +18,9 @@
 // bf16 weights are half-size on disk and widened on load; integer
 // constants load exactly (see ndarray.h on the f32 compute convention).
 
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
 #include <cstdint>
 #include <cstring>
 #include <fstream>
@@ -376,8 +379,23 @@ int pt_predictor_run(PTPredictor* p, const float** inputs, int n_inputs) {
       arr.data.assign(inputs[i], inputs[i] + arr.numel());
       locals.emplace(p->prog->inputs[i].first, std::move(arr));
     }
-    for (const auto& ins : p->prog->instrs) {
-      locals[ins.out] = ptnative::run_instr(ins, env);
+    static const bool profile = std::getenv("PT_NATIVE_PROFILE") != nullptr;
+    if (profile) {
+      std::map<std::string, double> per_prim;
+      for (const auto& ins : p->prog->instrs) {
+        auto t0 = std::chrono::steady_clock::now();
+        locals[ins.out] = ptnative::run_instr(ins, env);
+        per_prim[ins.prim] +=
+            std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+                .count();
+      }
+      for (const auto& kv : per_prim)
+        std::fprintf(stderr, "PT_NATIVE_PROFILE %-24s %8.1f ms\n",
+                     kv.first.c_str(), kv.second * 1e3);
+    } else {
+      for (const auto& ins : p->prog->instrs) {
+        locals[ins.out] = ptnative::run_instr(ins, env);
+      }
     }
     p->last_outputs.clear();
     for (int id : p->prog->outputs) p->last_outputs.push_back(env.at(id));

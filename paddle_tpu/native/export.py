@@ -91,6 +91,13 @@ class _Emitter:
         # inlining instead of aliasing the first call's results
         self.scopes: List[Dict[jcore.Var, int]] = [{}]
         self.next_id = 0
+        # constant folding: id -> known numpy value, materialized as a
+        # `const` line only on first use by a non-folded op (so folded-away
+        # weights — e.g. BN stats after fuse_batch_norm — never reach
+        # weights.bin, and const-only subexpressions cost nothing at runtime)
+        self.known: Dict[int, np.ndarray] = {}
+        self.uniform: Dict[int, float] = {}  # op-result ids known uniform
+        self._materialized: set = set()
 
     def vid(self, var) -> int:
         for scope in reversed(self.scopes):
@@ -114,7 +121,13 @@ class _Emitter:
         return self.next_id - 1
 
     def const(self, value) -> int:
-        arr = np.asarray(value)
+        """Lazily-known constant: records the value, materializes on use."""
+        cid = self.fresh()
+        self.known[cid] = np.asarray(value)
+        return cid
+
+    def _materialize(self, cid: int) -> None:
+        arr = self.known[cid]
         if arr.dtype.kind not in "biuf" and str(arr.dtype) != "bfloat16":
             arr = arr.astype(np.float32)
         dtag, payload = _storage_dtype(np.ascontiguousarray(arr))
@@ -122,7 +135,6 @@ class _Emitter:
             pad = 4 - self.weight_offset % 4
             self.weights.append(b"\x00" * pad)
             self.weight_offset += pad
-        cid = self.fresh()
         self.lines.append(
             f"const {cid} {self.weight_offset} {arr.ndim} "
             + " ".join(str(d) for d in arr.shape)
@@ -130,9 +142,15 @@ class _Emitter:
         )
         self.weights.append(payload)
         self.weight_offset += len(payload)
+        self._materialized.add(cid)
+
+    def use(self, cid: int) -> int:
+        if cid in self.known and cid not in self._materialized:
+            self._materialize(cid)
         return cid
 
     def op(self, prim: str, out: int, ins: Sequence[int], attrs: Dict[str, object] = None, fval=None):
+        ins = [self.use(i) for i in ins]
         parts = []
         for k, v in (attrs or {}).items():
             if isinstance(v, (list, tuple)):
@@ -155,6 +173,126 @@ def _in_ids(em: _Emitter, eqn) -> List[int]:
         else:
             ids.append(em.vid(v))
     return ids
+
+
+# --- export-time constant folding + algebraic identity elimination ---------
+# After transpiler.inference.fuse_batch_norm the BN weights are identities;
+# XLA folds the leftover arithmetic away at compile time, but the native
+# interpreter executes the program as written — so the exporter must do the
+# folding (the analogue of the reference inference_transpiler's op-graph
+# rewrite, inference_transpiler.py _fuse_bn).
+
+_FOLD_NUMEL_CAP = 1 << 16  # don't materialize folded constants bigger than this
+
+_FOLD_UNARY = {
+    "neg": lambda x: -x,
+    "abs": np.abs,
+    "exp": np.exp,
+    "log": np.log,
+    "sqrt": np.sqrt,
+    "rsqrt": lambda x: 1.0 / np.sqrt(x),
+    "tanh": np.tanh,
+    "floor": np.floor,
+    "ceil": np.ceil,
+    "sign": np.sign,
+}
+_FOLD_BINARY = {
+    "add": np.add,
+    "sub": np.subtract,
+    "mul": np.multiply,
+    "div": np.divide,
+    "max": np.maximum,
+    "min": np.minimum,
+    "pow": np.power,
+}
+
+
+def _uniform_scalar(em: _Emitter, cid: int):
+    """Scalar value if ``cid`` is known (or tracked) uniform, else None."""
+    if cid in em.uniform:
+        return em.uniform[cid]
+    val = em.known.get(cid)
+    if val is None:
+        return None
+    if val.size == 0:
+        return None
+    flat = np.asarray(val).ravel()
+    v0 = flat[0]
+    return float(v0) if np.all(flat == v0) else None
+
+
+def _try_fold(em: _Emitter, eqn, prim, params, ins) -> bool:
+    """Fold const-only subexpressions / eliminate algebraic identities.
+    Returns True when the eqn needs no emitted op."""
+    if len(eqn.outvars) != 1:
+        return False
+    outvar = eqn.outvars[0]
+    out_shape = tuple(getattr(outvar.aval, "shape", ()))
+    out_numel = int(np.prod(out_shape)) if out_shape else 1
+
+    def known(i):
+        return em.known.get(ins[i])
+
+    # pure constant computation (kept small so weights.bin doesn't bloat;
+    # int8-rooted chains are the deliberate quantized-storage path — folding
+    # them would re-materialize f32 weights and undo the 4x size win)
+    if (
+        out_numel <= _FOLD_NUMEL_CAP
+        and all(i in em.known for i in ins)
+        and not any(em.known[i].dtype == np.int8 for i in ins)
+    ):
+        try:
+            if prim in _FOLD_BINARY and len(ins) == 2:
+                val = _FOLD_BINARY[prim](known(0), known(1))
+            elif prim in _FOLD_UNARY and len(ins) == 1:
+                val = _FOLD_UNARY[prim](known(0))
+            elif prim == "integer_pow" and len(ins) == 1:
+                val = known(0) ** params["y"]
+            elif prim in ("reshape", "squeeze", "expand_dims"):
+                val = np.asarray(known(0)).reshape(out_shape)
+            elif prim == "transpose":
+                val = np.transpose(known(0), params["permutation"])
+            elif prim == "broadcast_in_dim":
+                src = np.asarray(known(0))
+                expand = [1] * len(out_shape)
+                for d, od in enumerate(params["broadcast_dimensions"]):
+                    expand[od] = src.shape[d]
+                val = np.broadcast_to(src.reshape(expand), out_shape).copy()
+            elif prim in _COPY or prim == "convert_element_type":
+                val = np.asarray(known(0))
+            else:
+                return False
+        except Exception:
+            return False
+        em.bind(outvar, em.const(np.asarray(val)))
+        return True
+
+    # uniform-value tracking through shape ops (a broadcast of a uniform
+    # constant stays uniform, whatever its size)
+    if prim in ("broadcast_in_dim", "reshape", "squeeze", "expand_dims", "transpose") or prim in _COPY:
+        u = _uniform_scalar(em, ins[0])
+        if u is not None:
+            out_id = em.vid(outvar)
+            em.uniform[out_id] = u  # op still emitted; DCE removes it if unused
+
+    # identity elimination: x+0, x-0, x*1, x/1 alias their live operand
+    if prim in ("add", "sub", "mul", "div") and len(ins) == 2:
+        u0, u1 = _uniform_scalar(em, ins[0]), _uniform_scalar(em, ins[1])
+        in_shapes = [tuple(getattr(v.aval, "shape", ())) for v in eqn.invars]
+
+        def alias(i):
+            em.bind(outvar, ins[i])
+            return True
+
+        if prim in ("add", "sub") and u1 == 0.0 and in_shapes[0] == out_shape:
+            return alias(0)
+        if prim == "add" and u0 == 0.0 and in_shapes[1] == out_shape:
+            return alias(1)
+        if prim in ("mul", "div") and u1 == 1.0 and in_shapes[0] == out_shape:
+            return alias(0)
+        if prim == "mul" and u0 == 1.0 and in_shapes[1] == out_shape:
+            return alias(1)
+    return False
 
 
 def _emit_eqn(em: _Emitter, eqn) -> None:
@@ -186,6 +324,8 @@ def _emit_eqn(em: _Emitter, eqn) -> None:
         raise NotImplementedError(f"call primitive without jaxpr: {prim}")
 
     ins = _in_ids(em, eqn)
+    if _try_fold(em, eqn, prim, params, ins):
+        return
     out = em.vid(eqn.outvars[0])
 
     if prim == "add_any":  # grad accumulation (lax.add_any) == add
@@ -399,15 +539,37 @@ def export_program(fn: Callable, example_inputs: Sequence, out_dir: str) -> None
     out_lines = []
     for var in jaxpr.outvars:
         if isinstance(var, jcore.Literal):
-            out_lines.append(f"output {em.const(var.val)}")
+            out_lines.append(f"output {em.use(em.const(var.val))}")
         else:
-            out_lines.append(f"output {em.vid(var)}")
+            out_lines.append(f"output {em.use(em.vid(var))}")
 
     with open(os.path.join(out_dir, "program.txt"), "w") as f:
         f.write("# paddle_tpu native program v2\n")
-        f.write("\n".join(em.lines + out_lines) + "\n")
+        f.write("\n".join(_line_dce(em.lines, out_lines) + out_lines) + "\n")
     with open(os.path.join(out_dir, "weights.bin"), "wb") as f:
         f.write(b"".join(em.weights))
+
+
+def _line_dce(lines: List[str], out_lines: List[str]) -> List[str]:
+    """Backward-reachability DCE over emitted lines: identity elimination
+    can orphan ops (e.g. the broadcast feeding an eliminated x*1) whose
+    results nothing reads — drop them (and consts only they read)."""
+    needed = {int(l.split()[1]) for l in out_lines}
+    keep_rev: List[str] = []
+    for line in reversed(lines):
+        parts = line.split()
+        if parts[0] == "op":
+            out_id = int(parts[2])
+            if out_id in needed:
+                keep_rev.append(line)
+                nin = int(parts[3])
+                needed.update(int(p) for p in parts[4 : 4 + nin])
+        elif parts[0] == "const":
+            if int(parts[1]) in needed:
+                keep_rev.append(line)
+        else:  # input lines always survive (the call ABI)
+            keep_rev.append(line)
+    return list(reversed(keep_rev))
 
 
 def export_train_step(
