@@ -340,3 +340,66 @@ def test_native_int8_quantized_export(tmp_path, rng):
     agree = np.mean(o8.argmax(1) == o32.argmax(1))
     assert agree >= 0.8, agree
     p32.close(); p8.close()
+
+
+def test_export_constant_folding_and_identity_elim(tmp_path, rng):
+    """Exporter-level constant folding: const-only subexpressions fold at
+    export, x*1 / x+0 alias away, and orphaned ops/consts are DCE'd — so a
+    folded-BN model's native program carries no BN arithmetic (the op-graph
+    analogue of inference_transpiler.py _fuse_bn)."""
+    import jax.numpy as jnp
+
+    scale_v = np.float32(2.0)
+
+    def f(x):
+        one = jnp.ones((4,), np.float32) * scale_v / 2.0  # folds to exactly 1
+        zero = jnp.zeros((3, 4), np.float32)
+        return (x * one + zero) * (scale_v / 2.0)  # * 1.0 folds too
+
+    x = rng.randn(3, 4).astype(np.float32)
+    out_dir = str(tmp_path / "folded")
+    export_program(f, [x], out_dir)
+    prog = open(os.path.join(out_dir, "program.txt")).read()
+    ops = [l for l in prog.splitlines() if l.startswith("op ")]
+    # everything folds/aliases away: output is the input itself
+    assert ops == [], ops
+    pred = NativePredictor(out_dir)
+    np.testing.assert_allclose(pred.run(x)[0], x, rtol=1e-6)
+
+
+def test_export_folded_bn_has_no_bn_arithmetic(tmp_path, rng):
+    """conv+BN model: after fuse_batch_norm the exported native program
+    contains only the conv (+bias add), not the BN mul/sub chain."""
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.transpiler.inference import fuse_batch_norm
+
+    def net(x):
+        h = pt.layers.conv2d(x, 4, 3, padding=1)
+        h = pt.layers.batch_norm(h)
+        return h
+
+    model = pt.build(net)
+    x = rng.randn(2, 8, 8, 3).astype(np.float32)
+    variables = model.init(0, jnp.asarray(x))
+    # make BN stats non-trivial so the test is not vacuous
+    state = {k: jnp.asarray(rng.rand(*v.shape).astype(np.float32) + 0.5)
+             for k, v in variables.state.items()}
+    variables = type(variables)(variables.params, state)
+    folded = fuse_batch_norm(variables)
+
+    def infer(xx):
+        out, _ = model.apply(folded, xx, is_train=False)
+        return out
+
+    out_dir = str(tmp_path / "bnfold")
+    export_program(infer, [x], out_dir)
+    prog = open(os.path.join(out_dir, "program.txt")).read()
+    op_names = [l.split()[1] for l in prog.splitlines() if l.startswith("op ")]
+    assert "conv" in op_names
+    # identity BN: no runtime mul/sub left (only conv + the bias add)
+    assert "mul" not in op_names and "sub" not in op_names, op_names
+    # and it computes the same thing as JAX
+    pred = NativePredictor(out_dir)
+    ref, _ = model.apply(variables, jnp.asarray(x), is_train=False)
+    np.testing.assert_allclose(pred.run(x)[0], np.asarray(ref), rtol=2e-4, atol=2e-5)
