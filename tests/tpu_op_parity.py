@@ -1,0 +1,119 @@
+"""TPU-vs-CPU op parity sweep — the chip half of the reference's both-places
+discipline (``op_test.py:368`` check_output on CPUPlace AND CUDAPlace).
+
+Runs a broad sample of the functional op catalog twice — once jit-compiled
+on the default (TPU) backend, once on the CPU backend — and compares
+numerics. Exits 0 whenever the JSON verdict line was printed; meant to be
+run opportunistically whenever the axon tunnel is up:
+
+    python tests/tpu_op_parity.py        # writes OP_PARITY_TPU.json
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+BUDGET_S = float(os.environ.get("PT_OPPARITY_BUDGET_S", "600"))
+_T0 = time.monotonic()
+
+
+def main() -> int:
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache"))
+    except Exception:
+        pass
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    out = {"sweep": "tpu_op_parity", "ok": False, "n_pass": 0, "n_fail": 0,
+           "failures": [], "skipped": []}
+    dev = jax.devices()[0]
+    out["platform"], out["device_kind"] = dev.platform, dev.device_kind
+    if dev.platform == "cpu":
+        out["failures"].append("no TPU backend")
+        print(json.dumps(out))
+        return 0
+
+    cpu = jax.devices("cpu")[0]
+    tpu = dev
+
+    from paddle_tpu.ops import nn as on
+    from paddle_tpu.ops import math as om
+    from paddle_tpu.ops import sequence as oseq
+
+    rng = np.random.RandomState(0)
+    x4 = rng.randn(2, 8, 8, 6).astype(np.float32)
+    w4 = rng.randn(3, 3, 6, 4).astype(np.float32)
+    x2 = rng.randn(4, 16).astype(np.float32)
+    w2 = rng.randn(16, 8).astype(np.float32)
+    v1 = rng.rand(4, 16).astype(np.float32)
+    labels = rng.randint(0, 8, (4,)).astype(np.int32)
+    seq = rng.randn(3, 6, 4).astype(np.float32)
+    seq_lens = np.array([3, 5, 6], np.int64)
+
+    # (name, fn, args, tol) — representative spread of the op families
+    CASES = [
+        ("conv2d", lambda: on.conv2d(jnp.asarray(x4), jnp.asarray(w4), padding=1), 2e-5),
+        ("conv2d_transpose", lambda: on.conv2d_transpose(jnp.asarray(x4), jnp.asarray(rng.randn(3, 3, 6, 5).astype(np.float32)), stride=2), 2e-5),
+        ("pool2d_max", lambda: on.pool2d(jnp.asarray(x4), 2, "max", 2), 1e-6),
+        ("pool2d_avg", lambda: on.pool2d(jnp.asarray(x4), 2, "avg", 2), 1e-6),
+        ("maxout", lambda: on.maxout(jnp.asarray(x4), 2), 1e-6),
+        ("lrn", lambda: on.lrn(jnp.asarray(x4)), 1e-5),
+        ("softmax", lambda: on.softmax(jnp.asarray(x2)), 1e-5),
+        ("log_softmax", lambda: on.log_softmax(jnp.asarray(x2)), 1e-5),
+        ("cross_entropy", lambda: on.cross_entropy(jnp.asarray(v1 / v1.sum(1, keepdims=True)), jnp.asarray(labels)), 1e-5),
+        ("softmax_xent", lambda: on.softmax_with_cross_entropy(jnp.asarray(x2[:, :8]), jnp.asarray(labels)), 1e-5),
+        ("sigmoid_xent", lambda: on.sigmoid_cross_entropy_with_logits(jnp.asarray(x2), jnp.asarray(v1)), 1e-5),
+        ("l2_normalize", lambda: on.l2_normalize(jnp.asarray(x2), axis=1), 1e-5),
+        ("matmul", lambda: om.matmul(jnp.asarray(x2), jnp.asarray(w2)), 2e-5),
+        ("elementwise_pow", lambda: om.elementwise_pow(jnp.asarray(np.abs(x2) + 0.5), jnp.asarray(np.abs(w2.T[:4]) + 0.5)), 1e-4),
+        ("tanh", lambda: om.tanh(jnp.asarray(x2)), 1e-6),
+        ("cumsum", lambda: om.cumsum(jnp.asarray(x2), axis=1), 1e-5),
+        ("topk", lambda: om.topk(jnp.asarray(x2), 4)[0], 1e-6),
+        ("argsort", lambda: om.argsort(jnp.asarray(x2), axis=1)[0], 1e-6),
+        ("clip", lambda: om.clip(jnp.asarray(x2), -0.5, 0.5), 1e-6),
+        ("sequence_pool_mean", lambda: oseq.sequence_pool(jnp.asarray(seq), jnp.asarray(seq_lens), "average"), 1e-5),
+        ("sequence_softmax", lambda: oseq.sequence_softmax(jnp.asarray(seq[:, :, 0]), jnp.asarray(seq_lens)), 1e-5),
+        ("layer_norm", lambda: on.layer_norm(jnp.asarray(x2), jnp.ones((16,)), jnp.zeros((16,)), begin_norm_axis=-1), 2e-5),
+    ]
+
+    for name, fn, tol in CASES:
+        if time.monotonic() - _T0 > BUDGET_S:
+            out["skipped"].append(name)
+            continue
+        try:
+            with jax.default_device(cpu):
+                ref = np.asarray(jax.device_get(jax.jit(fn)()))
+            with jax.default_device(tpu):
+                got = np.asarray(jax.device_get(jax.jit(fn)()))
+            np.testing.assert_allclose(got, ref, rtol=tol, atol=tol)
+            out["n_pass"] += 1
+        except AssertionError as e:
+            out["n_fail"] += 1
+            out["failures"].append(f"{name}: numerics: {str(e).splitlines()[1][:120] if len(str(e).splitlines())>1 else str(e)[:120]}")
+        except Exception as e:  # noqa: BLE001
+            out["n_fail"] += 1
+            out["failures"].append(f"{name}: {type(e).__name__}: {str(e)[:160]}")
+
+    out["ok"] = out["n_fail"] == 0 and out["n_pass"] > 0
+    out["elapsed_s"] = round(time.monotonic() - _T0, 1)
+    line = json.dumps(out)
+    print(line)
+    try:
+        with open(os.path.join(_REPO, "OP_PARITY_TPU.json"), "w") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
