@@ -239,6 +239,32 @@ class Momentum(Optimizer):
         return new_p, {"velocity": v}
 
 
+class LARS(Optimizer):
+    """Layer-wise Adaptive Rate Scaling with momentum: per-parameter
+    effective lr = lr * ||p|| / (||g|| + wd*||p||) — the reference exposed
+    this as the ``append_LARS`` lr rewrite
+    (``layers/learning_rate_scheduler.py:310``); here it is a first-class
+    optimizer so it composes with schedulers/clipping like the rest."""
+
+    def __init__(self, learning_rate, momentum: float = 0.9, lars_weight_decay: float = 0.0005, epsilon: float = 1e-9, **kw):
+        super().__init__(learning_rate, **kw)
+        self.momentum = momentum
+        self.lars_weight_decay = lars_weight_decay
+        self.epsilon = epsilon
+
+    def _slot_names(self):
+        return ("velocity",)
+
+    def _update(self, p, g, lr, slots, step):
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        local_lr = lr * p_norm / (g_norm + self.lars_weight_decay * p_norm + self.epsilon)
+        v = self.momentum * slots["velocity"] + local_lr * (
+            g + self.lars_weight_decay * p
+        )
+        return p - v, {"velocity": v}
+
+
 class Adagrad(Optimizer):
     def __init__(self, learning_rate, epsilon: float = 1e-6, initial_accumulator_value: float = 0.0, **kw):
         super().__init__(learning_rate, **kw)

@@ -294,3 +294,17 @@ def test_lamb_trains_and_trust_ratio_finite(rng):
         v, o = out.variables, out.opt_state
         losses.append(float(out.loss))
     assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
+
+
+def test_lars_scales_update_by_trust_ratio():
+    """LARS (reference append_LARS, learning_rate_scheduler.py:310): the
+    effective step scales with ||p||/||g||, so two params with equal grads
+    but different magnitudes take proportionally different steps."""
+    opt = pt.optimizer.LARS(learning_rate=0.1, momentum=0.0, lars_weight_decay=0.0)
+    params = {"big": jnp.full((4,), 10.0), "small": jnp.full((4,), 1.0)}
+    grads = {"big": jnp.full((4,), 1.0), "small": jnp.full((4,), 1.0)}
+    state = opt.create_state(params)
+    new_params, _ = opt.apply_gradients(params, grads, state, {})
+    step_big = float(jnp.abs(params["big"] - new_params["big"]).mean())
+    step_small = float(jnp.abs(params["small"] - new_params["small"]).mean())
+    np.testing.assert_allclose(step_big / step_small, 10.0, rtol=1e-4)
