@@ -171,7 +171,9 @@ def run_benchmark(args) -> dict:
         for _ in range(max(1, args.skip_batch_num)):  # ≥1 warmup to compile
             out = step(variables, opt_state)
             variables, opt_state = out.variables, out.opt_state
-        jax.block_until_ready(out.loss)
+        # device_get (not block_until_ready): the tunneled backend has been
+        # observed to return from block_until_ready before execution ends
+        float(jax.device_get(out.loss))
 
         profiled = args.profile and pass_id == 0
         ctx = (
@@ -191,12 +193,12 @@ def run_benchmark(args) -> dict:
                         out = step(variables, opt_state)
                         variables, opt_state = out.variables, out.opt_state
                     with prof.record_event("device_wait"):
-                        jax.block_until_ready(out.loss)
+                        float(jax.device_get(out.loss))
             else:
                 for _ in range(args.iterations):
                     out = step(variables, opt_state)
                     variables, opt_state = out.variables, out.opt_state
-                jax.block_until_ready(out.loss)
+                float(jax.device_get(out.loss))
         dt = time.perf_counter() - t0
         if profiled:
             timeline = prof.export_chrome_trace(
