@@ -49,13 +49,30 @@ def _ring_core(ring_mesh):
     )
 
 
+def _ulysses_core(mesh):
+    """All-to-all sequence parallelism (``ops/ulysses.py``): re-shard
+    seq->head, plain flash attention on full local sequences, shard back."""
+    from paddle_tpu.ops.ulysses import ulysses_attention_sharded
+
+    return lambda qh, kh, vh: ulysses_attention_sharded(
+        qh, kh, vh, mesh, causal=True
+    )
+
+
 def lm_block(x, cfg, name):
     ring_mesh = cfg.get("ring_mesh")
+    ulysses_mesh = cfg.get("ulysses_mesh")
+    if ring_mesh is not None:
+        core = _ring_core(ring_mesh)
+    elif ulysses_mesh is not None:
+        core = _ulysses_core(ulysses_mesh)
+    else:
+        core = None
     with name_scope(name):
         attn = multi_head_attention(
             x, x, x, cfg["d_model"], cfg["num_heads"],
             dropout_rate=cfg["attn_dropout"], causal=True, name="self_attn",
-            core=_ring_core(ring_mesh) if ring_mesh is not None else None,
+            core=core,
         )
         x = _post_process(x, attn, cfg["residual_dropout"])
         ffn = positionwise_ffn(x, cfg["d_inner"], cfg["d_model"], cfg["relu_dropout"])
@@ -255,16 +272,21 @@ BASE_CFG = dict(
 
 
 def get_model(
-    seq_len: int = 1024, learning_rate: float = 1e-3, ring_mesh=None, **overrides
+    seq_len: int = 1024, learning_rate: float = 1e-3, ring_mesh=None,
+    ulysses_mesh=None, **overrides
 ) -> ModelSpec:
     """``ring_mesh``: a Mesh with a ``seq`` axis → attention runs as ring
     attention over it (sequence-parallel exact attention; batch tokens must
-    be fed sharded [data, seq])."""
+    be fed sharded [data, seq]). ``ulysses_mesh``: same contract but via
+    all-to-all head resharding (``ops/ulysses.py``) — pick ring for
+    T >> heads, ulysses for heads >= seq-axis size."""
     cfg = dict(BASE_CFG)
     cfg.update({k: v for k, v in overrides.items() if k in cfg})
     cfg["max_len"] = max(cfg["max_len"], seq_len)
     if ring_mesh is not None:
         cfg["ring_mesh"] = ring_mesh
+    if ulysses_mesh is not None:
+        cfg["ulysses_mesh"] = ulysses_mesh
 
     model = pt.build(functools.partial(lm_forward, cfg=cfg), name="transformer_lm")
 

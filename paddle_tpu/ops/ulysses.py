@@ -1,0 +1,100 @@
+"""Ulysses-style all-to-all sequence parallelism for attention.
+
+No reference counterpart (SURVEY.md §5.7: the reference predates context
+parallelism). This is the second TPU-native long-context path next to
+:mod:`paddle_tpu.ops.ring_attention`: instead of rotating K/V blocks around
+an ICI ring, two ``all_to_all`` collectives re-shard the activations from
+sequence-sharded to HEAD-sharded, run ordinary (flash) attention on full
+sequences locally, and shard back (DeepSpeed-Ulysses / "all-to-all sequence
+parallelism"). Trade-off vs ring:
+
+- communication is 2 all-to-alls of the activations, independent of T's
+  square — cheaper than ring when heads >= devices and T is moderate;
+- every device sees the FULL sequence for its head slice, so the local
+  kernel is the plain Pallas flash kernel (best MXU utilization, no
+  per-block merge arithmetic);
+- requires num_heads % n_devices == 0 (ring has no such constraint).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.parallel import mesh as mesh_mod
+
+__all__ = ["ulysses_attention", "ulysses_attention_sharded"]
+
+
+def _local_attention(q, k, v, causal: bool, use_flash: Optional[bool]):
+    from paddle_tpu.core import config as _cfg
+
+    flash = use_flash if use_flash is not None else _cfg.flags().use_flash_attention
+    if flash:
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+        t = q.shape[-2]
+        if t % 128 == 0 or t <= 128:
+            return flash_attention(q, k, v, causal=causal)
+    from paddle_tpu.ops.pallas.flash_attention import _reference_attention
+
+    return _reference_attention(q, k, v, causal, q.shape[-1] ** -0.5)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis: str = mesh_mod.SEQ_AXIS,
+    causal: bool = False,
+    use_flash: Optional[bool] = None,
+) -> jax.Array:
+    """Per-shard body (call under ``shard_map``): q/k/v are LOCAL
+    [B, H, T_local, d] blocks sharded over ``axis`` on the T dim. Returns the
+    local [B, H, T_local, d] output block.
+
+    all_to_all #1: seq-sharded -> head-sharded ([B, H/n, T, d]);
+    local full-sequence attention; all_to_all #2: back.
+    """
+    n = jax.lax.psum(1, axis)
+    enforce(q.shape[1] % n == 0, f"num_heads {q.shape[1]} not divisible by {axis} size {n}")
+    # split the head dim across the axis, gather the seq dim
+    qh = jax.lax.all_to_all(q, axis, split_axis=1, concat_axis=2, tiled=True)
+    kh = jax.lax.all_to_all(k, axis, split_axis=1, concat_axis=2, tiled=True)
+    vh = jax.lax.all_to_all(v, axis, split_axis=1, concat_axis=2, tiled=True)
+    out = _local_attention(qh, kh, vh, causal, use_flash)
+    # inverse: split seq back out, gather heads
+    return jax.lax.all_to_all(out, axis, split_axis=2, concat_axis=1, tiled=True)
+
+
+def ulysses_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = mesh_mod.SEQ_AXIS,
+    causal: bool = False,
+    use_flash: Optional[bool] = None,
+    batch_axis: Optional[str] = mesh_mod.DATA_AXIS,
+) -> jax.Array:
+    """Convenience wrapper mirroring :func:`ring_attention_sharded`: q/k/v
+    are GLOBAL [B, H, T, d]; shards T over ``axis`` (and batch over
+    ``batch_axis`` when present), runs :func:`ulysses_attention` under
+    shard_map, returns the global result."""
+    b_axis = batch_axis if (batch_axis and batch_axis in mesh.axis_names) else None
+    if b_axis is not None and q.shape[0] % mesh.shape[b_axis] != 0:
+        b_axis = None
+    spec = P(b_axis, None, axis, None)
+    return shard_map(
+        partial(ulysses_attention, axis=axis, causal=causal, use_flash=use_flash),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
