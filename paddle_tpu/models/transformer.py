@@ -64,6 +64,7 @@ def multi_head_attention(
     causal: bool = False,
     core=None,
     kv_len=None,
+    num_kv_heads: Optional[int] = None,
 ):
     """Projected multi-head attention (q/k/v/out linear maps + fused core).
 
@@ -71,14 +72,20 @@ def multi_head_attention(
     when given, new k/v are appended (static-size cache with a write index is
     used in the beam-search decoder). ``core`` overrides the attention core
     ``(qh, kh, vh) -> ctx`` — e.g. a ring-attention body for sequence-
-    parallel long context."""
+    parallel long context. ``num_kv_heads`` < num_heads enables
+    grouped-query attention (MQA at 1): k/v project to fewer heads, cutting
+    KV projection FLOPs, cache size, and HBM traffic proportionally."""
+    h_kv = num_kv_heads or num_heads
+    if num_heads % h_kv:
+        raise ValueError(f"num_heads {num_heads} not divisible by num_kv_heads {h_kv}")
+    d_kv = d_model // num_heads * h_kv
     with name_scope(name):
         q = _proj(queries, d_model, shard_out=True, name="q")
-        k = _proj(keys, d_model, shard_out=True, name="k")
-        v = _proj(values, d_model, shard_out=True, name="v")
+        k = _proj(keys, d_kv, shard_out=True, name="k")
+        v = _proj(values, d_kv, shard_out=True, name="v")
         qh = oattn.split_heads(q, num_heads)
-        kh = oattn.split_heads(k, num_heads)
-        vh = oattn.split_heads(v, num_heads)
+        kh = oattn.split_heads(k, h_kv)
+        vh = oattn.split_heads(v, h_kv)
         if cache is not None:
             kh = jnp.concatenate([cache["k"], kh], axis=2)
             vh = jnp.concatenate([cache["v"], vh], axis=2)

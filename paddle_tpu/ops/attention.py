@@ -119,6 +119,39 @@ def scaled_dot_product_attention(
 
     out_dtype = q.dtype
     q, k, v = mxu_operands(q, k, v)
+
+    if q.ndim == 4 and k.ndim == 4 and k.shape[1] != q.shape[1]:
+        # grouped-query attention: q has H heads, k/v have H_kv < H (MQA at
+        # H_kv=1). Grouped einsums keep K/V at H_kv in HBM — no repeat
+        # materialization, the point of GQA's KV-traffic savings.
+        b, h, t_q, d_ = q.shape
+        h_kv = k.shape[1]
+        if h % h_kv:
+            raise ValueError(f"GQA: {h} query heads not divisible by {h_kv} kv heads")
+        if mask is not None and mask.ndim >= 3 and mask.shape[-3] not in (1, h_kv):
+            raise ValueError("GQA: per-query-head masks are unsupported; use a "
+                             "head-broadcastable mask (head dim 1)")
+        g = h // h_kv
+        qg = q.reshape(b, h_kv, g, t_q, d_)
+        logits = jnp.einsum(
+            "bkgqd,bktd->bkgqt", qg, k, preferred_element_type=jnp.float32
+        ) * scale
+        if mask is not None:
+            m = mask.astype(jnp.float32)
+            if m.ndim >= 3:  # insert the group dim after the (1|h_kv) head dim
+                m = jnp.expand_dims(m, -3)
+            logits = logits + m
+        weights = jax.nn.softmax(logits, axis=-1)
+        if dropout_rate > 0.0 and not is_test:
+            from paddle_tpu.ops.nn import dropout as _dropout
+
+            weights = _dropout(weights, dropout_rate, is_test=False, key=dropout_key)
+        out = jnp.einsum(
+            "bkgqt,bktd->bkgqd", weights.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
+        return out.reshape(b, h, t_q, d_).astype(out_dtype)
+
     logits = jnp.matmul(q, jnp.swapaxes(k, -1, -2), preferred_element_type=jnp.float32)
     logits = logits * scale
     if mask is not None:
