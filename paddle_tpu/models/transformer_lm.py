@@ -72,7 +72,7 @@ def lm_block(x, cfg, name):
         attn = multi_head_attention(
             x, x, x, cfg["d_model"], cfg["num_heads"],
             dropout_rate=cfg["attn_dropout"], causal=True, name="self_attn",
-            core=core,
+            core=core, num_kv_heads=cfg.get("num_kv_heads"),
         )
         x = _post_process(x, attn, cfg["residual_dropout"])
         ffn = positionwise_ffn(x, cfg["d_inner"], cfg["d_model"], cfg["relu_dropout"])
@@ -155,6 +155,12 @@ def generate(
     D, H, L = cfg["d_model"], cfg["num_heads"], cfg["n_layers"]
     dh = D // H
     enforce(max_new_tokens >= 1, f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    enforce(
+        cfg.get("num_kv_heads") in (None, H),
+        "generate(): the static-cache decoder does not support GQA "
+        "(num_kv_heads < num_heads) yet — train-time GQA works; decode with "
+        "model.apply or extend the cache layout to H_kv heads",
+    )
     enforce(
         temperature == 0.0 or rng is not None,
         "generate: sampling (temperature > 0) needs an explicit rng key — "
@@ -262,6 +268,7 @@ BASE_CFG = dict(
     d_model=512,
     d_inner=2048,
     num_heads=8,
+    num_kv_heads=None,  # < num_heads -> grouped-query attention
     n_layers=6,
     max_len=8192,
     attn_dropout=0.0,
