@@ -142,10 +142,12 @@ def sinusoid_position_encoding(max_len: int, d_model: int, dtype=jnp.float32):
     return jnp.asarray(enc, dtype)
 
 
-def prepare_embedding(ids, vocab_size, d_model, max_len, dropout_rate, name, pos_offset=0):
-    """token embedding * sqrt(d) + fixed sinusoid position encoding.
-    ``pos_offset`` (int or traced scalar) shifts positions for incremental
-    decode with a k/v cache."""
+def prepare_embedding(ids, vocab_size, d_model, max_len, dropout_rate, name,
+                      pos_offset=0, add_position_encoding=True):
+    """token embedding * sqrt(d) (+ fixed sinusoid position encoding unless
+    ``add_position_encoding=False`` — RoPE models inject position at the
+    attention rotation instead). ``pos_offset`` (int or traced scalar)
+    shifts positions for incremental decode with a k/v cache."""
     with name_scope(name):
         emb = layers.embedding(
             ids,
@@ -153,9 +155,10 @@ def prepare_embedding(ids, vocab_size, d_model, max_len, dropout_rate, name, pos
             param_attr=ParamAttr(name="word_emb", sharding=(None, TP)),
         )
         emb = emb * (d_model ** 0.5)
-        t = ids.shape[-1]
-        pe = sinusoid_position_encoding(max_len, d_model, emb.dtype)
-        emb = emb + jax.lax.dynamic_slice_in_dim(pe, pos_offset, t, axis=0)
+        if add_position_encoding:
+            t = ids.shape[-1]
+            pe = sinusoid_position_encoding(max_len, d_model, emb.dtype)
+            emb = emb + jax.lax.dynamic_slice_in_dim(pe, pos_offset, t, axis=0)
         if dropout_rate:
             emb = layers.dropout(emb, dropout_rate)
         return emb

@@ -59,6 +59,21 @@ def _ulysses_core(mesh):
     )
 
 
+def _rope_core(cfg):
+    """Attention core applying rotary position embeddings to q/k before the
+    (flash-routed) fused attention; positions are absolute so scores are
+    relative-position functions."""
+    from paddle_tpu.ops.attention import apply_rope, rope_tables, scaled_dot_product_attention
+
+    def core(qh, kh, vh):
+        cos, sin = rope_tables(qh.shape[-1], qh.shape[-2])
+        return scaled_dot_product_attention(
+            apply_rope(qh, cos, sin), apply_rope(kh, cos, sin), vh, causal=True
+        )
+
+    return core
+
+
 def lm_block(x, cfg, name):
     ring_mesh = cfg.get("ring_mesh")
     ulysses_mesh = cfg.get("ulysses_mesh")
@@ -66,6 +81,8 @@ def lm_block(x, cfg, name):
         core = _ring_core(ring_mesh)
     elif ulysses_mesh is not None:
         core = _ulysses_core(ulysses_mesh)
+    elif cfg.get("pos_encoding") == "rope":
+        core = _rope_core(cfg)
     else:
         core = None
     with name_scope(name):
@@ -110,6 +127,7 @@ def lm_forward(ids, labels, *, cfg):
     x = prepare_embedding(
         ids, cfg["vocab"], cfg["d_model"], cfg["max_len"],
         cfg["residual_dropout"], name="emb",
+        add_position_encoding=cfg.get("pos_encoding", "sinusoid") != "rope",
     )
     block = _block_caller(cfg)
     for i in range(cfg["n_layers"]):
@@ -160,6 +178,11 @@ def generate(
         "generate(): the static-cache decoder does not support GQA "
         "(num_kv_heads < num_heads) yet — train-time GQA works; decode with "
         "model.apply or extend the cache layout to H_kv heads",
+    )
+    enforce(
+        cfg.get("pos_encoding", "sinusoid") == "sinusoid",
+        "generate(): the static-cache decoder assumes additive sinusoid PE; "
+        "RoPE decode needs per-step q/k rotation — decode with model.apply",
     )
     enforce(
         temperature == 0.0 or rng is not None,
@@ -269,6 +292,7 @@ BASE_CFG = dict(
     d_inner=2048,
     num_heads=8,
     num_kv_heads=None,  # < num_heads -> grouped-query attention
+    pos_encoding="sinusoid",  # or "rope" (rotary, applied at attention)
     n_layers=6,
     max_len=8192,
     attn_dropout=0.0,

@@ -16,7 +16,33 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["scaled_dot_product_attention", "split_heads", "combine_heads", "causal_mask"]
+__all__ = [
+    "scaled_dot_product_attention", "split_heads", "combine_heads",
+    "causal_mask", "rope_tables", "apply_rope",
+]
+
+
+def rope_tables(dim: int, t: int, base: float = 10000.0, pos0: int = 0):
+    """Rotary position embedding cos/sin tables: [t, dim//2] each.
+    No reference counterpart (the reference era used additive sinusoid PE,
+    ``models/transformer.py`` position_encoding_init); RoPE is the modern
+    long-context scheme — relative-position attention scores, exact under
+    sequence sharding since tables index GLOBAL positions via ``pos0``."""
+    half = dim // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    angles = (pos0 + jnp.arange(t, dtype=jnp.float32))[:, None] * freqs[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate feature pairs of [..., T, d] by position angle (half-split
+    pairing): out = (x1*cos - x2*sin, x1*sin + x2*cos)."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [xf1 * cos - xf2 * sin, xf1 * sin + xf2 * cos], axis=-1
+    ).astype(x.dtype)
 
 
 def causal_mask(t_q: int, t_k: int, dtype=jnp.float32) -> jax.Array:

@@ -281,3 +281,54 @@ def test_mha_layer_num_kv_heads(rng):
         lambda p: model.apply((p, variables.state), x)[0].sum()
     )(variables.params)
     assert all(np.all(np.isfinite(np.asarray(t))) for t in jax.tree_util.tree_leaves(g))
+
+
+def test_rope_relative_position_property(rng):
+    """RoPE scores depend only on relative offset: shifting BOTH positions
+    by s leaves q·k unchanged."""
+    from paddle_tpu.ops.attention import apply_rope, rope_tables
+
+    d, T, s = 16, 8, 5
+    q = jnp.asarray(rng.randn(1, 1, T, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 1, T, d).astype(np.float32))
+
+    cos0, sin0 = rope_tables(d, T, pos0=0)
+    coss, sins = rope_tables(d, T, pos0=s)
+    score0 = np.einsum(
+        "bhqd,bhkd->bhqk", np.asarray(apply_rope(q, cos0, sin0)), np.asarray(apply_rope(k, cos0, sin0))
+    )
+    scores = np.einsum(
+        "bhqd,bhkd->bhqk", np.asarray(apply_rope(q, coss, sins)), np.asarray(apply_rope(k, coss, sins))
+    )
+    np.testing.assert_allclose(score0, scores, rtol=1e-4, atol=1e-5)
+    # rotation is norm-preserving
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(apply_rope(q, cos0, sin0))),
+        np.linalg.norm(np.asarray(q)), rtol=1e-5,
+    )
+
+
+def test_rope_lm_trains(rng):
+    """transformer_lm(pos_encoding='rope') trains and has no additive PE in
+    its embedding (position enters only through the attention rotation)."""
+    import paddle_tpu as pt
+    from paddle_tpu import models
+
+    spec = models.get_model(
+        "transformer_lm", seq_len=32, vocab=64, d_model=32, num_heads=4,
+        n_layers=1, max_len=32, pos_encoding="rope",
+    )
+    batch = spec.synth_batch(4, rng)
+    v = spec.model.init(0, *batch)
+    opt = spec.optimizer()
+    os_ = opt.create_state(v.params)
+    step = jax.jit(opt.minimize(spec.model))
+    losses = []
+    for i in range(4):
+        out = step(v, os_, *[jnp.asarray(b) for b in batch], rng=jax.random.PRNGKey(i))
+        v, os_ = out.variables, out.opt_state
+        losses.append(float(out.loss))
+    assert losses[-1] < losses[0]
+    with pytest.raises(Exception, match="sinusoid"):
+        from paddle_tpu.models.transformer_lm import generate
+        generate(v, jnp.zeros((1, 4), jnp.int32), 2, spec.extra["cfg"])
