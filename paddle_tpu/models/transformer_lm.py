@@ -172,13 +172,9 @@ def generate(
     T_max = Tp + max_new_tokens
     D, H, L = cfg["d_model"], cfg["num_heads"], cfg["n_layers"]
     dh = D // H
+    H_kv = cfg.get("num_kv_heads") or H  # GQA: cache holds H_kv heads
+    G = H // H_kv
     enforce(max_new_tokens >= 1, f"max_new_tokens must be >= 1, got {max_new_tokens}")
-    enforce(
-        cfg.get("num_kv_heads") in (None, H),
-        "generate(): the static-cache decoder does not support GQA "
-        "(num_kv_heads < num_heads) yet — train-time GQA works; decode with "
-        "model.apply or extend the cache layout to H_kv heads",
-    )
     enforce(
         cfg.get("pos_encoding", "sinusoid") == "sinusoid",
         "generate(): the static-cache decoder assumes additive sinusoid PE; "
@@ -204,8 +200,15 @@ def generate(
         out = x @ p(f"{pfx}/w")
         return out + p(f"{pfx}/b") if bias else out
 
-    def heads(x):  # [B, T, D] -> [B, H, T, dh]
-        return x.reshape(x.shape[0], x.shape[1], H, dh).transpose(0, 2, 1, 3)
+    def heads(x, n=None):  # [B, T, n*dh] -> [B, n, T, dh]
+        n = n or H
+        return x.reshape(x.shape[0], x.shape[1], n, dh).transpose(0, 2, 1, 3)
+
+    def grouped(q):  # [B, H, T, dh] -> [B, H_kv, G, T, dh]
+        return q.reshape(q.shape[0], H_kv, G, q.shape[2], dh)
+
+    def ungrouped(o):  # [B, H_kv, G, T, dh] -> [B, H, T, dh]
+        return o.reshape(o.shape[0], H, o.shape[3], dh)
 
     def embed(ids, pos0):
         e = jnp.take(p("emb/embedding/word_emb"), ids, axis=0) * (D ** 0.5)
@@ -215,8 +218,8 @@ def generate(
     def block(x, i, attend):
         pfx = f"layer_{i}/self_attn"
         q = heads(proj(x, f"{pfx}/q"))
-        k = heads(proj(x, f"{pfx}/k"))
-        v = heads(proj(x, f"{pfx}/v"))
+        k = heads(proj(x, f"{pfx}/k"), H_kv)
+        v = heads(proj(x, f"{pfx}/v"), H_kv)
         ctx = attend(q, k, v, i)  # [B, H, Tq, dh]
         ctx = ctx.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], D)
         x = ln(x + proj(ctx, f"{pfx}/out"), f"layer_{i}/layer_norm")
@@ -232,17 +235,17 @@ def generate(
         return jax.random.categorical(key, logits / temperature).astype(jnp.int32)
 
     # ---- prefill: full causal pass over the prompt fills caches [0, Tp)
-    kc0 = jnp.zeros((L, B, H, T_max, dh), jnp.float32)
-    vc0 = jnp.zeros((L, B, H, T_max, dh), jnp.float32)
+    kc0 = jnp.zeros((L, B, H_kv, T_max, dh), jnp.float32)
+    vc0 = jnp.zeros((L, B, H_kv, T_max, dh), jnp.float32)
     caches = {"k": kc0, "v": vc0}
 
     def prefill_attend(q, k, v, i):
         caches["k"] = caches["k"].at[i, :, :, :Tp].set(k)
         caches["v"] = caches["v"].at[i, :, :, :Tp].set(v)
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        s = jnp.einsum("bkgqd,bktd->bkgqt", grouped(q), k) * scale
         mask = jnp.tril(jnp.ones((Tp, Tp), bool))
         s = jnp.where(mask, s, -1e9)
-        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+        return ungrouped(jnp.einsum("bkgqt,bktd->bkgqd", jax.nn.softmax(s, -1), v))
 
     x = embed(prompt, 0)
     for i in range(L):
@@ -262,10 +265,10 @@ def generate(
             nonlocal kc, vc
             kc = jax.lax.dynamic_update_slice(kc, k[None], (i, 0, 0, t, 0))
             vc = jax.lax.dynamic_update_slice(vc, v[None], (i, 0, 0, t, 0))
-            s_ = jnp.einsum("bhqd,bhkd->bhqk", q, kc[i]) * scale
+            s_ = jnp.einsum("bkgqd,bktd->bkgqt", grouped(q), kc[i]) * scale
             live = jnp.arange(T_max) <= t
-            s_ = jnp.where(live[None, None, None, :], s_, -1e9)
-            return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s_, -1), vc[i])
+            s_ = jnp.where(live[None, None, None, None, :], s_, -1e9)
+            return ungrouped(jnp.einsum("bkgqt,bktd->bkgqd", jax.nn.softmax(s_, -1), vc[i]))
 
         y = xt
         for i in range(L):
