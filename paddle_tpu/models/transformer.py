@@ -118,10 +118,18 @@ def multi_head_attention(
         return _proj(out, d_model, shard_out=False, name="out")
 
 
-def positionwise_ffn(x, d_inner: int, d_model: int, dropout_rate: float, name: str = "ffn"):
+def positionwise_ffn(x, d_inner: int, d_model: int, dropout_rate: float,
+                     name: str = "ffn", activation: str = "relu"):
+    """``activation='swiglu'`` gates the up-projection with a SiLU branch
+    (modern LM FFN; two column-parallel matmuls instead of one)."""
     with name_scope(name):
-        hidden = _proj(x, d_inner, shard_out=True, name="fc1")
-        hidden = layers.relu(hidden)
+        if activation == "swiglu":
+            up = _proj(x, d_inner, shard_out=True, name="fc1")
+            gate = _proj(x, d_inner, shard_out=True, name="gate")
+            hidden = up * jax.nn.silu(gate)
+        else:
+            hidden = _proj(x, d_inner, shard_out=True, name="fc1")
+            hidden = layers.relu(hidden)
         if dropout_rate:
             hidden = layers.dropout(hidden, dropout_rate)
         return _proj(hidden, d_model, shard_out=False, name="fc2")

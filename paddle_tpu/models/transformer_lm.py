@@ -92,7 +92,10 @@ def lm_block(x, cfg, name):
             core=core, num_kv_heads=cfg.get("num_kv_heads"),
         )
         x = _post_process(x, attn, cfg["residual_dropout"])
-        ffn = positionwise_ffn(x, cfg["d_inner"], cfg["d_model"], cfg["relu_dropout"])
+        ffn = positionwise_ffn(
+            x, cfg["d_inner"], cfg["d_model"], cfg["relu_dropout"],
+            activation=cfg.get("ffn_activation", "relu"),
+        )
         return _post_process(x, ffn, cfg["residual_dropout"])
 
 
@@ -296,6 +299,7 @@ BASE_CFG = dict(
     num_heads=8,
     num_kv_heads=None,  # < num_heads -> grouped-query attention
     pos_encoding="sinusoid",  # or "rope" (rotary, applied at attention)
+    ffn_activation="relu",  # or "swiglu"
     n_layers=6,
     max_len=8192,
     attn_dropout=0.0,

@@ -310,3 +310,27 @@ def test_transformer_lm_generate_gqa_matches_naive_decode():
         naive.append(nxt)
         seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(jnp.stack(naive, 1)))
+
+
+def test_modern_lm_stack_trains():
+    """RoPE + GQA + SwiGLU together (the modern decoder stack) train and
+    decrease loss; generate() guards fire for the unsupported decode combo."""
+    rng = np.random.RandomState(0)
+    spec = models.get_model(
+        "transformer_lm", seq_len=32, vocab=64, d_model=32, num_heads=4,
+        num_kv_heads=2, n_layers=1, max_len=32, pos_encoding="rope",
+        ffn_activation="swiglu",
+    )
+    batch = spec.synth_batch(4, rng)
+    v = spec.model.init(0, *batch)
+    assert "layer_0/ffn/gate/w" in v.params
+    assert v.params["layer_0/self_attn/k/w"].shape[1] == 16  # 2 kv heads * 8
+    opt = spec.optimizer()
+    os_ = opt.create_state(v.params)
+    step = jax.jit(opt.minimize(spec.model))
+    losses = []
+    for i in range(4):
+        out = step(v, os_, *[jnp.asarray(b) for b in batch], rng=jax.random.PRNGKey(i))
+        v, os_ = out.variables, out.opt_state
+        losses.append(float(out.loss))
+    assert losses[-1] < losses[0]
