@@ -109,7 +109,8 @@ def scaled_dot_product_attention(
         and (dropout_rate == 0.0 or is_test)
         and q.ndim == 4
         and k.shape == v.shape
-        and q.shape[:2] == k.shape[:2]  # no MQA-style broadcast heads
+        and q.shape[0] == k.shape[0]
+        and q.shape[1] % k.shape[1] == 0  # equal heads or GQA/MQA grouping
         # the kernel's causal mask is top-left aligned (q_pos >= k_pos);
         # causal_mask below is bottom-right aligned for Tq != Tk — only
         # route equal-length causal calls so the two paths agree

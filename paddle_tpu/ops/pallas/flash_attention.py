@@ -168,18 +168,24 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
     ``kv_len`` ([B] int) masks key positions >= kv_len[b] (suffix padding,
     the LoD-replacement layout)."""
     B, H, T, d = q.shape
+    h_kv = k.shape[1]
     t_kv = k.shape[2]
+    enforce(H % h_kv == 0, f"{H} query heads not divisible by {h_kv} kv heads")
+    group = H // h_kv
     block_q = min(block_q, T)
     block_k = min(block_k, t_kv)
     enforce(T % block_q == 0, f"seq len {T} not divisible by block_q {block_q}")
     enforce(t_kv % block_k == 0, f"kv len {t_kv} not divisible by block_k {block_k}")
 
     qr = q.reshape(B * H, T, d)
-    kr = k.reshape(B * H, t_kv, d)
-    vr = v.reshape(B * H, t_kv, d)
+    kr = k.reshape(B * h_kv, t_kv, d)
+    vr = v.reshape(B * h_kv, t_kv, d)
     has_kvlen = kv_len is not None
     lens = _kvlen_rows(kv_len, B, H) if has_kvlen else jnp.zeros((B * H, 1), jnp.int32)
     from jax.experimental.pallas import tpu as pltpu
+
+    def kvrow(b):  # combined q row -> combined kv row (GQA head sharing)
+        return (b // H) * h_kv + (b % H) // group
 
     out_shapes = [
         jax.ShapeDtypeStruct((B * H, T, d), q.dtype),
@@ -196,8 +202,8 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
             grid=(B * H, T // block_q),
             in_specs=[
                 pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, t_kv, d), lambda b, i: (kvrow(b), 0, 0)),
+                pl.BlockSpec((1, t_kv, d), lambda b, i: (kvrow(b), 0, 0)),
                 pl.BlockSpec(memory_space=pltpu.SMEM),
             ],
             out_specs=[
@@ -222,8 +228,8 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
         grid=(B * H, T // block_q, t_kv // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kvrow(b), j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kvrow(b), j, 0)),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
@@ -248,17 +254,22 @@ def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dk_ref, dv_ref,
     dk_acc, dv_acc,
     *, block_q: int, block_k: int, causal: bool, sm_scale: float,
-    has_kvlen: bool,
+    has_kvlen: bool, n_qb: int,
 ):
     """dK/dV for one kv block, streaming q blocks through the innermost grid
     dim. P is recomputed from (Q, K, LSE) — FlashAttention-2 eq. (13-16):
-    dV += P^T dO; dS = P ∘ (dO V^T − Δ); dK += dS^T Q·scale."""
-    i = pl.program_id(2)
-    n_q = pl.num_programs(2)
+    dV += P^T dO; dS = P ∘ (dO V^T − Δ); dK += dS^T Q·scale.
+    Under GQA the innermost dim runs group * n_qb steps: all q blocks of
+    every query head sharing this kv head accumulate into the same
+    dk/dv block (``n_qb`` = T // block_q; the index maps route each step
+    to its (head, q-block) pair)."""
+    s_idx = pl.program_id(2)
+    n_total = pl.num_programs(2)
+    i = s_idx % n_qb  # q-block index within the current query head
     j = pl.program_id(1)
     kv_limit = kvlen_ref[pl.program_id(0), 0] if has_kvlen else None
 
-    @pl.when(i == 0)
+    @pl.when(s_idx == 0)
     def _():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -299,7 +310,7 @@ def _flash_bwd_dkv_kernel(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )  # dS^T (Q·scale) -> [block_k, d]
 
-    @pl.when(i == n_q - 1)
+    @pl.when(s_idx == n_total - 1)
     def _():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -358,17 +369,21 @@ def _flash_bwd_dq_kernel(
 
 def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret,
                kv_len=None):
-    """Fused backward: returns (dq, dk, dv), each the dtype of its primal."""
+    """Fused backward: returns (dq, dk, dv), each the dtype of its primal
+    (dk/dv at the kv head count under GQA)."""
     B, H, T, d = q.shape
+    h_kv = k.shape[1]
+    group = H // h_kv
     t_kv = k.shape[2]
     block_q = min(block_q, T)
     block_k = min(block_k, t_kv)
     enforce(T % block_q == 0, f"seq len {T} not divisible by block_q {block_q}")
     enforce(t_kv % block_k == 0, f"kv len {t_kv} not divisible by block_k {block_k}")
+    n_qb = T // block_q
 
     qr = q.reshape(B * H, T, d)
-    kr = k.reshape(B * H, t_kv, d)
-    vr = v.reshape(B * H, t_kv, d)
+    kr = k.reshape(B * h_kv, t_kv, d)
+    vr = v.reshape(B * h_kv, t_kv, d)
     gr = g.reshape(B * H, T, d)
     lse_r = lse.reshape(B * H, T, 1)
     # Δ = rowsum(dO ∘ O): cheap elementwise+reduce, XLA fuses it
@@ -378,26 +393,35 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
     )
     has_kvlen = kv_len is not None
     lens = _kvlen_rows(kv_len, B, H) if has_kvlen else jnp.zeros((B * H, 1), jnp.int32)
+    lens_kv = (
+        _kvlen_rows(kv_len, B, h_kv) if has_kvlen else jnp.zeros((B * h_kv, 1), jnp.int32)
+    )
     from jax.experimental.pallas import tpu as pltpu
+
+    def kvrow(b):  # combined q row -> combined kv row
+        return (b // H) * h_kv + (b % H) // group
+
+    def qrow(r, s):  # (combined kv row, grouped inner step) -> combined q row
+        return (r // h_kv) * H + (r % h_kv) * group + s // n_qb
 
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel,
         block_q=block_q, block_k=block_k, causal=causal, sm_scale=sm_scale,
-        has_kvlen=has_kvlen,
+        has_kvlen=has_kvlen, n_qb=n_qb,
     )
-    # grid: q innermost (sequential accumulate), kv parallel
-    q_stream = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
-    row_stream = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
-    kv_fixed = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    # grid: (group * q-blocks) innermost (sequential accumulate), kv parallel
+    q_stream = pl.BlockSpec((1, block_q, d), lambda r, j, s: (qrow(r, s), s % n_qb, 0))
+    row_stream = pl.BlockSpec((1, block_q, 1), lambda r, j, s: (qrow(r, s), s % n_qb, 0))
+    kv_fixed = pl.BlockSpec((1, block_k, d), lambda r, j, s: (r, j, 0))
     len_spec3 = pl.BlockSpec(memory_space=pltpu.SMEM)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(B * H, t_kv // block_k, T // block_q),
+        grid=(B * h_kv, t_kv // block_k, group * n_qb),
         in_specs=[q_stream, kv_fixed, kv_fixed, q_stream, row_stream, row_stream, len_spec3],
         out_specs=[kv_fixed, kv_fixed],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, t_kv, d), k.dtype),
-            jax.ShapeDtypeStruct((B * H, t_kv, d), v.dtype),
+            jax.ShapeDtypeStruct((B * h_kv, t_kv, d), k.dtype),
+            jax.ShapeDtypeStruct((B * h_kv, t_kv, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -407,7 +431,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(qr, kr, vr, gr, lse_r, delta, lens)
+    )(qr, kr, vr, gr, lse_r, delta, lens_kv)
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel,
@@ -417,7 +441,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
     # grid: kv innermost (sequential accumulate), q parallel
     q_fixed = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     row_fixed = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
-    kv_stream = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    kv_stream = pl.BlockSpec((1, block_k, d), lambda b, i, j: (kvrow(b), j, 0))
     (dq,) = pl.pallas_call(
         dq_kernel,
         grid=(B * H, T // block_q, t_kv // block_k),
@@ -433,14 +457,19 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
 
     return (
         dq.reshape(B, H, T, d),
-        dk.reshape(B, H, t_kv, d),
-        dv.reshape(B, H, t_kv, d),
+        dk.reshape(B, h_kv, t_kv, d),
+        dv.reshape(B, h_kv, t_kv, d),
     )
 
 
 def _reference_attention(q, k, v, causal: bool, sm_scale: float, kv_len=None):
     # f32 accumulation in both einsums — bf16 inputs must not produce
-    # bf16-precision scores in the recomputed backward
+    # bf16-precision scores in the recomputed backward. GQA: repeat kv heads
+    # (correctness path only; repeat's VJP sums group grads back to h_kv)
+    if k.shape[1] != q.shape[1]:
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * sm_scale
@@ -567,11 +596,14 @@ def flash_attention(
 ) -> jax.Array:
     """Fused attention: ``softmax(QK^T * sm_scale) V``.
 
-    q/k/v: [B, H, T, d]. ``kv_len`` ([B] int, values >= 1) masks key
-    positions >= kv_len[b] — suffix padding, the framework's LoD
-    replacement — in forward AND fused backward, with fully-padded tail
-    blocks skipped. ``interpret`` defaults to True off-TPU so the same
-    code path runs under the CPU test mesh."""
+    q: [B, H, T, d]; k/v: [B, H_kv, T, d] with H % H_kv == 0 — H_kv < H is
+    grouped-query attention (kv blocks are fetched once per shared head via
+    the index maps; dK/dV accumulate over the query-head group in the fused
+    backward). ``kv_len`` ([B] int, values >= 1) masks key positions >=
+    kv_len[b] — suffix padding, the framework's LoD replacement — in
+    forward AND fused backward, with fully-padded tail blocks skipped.
+    ``interpret`` defaults to True off-TPU so the same code path runs under
+    the CPU test mesh."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     if interpret is None:
