@@ -96,6 +96,36 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         out["errors"].append(f"flash_compiled: {type(e).__name__}: {e}"[:400])
 
+    # --- 1b. compiled GQA flash (kv-row index maps + grouped dkv grid) ---
+    try:
+        B, H, Hkv, T, d = 2, 4, 2, 512, 64
+        rng = np.random.RandomState(1)
+        qg = jax.device_put(jnp.asarray(rng.randn(B, H, T, d), dtype=jnp.float32))
+        kg = jax.device_put(jnp.asarray(rng.randn(B, Hkv, T, d), dtype=jnp.float32))
+        vg = jax.device_put(jnp.asarray(rng.randn(B, Hkv, T, d), dtype=jnp.float32))
+
+        def loss_gqa(q, k, v):
+            return flash_attention(q, k, v, causal=True, interpret=False).sum()
+
+        o_f = jax.jit(flash_attention, static_argnames=("causal", "interpret"))(
+            qg, kg, vg, causal=True, interpret=False
+        )
+        o_r = _reference_attention(qg, kg, vg, True, d ** -0.5)
+        fwd_err = float(jnp.max(jnp.abs(o_f - o_r)))
+        g_f = jax.jit(jax.grad(loss_gqa, (0, 1, 2)))(qg, kg, vg)
+        g_r = jax.jit(
+            jax.grad(lambda a, b, c: _reference_attention(a, b, c, True, d ** -0.5).sum(), (0, 1, 2))
+        )(qg, kg, vg)
+        jax.block_until_ready((g_f, g_r))
+        bwd_err = max(float(jnp.max(jnp.abs(a - b))) for a, b in zip(g_f, g_r))
+        out["checks"]["flash_gqa_compiled"] = {
+            "fwd_max_abs_err": fwd_err,
+            "bwd_max_abs_err": bwd_err,
+            "pass": fwd_err < 2e-2 and bwd_err < 5e-2,
+        }
+    except Exception as e:  # noqa: BLE001
+        out["errors"].append(f"flash_gqa_compiled: {type(e).__name__}: {e}"[:400])
+
     # --- 2. one jit train step per model family (tiny shapes) ---
     from paddle_tpu import models
 
