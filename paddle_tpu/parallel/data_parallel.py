@@ -46,11 +46,21 @@ class DataParallel:
         loss_index: int = 0,
         donate: bool = True,
         batch_specs: Optional[Sequence[Optional[P]]] = None,
+        zero_shard_optimizer: bool = False,
     ):
         """``batch_specs``: optional per-batch-arg PartitionSpecs overriding
         the default leading-dim data sharding — e.g. shard the sequence dim of
         token inputs over the ``seq`` axis: ``P('data', 'seq')`` (sequence
-        parallelism; the activation sharding the reference never had)."""
+        parallelism; the activation sharding the reference never had).
+
+        ``zero_shard_optimizer`` (ZeRO-1, TPU-native form): optimizer slot
+        buffers of replicated params are declared sharded over the data axis
+        (leading dim, where divisible) in the step's in/out_shardings — the
+        SPMD partitioner then materializes the reduce-scatter/all-gather
+        pattern, cutting optimizer-state HBM by the data-axis size. The
+        reference's Reduce+Broadcast BuildStrategy
+        (``multi_devices_graph_pass.cc:397-446``) solved the same problem by
+        placing each param's update on one owner device."""
         from paddle_tpu.core import config as _cfg
 
         _cfg.apply_compile_cache()
@@ -61,6 +71,7 @@ class DataParallel:
         self.loss_index = loss_index
         self.donate = donate
         self.batch_specs = tuple(batch_specs) if batch_specs is not None else None
+        self.zero_shard_optimizer = zero_shard_optimizer
         self._step_fn = None
         self._eval_fn = None
         enforce(
@@ -76,10 +87,11 @@ class DataParallel:
             variables = self.model.init(rng, *example_batch)
         variables = shard_variables(self.mesh, variables, self.model.param_info)
         opt_state = self.optimizer.create_state(variables.params)
-        # slots share their param's sharding; step counter replicated
-        p_shards = param_shardings(self.mesh, self.model.param_info, variables.params)
+        # slots share their param's sharding (or the ZeRO-1 data sharding);
+        # step counter replicated
+        _, opt_sh = self._state_shardings(variables, opt_state)
         slots = {
-            s: {k: jax.device_put(v, p_shards[k]) for k, v in d.items()}
+            s: {k: jax.device_put(v, opt_sh.slots[s][k]) for k, v in d.items()}
             for s, d in opt_state.slots.items()
         }
         opt_state = OptState(
@@ -129,16 +141,36 @@ class DataParallel:
 
     def _state_shardings(self, variables: Variables, opt_state: OptState):
         """Sharding pytrees matching (variables, opt_state): params/slots per
-        their annotated specs, everything else replicated."""
+        their annotated specs, everything else replicated. With
+        ``zero_shard_optimizer``, slots of replicated params get a leading-dim
+        ``data`` sharding instead (ZeRO-1)."""
         p_sh = param_shardings(self.mesh, self.model.param_info, variables.params)
         rep = replicated(self.mesh)
+
+        def slot_sharding(name, slot_val):
+            base = p_sh[name]
+            actually_sharded = any(a is not None for a in base.spec)
+            if not self.zero_shard_optimizer or actually_sharded:
+                return base  # model-parallel params keep their own sharding
+            n_data = self.mesh.shape[self.batch_axis]
+            shape = jax.numpy.shape(slot_val)
+            # first dim divisible by the data-axis size carries the shard
+            # (a flattened 1/N split is not expressible as a dim sharding)
+            for dim, size in enumerate(shape):
+                if size % n_data == 0 and size >= n_data:
+                    dims = [None] * len(shape)
+                    dims[dim] = self.batch_axis
+                    return NamedSharding(self.mesh, P(*dims))
+            return base
+
         var_sh = Variables(
             dict(p_sh), jax.tree_util.tree_map(lambda _: rep, variables.state)
         )
         opt_sh = OptState(
             step=rep,
             slots={
-                s: {k: p_sh[k] for k in d} for s, d in opt_state.slots.items()
+                s: {k: slot_sharding(k, v) for k, v in d.items()}
+                for s, d in opt_state.slots.items()
             },
         )
         return var_sh, opt_sh
