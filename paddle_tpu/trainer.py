@@ -83,6 +83,7 @@ class Trainer:
         parallel: bool = False,
         checkpoint_config: Optional[CheckpointConfig] = None,
         rng: int | jax.Array | None = 0,
+        parallel_kwargs: Optional[dict] = None,
     ):
         from paddle_tpu.framework import build
 
@@ -90,6 +91,8 @@ class Trainer:
         self.model = model if isinstance(model, Model) else build(model)
         self.optimizer = optimizer_func()
         self.parallel = parallel
+        # extra DataParallel options (mesh=..., zero_shard_optimizer=True, ...)
+        self.parallel_kwargs = dict(parallel_kwargs or {})
         self.checkpoint_cfg = checkpoint_config
         self.rng = rng
         self.place = place
@@ -116,7 +119,9 @@ class Trainer:
             from paddle_tpu.parallel import DataParallel
             from paddle_tpu.parallel.mesh import default_mesh
 
-            self._dp = DataParallel(self.model, self.optimizer, mesh=default_mesh())
+            kw = dict(self.parallel_kwargs)
+            kw.setdefault("mesh", default_mesh())
+            self._dp = DataParallel(self.model, self.optimizer, **kw)
             self.variables, self.opt_state = self._dp.init(self.rng, *first_batch)
         else:
             self.variables = self.model.init(self.rng, *first_batch)
