@@ -37,7 +37,7 @@ __all__ = ["flash_attention", "flash_attention_with_lse", "flash_attention_bwd_b
 def _flash_fwd_kernel(
     q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     *, block_q: int, block_k: int, causal: bool, sm_scale: float,
-    has_kvlen: bool,
+    has_kvlen: bool, window=None,
 ):
     """One (batch*head, q_block, kv_block) grid cell. Only the CURRENT
     [block_k, d] K/V tiles are VMEM-resident — long sequences stream through
@@ -58,6 +58,11 @@ def _flash_fwd_kernel(
     # their compute entirely (half the FLOPs on average); same for kv
     # blocks entirely past this row's kv_len (padded tails)
     live = (j * block_k <= q_blk * block_q + block_q - 1) if causal else True
+    if window is not None:
+        # kv block entirely left of every query's window -> dead
+        live = jnp.logical_and(
+            live, j * block_k + block_k - 1 >= q_blk * block_q - (window - 1)
+        )
     if has_kvlen:
         live = jnp.logical_and(live, j * block_k < kv_limit)
 
@@ -73,6 +78,8 @@ def _flash_fwd_kernel(
             q_pos = q_blk * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            if window is not None:
+                s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
         if has_kvlen:
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(k_pos < kv_limit, s, NEG_INF)
@@ -98,6 +105,7 @@ def _flash_fwd_kernel(
 def _flash_fwd_kernel_resident(
     q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref,
     *, block_k: int, causal: bool, sm_scale: float, has_kvlen: bool,
+    window=None,
 ):
     """Fast path for K/V that fit in VMEM: one (batch*head, q_block) grid
     cell holds the whole K/V and loops kv blocks with a fori_loop — the
@@ -120,6 +128,8 @@ def _flash_fwd_kernel_resident(
             q_pos = q_blk * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            if window is not None:
+                s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
         if has_kvlen:
             k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(k_pos < kv_limit, s, NEG_INF)
@@ -140,12 +150,15 @@ def _flash_fwd_kernel_resident(
         n_kv_used = n_kv
     if has_kvlen:  # fully-padded tail blocks contribute nothing — skip them
         n_kv_used = jnp.minimum(n_kv_used, pl.cdiv(kv_limit, block_k))
+    lo = 0
+    if window is not None:  # kv blocks left of every window: skip entirely
+        lo = jnp.maximum(0, (q_blk * block_q - (window - 1)) // block_k)
     init = (
         jnp.full((block_q, 1), NEG_INF, jnp.float32),
         jnp.zeros((block_q, 1), jnp.float32),
         jnp.zeros((block_q, d), jnp.float32),
     )
-    m, l, acc = jax.lax.fori_loop(0, n_kv_used, body, init)
+    m, l, acc = jax.lax.fori_loop(lo, n_kv_used, body, init)
     l_safe = jnp.maximum(l, 1e-20)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
     lse_ref[0] = m + jnp.log(l_safe)
@@ -162,7 +175,7 @@ def _kvlen_rows(kv_len, B: int, H: int):
 
 
 def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int,
-               interpret: bool, kv_len=None):
+               interpret: bool, kv_len=None, window=None):
     """Returns ``(out [B,H,T,d], lse [B,H,T,1])`` — lse is the per-row
     logsumexp of the scaled scores, consumed by the fused backward.
     ``kv_len`` ([B] int) masks key positions >= kv_len[b] (suffix padding,
@@ -196,6 +209,7 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
         kernel = functools.partial(
             _flash_fwd_kernel_resident,
             block_k=block_k, causal=causal, sm_scale=sm_scale, has_kvlen=has_kvlen,
+            window=window,
         )
         out, lse = pl.pallas_call(
             kernel,
@@ -221,7 +235,7 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
     kernel = functools.partial(
         _flash_fwd_kernel,
         block_q=block_q, block_k=block_k, causal=causal, sm_scale=sm_scale,
-        has_kvlen=has_kvlen,
+        has_kvlen=has_kvlen, window=window,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -254,7 +268,7 @@ def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dk_ref, dv_ref,
     dk_acc, dv_acc,
     *, block_q: int, block_k: int, causal: bool, sm_scale: float,
-    has_kvlen: bool, n_qb: int,
+    has_kvlen: bool, n_qb: int, window=None,
 ):
     """dK/dV for one kv block, streaming q blocks through the innermost grid
     dim. P is recomputed from (Q, K, LSE) — FlashAttention-2 eq. (13-16):
@@ -277,6 +291,10 @@ def _flash_bwd_dkv_kernel(
     # causal: q blocks fully above this kv block's diagonal see none of it;
     # kv blocks fully past kv_len contribute zero grads — skip both
     live = (i * block_q + block_q - 1 >= j * block_k) if causal else True
+    if window is not None:
+        live = jnp.logical_and(
+            live, j * block_k + block_k - 1 >= i * block_q - (window - 1)
+        )
     if has_kvlen:
         live = jnp.logical_and(live, j * block_k < kv_limit)
 
@@ -295,6 +313,8 @@ def _flash_bwd_dkv_kernel(
             q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            if window is not None:
+                s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
         if has_kvlen:
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(k_pos < kv_limit, s, NEG_INF)
@@ -319,7 +339,7 @@ def _flash_bwd_dkv_kernel(
 def _flash_bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dq_ref, dq_acc,
     *, block_q: int, block_k: int, causal: bool, sm_scale: float,
-    has_kvlen: bool,
+    has_kvlen: bool, window=None,
 ):
     """dQ for one q block, streaming kv blocks: dQ += dS K·scale."""
     j = pl.program_id(2)
@@ -332,6 +352,10 @@ def _flash_bwd_dq_kernel(
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     live = (j * block_k <= i * block_q + block_q - 1) if causal else True
+    if window is not None:
+        live = jnp.logical_and(
+            live, j * block_k + block_k - 1 >= i * block_q - (window - 1)
+        )
     if has_kvlen:
         live = jnp.logical_and(live, j * block_k < kv_limit)
 
@@ -350,6 +374,8 @@ def _flash_bwd_dq_kernel(
             q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            if window is not None:
+                s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
         if has_kvlen:
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(k_pos < kv_limit, s, NEG_INF)
@@ -368,7 +394,7 @@ def _flash_bwd_dq_kernel(
 
 
 def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret,
-               kv_len=None):
+               kv_len=None, window=None):
     """Fused backward: returns (dq, dk, dv), each the dtype of its primal
     (dk/dv at the kv head count under GQA)."""
     B, H, T, d = q.shape
@@ -407,7 +433,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel,
         block_q=block_q, block_k=block_k, causal=causal, sm_scale=sm_scale,
-        has_kvlen=has_kvlen, n_qb=n_qb,
+        has_kvlen=has_kvlen, n_qb=n_qb, window=window,
     )
     # grid: (group * q-blocks) innermost (sequential accumulate), kv parallel
     q_stream = pl.BlockSpec((1, block_q, d), lambda r, j, s: (qrow(r, s), s % n_qb, 0))
@@ -436,7 +462,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel,
         block_q=block_q, block_k=block_k, causal=causal, sm_scale=sm_scale,
-        has_kvlen=has_kvlen,
+        has_kvlen=has_kvlen, window=window,
     )
     # grid: kv innermost (sequential accumulate), q parallel
     q_fixed = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
@@ -462,7 +488,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
     )
 
 
-def _reference_attention(q, k, v, causal: bool, sm_scale: float, kv_len=None):
+def _reference_attention(q, k, v, causal: bool, sm_scale: float, kv_len=None, window=None):
     # f32 accumulation in both einsums — bf16 inputs must not produce
     # bf16-precision scores in the recomputed backward. GQA: repeat kv heads
     # (correctness path only; repeat's VJP sums group grads back to h_kv)
@@ -476,6 +502,8 @@ def _reference_attention(q, k, v, causal: bool, sm_scale: float, kv_len=None):
     if causal:
         T, S = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((T, S), bool))
+        if window is not None:  # sliding window: keep only the last `window` keys
+            mask = jnp.logical_and(mask, ~jnp.tril(jnp.ones((T, S), bool), -window))
         s = jnp.where(mask, s, NEG_INF)
     if kv_len is not None:
         k_pos = jnp.arange(s.shape[-1])
@@ -492,37 +520,38 @@ def _float0_like(x):
     return _np.zeros(x.shape, jax.dtypes.float0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, kv_len, causal, sm_scale, block_q, block_k, interpret, has_kvlen):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, kv_len, causal, sm_scale, block_q, block_k, interpret, has_kvlen, window):
     out, _ = _flash_fwd(
         q, k, v, causal, sm_scale, block_q, block_k, interpret,
-        kv_len if has_kvlen else None,
+        kv_len if has_kvlen else None, window,
     )
     return out
 
 
-def _flash_vjp_fwd(q, k, v, kv_len, causal, sm_scale, block_q, block_k, interpret, has_kvlen):
+def _flash_vjp_fwd(q, k, v, kv_len, causal, sm_scale, block_q, block_k, interpret, has_kvlen, window):
     out, lse = _flash_fwd(
         q, k, v, causal, sm_scale, block_q, block_k, interpret,
-        kv_len if has_kvlen else None,
+        kv_len if has_kvlen else None, window,
     )
     return out, (q, k, v, kv_len, out, lse)
 
 
-def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, has_kvlen, res, g):
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, has_kvlen, window, res, g):
     q, k, v, kv_len, out, lse = res
     from paddle_tpu.core.config import flags
 
     if flags().flash_fused_bwd:
         dq, dk, dv = _flash_bwd(
             q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret,
-            kv_len if has_kvlen else None,
+            kv_len if has_kvlen else None, window,
         )
     else:
         # recomputed XLA attention backward (activations were never stored)
         _, vjp = jax.vjp(
             lambda a, b, c: _reference_attention(
-                a, b, c, causal, sm_scale, kv_len if has_kvlen else None
+                a, b, c, causal, sm_scale, kv_len if has_kvlen else None,
+                window=window,
             ),
             q, k, v,
         )
@@ -593,6 +622,7 @@ def flash_attention(
     block_k: int = 128,
     interpret: Optional[bool] = None,
     kv_len: Optional[jax.Array] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Fused attention: ``softmax(QK^T * sm_scale) V``.
 
@@ -602,16 +632,23 @@ def flash_attention(
     backward). ``kv_len`` ([B] int, values >= 1) masks key positions >=
     kv_len[b] — suffix padding, the framework's LoD replacement — in
     forward AND fused backward, with fully-padded tail blocks skipped.
+    ``window`` (with causal=True) restricts attention to the last ``window``
+    keys — sliding-window attention; out-of-window kv blocks are skipped
+    entirely, making compute O(T * window) instead of O(T^2/2).
     ``interpret`` defaults to True off-TPU so the same code path runs under
     the CPU test mesh."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if window is not None:
+        enforce(causal, "flash_attention: window (sliding-window attention) "
+                        "requires causal=True")
+        enforce(window >= 1, f"window must be >= 1, got {window}")
     has_kvlen = kv_len is not None
     if not has_kvlen:
         kv_len = jnp.zeros((q.shape[0],), jnp.int32)
     return _flash(
         q, k, v, kv_len.astype(jnp.int32), causal, float(sm_scale),
-        block_q, block_k, interpret, has_kvlen,
+        block_q, block_k, interpret, has_kvlen, window,
     )

@@ -268,3 +268,43 @@ def test_flash_attention_gqa_with_kvlen(rng):
     out = flash_attention(q, k, v, causal=False, block_q=16, block_k=16, kv_len=kv_len)
     ref = _reference_attention(q, k, v, False, d ** -0.5, kv_len=kv_len)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("window", [16, 24, 64])
+def test_flash_attention_sliding_window(rng, window):
+    """Sliding-window attention (causal, last `window` keys only): flash
+    output and fused gradients match the masked reference; out-of-window
+    blocks are skip-computed in both directions."""
+    from paddle_tpu.ops.pallas.flash_attention import (
+        _reference_attention,
+        flash_attention,
+    )
+
+    B, H, T, d = 2, 2, 64, 16
+    q = jnp.asarray(rng.randn(B, H, T, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, H, T, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, H, T, d).astype(np.float32))
+
+    out = flash_attention(q, k, v, causal=True, window=window, block_q=16, block_k=16)
+    ref = _reference_attention(q, k, v, True, d ** -0.5, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+    g_f = jax.grad(
+        lambda a, b, c: flash_attention(a, b, c, causal=True, window=window,
+                                        block_q=16, block_k=16).sum(), (0, 1, 2)
+    )(q, k, v)
+    g_r = jax.grad(
+        lambda a, b, c: _reference_attention(a, b, c, True, d ** -0.5,
+                                             window=window).sum(), (0, 1, 2)
+    )(q, k, v)
+    for a, b in zip(g_f, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5)
+
+
+def test_flash_sliding_window_requires_causal(rng):
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+    from paddle_tpu.core.enforce import EnforceError
+
+    q = jnp.zeros((1, 1, 16, 8), jnp.float32)
+    with pytest.raises(EnforceError, match="causal"):
+        flash_attention(q, q, q, causal=False, window=8)
