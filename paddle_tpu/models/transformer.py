@@ -65,6 +65,7 @@ def multi_head_attention(
     core=None,
     kv_len=None,
     num_kv_heads: Optional[int] = None,
+    window: Optional[int] = None,
 ):
     """Projected multi-head attention (q/k/v/out linear maps + fused core).
 
@@ -113,6 +114,7 @@ def multi_head_attention(
                 dropout_key=pt.framework.next_rng_key() if (dropout_rate > 0 and pt.framework.is_training()) else None,
                 causal=causal,
                 kv_len=kv_len,
+                window=window,
             )
         out = oattn.combine_heads(ctx)
         return _proj(out, d_model, shard_out=False, name="out")

@@ -90,6 +90,7 @@ def lm_block(x, cfg, name):
             x, x, x, cfg["d_model"], cfg["num_heads"],
             dropout_rate=cfg["attn_dropout"], causal=True, name="self_attn",
             core=core, num_kv_heads=cfg.get("num_kv_heads"),
+            window=cfg.get("attention_window"),
         )
         x = _post_process(x, attn, cfg["residual_dropout"])
         ffn = positionwise_ffn(
@@ -300,6 +301,7 @@ BASE_CFG = dict(
     num_kv_heads=None,  # < num_heads -> grouped-query attention
     pos_encoding="sinusoid",  # or "rope" (rotary, applied at attention)
     ffn_activation="relu",  # or "swiglu"
+    attention_window=None,  # int -> sliding-window attention (O(T*W))
     n_layers=6,
     max_len=8192,
     attn_dropout=0.0,

@@ -83,6 +83,7 @@ def scaled_dot_product_attention(
     scale: Optional[float] = None,
     causal: bool = False,
     kv_len: Optional[jax.Array] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Attention over [..., T, D] tensors (head dims lead). ``mask`` is an
     additive mask broadcastable to [..., Tq, Tk] (0 = keep, -inf = drop);
@@ -115,6 +116,7 @@ def scaled_dot_product_attention(
         # causal_mask below is bottom-right aligned for Tq != Tk — only
         # route equal-length causal calls so the two paths agree
         and (not causal or q.shape[-2] == k.shape[-2])
+        and (window is None or causal)
     ):
         bq = _flash_block(q.shape[-2])
         bk = _flash_block(k.shape[-2])
@@ -126,7 +128,7 @@ def scaled_dot_product_attention(
             q, k, v = mxu_operands(q, k, v)  # bf16 halves K/V HBM traffic
             return flash_attention(
                 q, k, v, causal=causal, sm_scale=scale, block_q=bq, block_k=bk,
-                kv_len=kv_len,
+                kv_len=kv_len, window=window,
             ).astype(out_dtype)
     if kv_len is not None:
         from paddle_tpu.core.dtypes import NEG_INF
@@ -142,6 +144,12 @@ def scaled_dot_product_attention(
     if causal:
         mask_c = causal_mask(q.shape[-2], k.shape[-2])
         mask = mask_c if mask is None else mask + mask_c
+    if window is not None:
+        t_q, t_k = q.shape[-2], k.shape[-2]
+        i = jnp.arange(t_q)[:, None] + (t_k - t_q)  # align ends for Tq != Tk
+        jpos = jnp.arange(t_k)[None, :]
+        wmask = jnp.where(i - jpos < window, 0.0, -jnp.inf).astype(jnp.float32)
+        mask = wmask if mask is None else mask + wmask
     from paddle_tpu.core.dtypes import mxu_operands
 
     out_dtype = q.dtype

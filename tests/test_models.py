@@ -334,3 +334,34 @@ def test_modern_lm_stack_trains():
         v, os_ = out.variables, out.opt_state
         losses.append(float(out.loss))
     assert losses[-1] < losses[0]
+
+
+def test_lm_attention_window_trains_and_limits_context():
+    """attention_window: the LM trains, and a token's logits are invariant
+    to tokens further back than the window."""
+    rng = np.random.RandomState(0)
+    kw = dict(seq_len=32, vocab=64, d_model=32, num_heads=2, n_layers=1,
+              max_len=32, attention_window=8)
+    spec = models.get_model("transformer_lm", **kw)
+    batch = spec.synth_batch(2, rng)
+    v = spec.model.init(0, *batch)
+
+    ids = np.asarray(batch[0]).copy()
+    (_, _, logits_a), _ = spec.model.apply(v, jnp.asarray(ids), jnp.asarray(batch[1]), is_train=False)
+    # perturb a token 20 positions before the last: outside window 8
+    ids_b = ids.copy()
+    ids_b[:, 11] = (ids_b[:, 11] + 7) % 63 + 1
+    (_, _, logits_b), _ = spec.model.apply(v, jnp.asarray(ids_b), jnp.asarray(batch[1]), is_train=False)
+    np.testing.assert_allclose(
+        np.asarray(logits_a[:, -1]), np.asarray(logits_b[:, -1]), rtol=1e-5, atol=1e-6
+    )
+    # ... but a token INSIDE the window changes the logits
+    ids_c = ids.copy()
+    ids_c[:, 30] = (ids_c[:, 30] + 7) % 63 + 1
+    (_, _, logits_c), _ = spec.model.apply(v, jnp.asarray(ids_c), jnp.asarray(batch[1]), is_train=False)
+    assert float(np.abs(np.asarray(logits_c[:, -1]) - np.asarray(logits_a[:, -1])).max()) > 1e-4
+
+    opt = spec.optimizer()
+    os_ = opt.create_state(v.params)
+    out = jax.jit(opt.minimize(spec.model))(v, os_, *[jnp.asarray(b) for b in batch], rng=jax.random.PRNGKey(0))
+    assert np.isfinite(float(out.loss))
