@@ -68,7 +68,8 @@ def _rope_core(cfg):
     def core(qh, kh, vh):
         cos, sin = rope_tables(qh.shape[-1], qh.shape[-2])
         return scaled_dot_product_attention(
-            apply_rope(qh, cos, sin), apply_rope(kh, cos, sin), vh, causal=True
+            apply_rope(qh, cos, sin), apply_rope(kh, cos, sin), vh, causal=True,
+            window=cfg.get("attention_window"),
         )
 
     return core
@@ -77,6 +78,11 @@ def _rope_core(cfg):
 def lm_block(x, cfg, name):
     ring_mesh = cfg.get("ring_mesh")
     ulysses_mesh = cfg.get("ulysses_mesh")
+    if (ring_mesh is not None or ulysses_mesh is not None) and cfg.get("attention_window"):
+        raise NotImplementedError(
+            "attention_window is not supported together with ring/ulysses "
+            "sequence parallelism yet"
+        )
     if ring_mesh is not None:
         core = _ring_core(ring_mesh)
     elif ulysses_mesh is not None:
