@@ -41,6 +41,8 @@ from paddle_tpu import backward
 from paddle_tpu.executor import Executor
 from paddle_tpu import reader
 from paddle_tpu import metrics
+from paddle_tpu import average
+from paddle_tpu import evaluator
 from paddle_tpu import io
 from paddle_tpu import checkpoint
 from paddle_tpu import parallel

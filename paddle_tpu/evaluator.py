@@ -1,0 +1,20 @@
+"""Evaluator shims (reference ``python/paddle/fluid/evaluator.py``).
+
+The reference module was already deprecated in favor of ``fluid.metrics``
+(its classes carry "Warning: better to use fluid.metrics" docstrings); here
+the stateful accumulators live in :mod:`paddle_tpu.metrics`, and this module
+re-exports them under the Evaluator names so reference code ports cleanly.
+The graph-state mechanics (``_create_state`` on the Program) have no TPU
+analogue — accumulation is host-side numpy over fetched per-batch values.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.metrics import (  # noqa: F401
+    Accuracy,
+    ChunkEvaluator,
+    DetectionMAP,
+    EditDistance,
+)
+
+__all__ = ["Accuracy", "ChunkEvaluator", "DetectionMAP", "EditDistance"]
