@@ -53,6 +53,10 @@ CASES = [
     ("elementwise_pow", om.elementwise_pow, [XPOS, np.full((2, 3), 2.0, np.float32)], (0,), {}),
     ("relu", om.relu, [X22], (0,), {}),
     ("relu6", om.relu6, [X22], (0,), {}),
+    ("maxout", lambda x: on.maxout(x, 2),
+     [(np.arange(108, dtype=np.float32).reshape(2, 3, 3, 6) * 0.07) % 1.9 + 0.1
+      + np.tile(np.array([0.0, 5.0], np.float32), 54).reshape(2, 3, 3, 6)],
+     (0,), {}),
     ("sigmoid", om.sigmoid, [X22], (0,), {}),
     ("tanh", om.tanh, [X22], (0,), {}),
     ("softplus", om.softplus, [X22], (0,), {}),
