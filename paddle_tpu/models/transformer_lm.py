@@ -83,11 +83,6 @@ def lm_block(x, cfg, name):
             "attention_window is not supported together with ring/ulysses "
             "sequence parallelism yet"
         )
-    if (ring_mesh is not None or ulysses_mesh is not None) and cfg.get("num_kv_heads"):
-        raise NotImplementedError(
-            "num_kv_heads (GQA) is not supported together with ring/ulysses "
-            "sequence parallelism yet"
-        )
     if ring_mesh is not None:
         core = _ring_core(ring_mesh)
     elif ulysses_mesh is not None:

@@ -31,8 +31,13 @@ __all__ = ["ring_attention", "ring_attention_sharded"]
 
 def _block_attn(q, k, v, bias):
     """Scores + online-softmax partials for one (Q-block, KV-block) pair.
-    q: [B, H, Tq, d]; k/v: [B, H, Tk, d]; bias broadcastable to
-    [B, H, Tq, Tk]. Returns (m, l, o): running max, denominator, numerator."""
+    q: [B, H, Tq, d]; k/v: [B, H_kv, Tk, d] (H_kv < H = GQA, repeated here —
+    this composed body is the correctness/recompute path); bias
+    broadcastable to [B, H, Tq, Tk]. Returns (m, l, o)."""
+    if k.shape[1] != q.shape[1]:
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     scores = scores + bias
     m = jnp.max(scores, axis=-1)  # [B, H, Tq]

@@ -64,6 +64,10 @@ def ulysses_attention(
     """
     n = jax.lax.psum(1, axis)
     enforce(q.shape[1] % n == 0, f"num_heads {q.shape[1]} not divisible by {axis} size {n}")
+    enforce(k.shape[1] % n == 0,
+            f"kv heads {k.shape[1]} not divisible by {axis} size {n} (GQA "
+            "under ulysses needs num_kv_heads % seq-axis == 0; use ring "
+            "attention otherwise)")
     # split the head dim across the axis, gather the seq dim
     qh = jax.lax.all_to_all(q, axis, split_axis=1, concat_axis=2, tiled=True)
     kh = jax.lax.all_to_all(k, axis, split_axis=1, concat_axis=2, tiled=True)
