@@ -84,6 +84,7 @@ class Trainer:
         checkpoint_config: Optional[CheckpointConfig] = None,
         rng: int | jax.Array | None = 0,
         parallel_kwargs: Optional[dict] = None,
+        prefetch: bool = False,
     ):
         from paddle_tpu.framework import build
 
@@ -93,6 +94,9 @@ class Trainer:
         self.parallel = parallel
         # extra DataParallel options (mesh=..., zero_shard_optimizer=True, ...)
         self.parallel_kwargs = dict(parallel_kwargs or {})
+        # async host->device double buffering of reader batches (the
+        # reference's double_buffer reader, operators/reader/buffered_reader.cc)
+        self.prefetch = prefetch
         self.checkpoint_cfg = checkpoint_config
         self.rng = rng
         self.place = place
@@ -194,7 +198,7 @@ class Trainer:
             for epoch_id in range(self.epoch, num_epochs):
                 self.epoch = epoch_id
                 handler(BeginEpochEvent(epoch_id))
-                for step_id, batch in enumerate(reader()):
+                for step_id, batch in enumerate(self._batches(reader)):
                     begin_ev = BeginStepEvent(epoch_id, step_id)
                     handler(begin_ev)
                     out = self._run_step(batch)
@@ -291,6 +295,27 @@ class Trainer:
                 self.epoch, self.global_step,
                 "none configured" if self.checkpoint_cfg is None else "state already saved",
             )
+
+    def _batches(self, reader):
+        """One epoch's batch stream, optionally device-prefetched: transfers
+        run on a producer thread ``prefetch_depth`` batches ahead, already
+        placed with the step's input shardings, so the step never waits on
+        host->device copies."""
+        it = iter(reader())
+        if not self.prefetch:
+            yield from it
+            return
+        from paddle_tpu.reader import DevicePrefetcher
+
+        first = next(it, None)
+        if first is None:
+            return
+        if self.parallel:
+            placement = tuple(self._dp._batch_shardings(first))
+        else:
+            placement = self.exe._device
+        yield first
+        yield from DevicePrefetcher(it, device=placement)
 
     def _run_step(self, batch) -> StepOutput:
         if self.parallel:

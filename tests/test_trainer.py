@@ -243,3 +243,24 @@ def test_in_step_nan_guard_raises():
         assert out.finite is not None and bool(out.finite)
     finally:
         set_flags(check_nan_inf=False)
+
+
+@pytest.mark.parametrize("parallel", [False, True])
+def test_trainer_prefetch_matches_plain(parallel):
+    """prefetch=True (device double-buffering) must not change the training
+    trajectory, single-device and data-parallel."""
+    def run(prefetch):
+        trainer = Trainer(
+            _linreg_model, lambda: pt.optimizer.SGD(learning_rate=0.1),
+            parallel=parallel, prefetch=prefetch,
+        )
+        losses = []
+
+        def handler(ev):
+            if isinstance(ev, EndStepEvent):
+                losses.append(ev.metrics)
+
+        trainer.train(num_epochs=2, event_handler=handler, reader=_reader())
+        return losses
+
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-6)
