@@ -95,6 +95,31 @@ def _make_batch(args, spec, rng):
         return image_batch(
             dataset.flowers.train(), lambda im: im.transpose(0, 2, 3, 1)
         )
+    if args.model == "machine_translation":
+        # the reference NMT benchmark feeds from wmt14
+        # (benchmark/fluid/models/machine_translation.py:212); pad the ragged
+        # (src, trg_in, trg_next) triples to the model's static layout
+        seq_len = 50
+        rows = []
+        # dict sized to the model's vocab: larger ids would index past the
+        # embedding table
+        for i, ex in enumerate(dataset.wmt14.train(10000)()):
+            if i >= args.batch_size:
+                break
+            rows.append(ex)
+        n = len(rows)
+        src = np.zeros((n, seq_len), np.int32)
+        trg = np.zeros((n, seq_len), np.int32)
+        lab = np.zeros((n, seq_len), np.int32)
+        src_lens = np.zeros((n,), np.int32)
+        trg_lens = np.zeros((n,), np.int32)
+        for i, (s, t, tn) in enumerate(rows):
+            s, t, tn = s[:seq_len], t[:seq_len], tn[:seq_len]
+            src[i, : len(s)] = s
+            trg[i, : len(t)] = t
+            lab[i, : len(tn)] = tn
+            src_lens[i], trg_lens[i] = len(s), len(t)
+        return src, src_lens, trg, lab, trg_lens
     print(
         f"WARNING: no real-data mapping for model={args.model} "
         f"data_set={args.data_set}; using synthetic batches"
