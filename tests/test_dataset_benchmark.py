@@ -203,3 +203,18 @@ def test_multiprocess_reader_early_close_fast():
     it.close()
     assert len(got) == 5
     assert _t.time() - t0 < 10, "early close stalled"
+
+
+def test_wmt14_contract():
+    """wmt14 (the NMT benchmark's feed): (src, trg_in, trg_next) with the
+    reference's id conventions — src wrapped in <s>/<e> (wmt14.py:98-99),
+    trg_in starts <s>, trg_next ends <e>."""
+    src, trg_in, trg_next = next(iter(dataset.wmt14.train(200)()))
+    assert src[0] == dataset.wmt14.START_IDX and src[-1] == dataset.wmt14.END_IDX
+    assert trg_in[0] == dataset.wmt14.START_IDX
+    assert trg_next[-1] == dataset.wmt14.END_IDX
+    assert trg_next[:-1] == trg_in[1:]
+    sd, td = dataset.wmt14.get_dict(50)
+    assert sd[0] == "<s>" and td[1] == "<e>"
+    # gen split exists (wmt14.py:149)
+    assert len(list(dataset.wmt14.gen(100)())) > 0
