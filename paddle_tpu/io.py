@@ -64,6 +64,36 @@ def load_params(dirname: str, filename_prefix: str = "") -> Variables:
                      state={k: jax.numpy.asarray(v) for k, v in state.items()})
 
 
+# full reference io surface (reference io.py:28 __all__): persistables =
+# params + mutable state here, and save_vars/load_vars take an explicit
+# name predicate instead of the reference's Variable-object filters
+save_persistables = save_params
+load_persistables = load_params
+
+
+def save_vars(dirname: str, variables: Variables, predicate=None,
+              filename_prefix: str = "") -> None:
+    """Save the subset of variables whose NAME satisfies ``predicate``
+    (reference ``io.save_vars``; default: everything)."""
+    pred = predicate or (lambda name: True)
+    sub = Variables(
+        params={k: v for k, v in variables.params.items() if pred(k)},
+        state={k: v for k, v in variables.state.items() if pred(k)},
+    )
+    save_params(dirname, sub, filename_prefix)
+
+
+def load_vars(dirname: str, predicate=None, filename_prefix: str = "") -> Variables:
+    """Load, keeping only names satisfying ``predicate``
+    (reference ``io.load_vars``)."""
+    pred = predicate or (lambda name: True)
+    full = load_params(dirname, filename_prefix)
+    return Variables(
+        params={k: v for k, v in full.params.items() if pred(k)},
+        state={k: v for k, v in full.state.items() if pred(k)},
+    )
+
+
 def save_inference_model(
     dirname: str,
     model: Model,
