@@ -85,12 +85,16 @@ def save_vars(dirname: str, variables: Variables, predicate=None,
 
 def load_vars(dirname: str, predicate=None, filename_prefix: str = "") -> Variables:
     """Load, keeping only names satisfying ``predicate``
-    (reference ``io.load_vars``)."""
+    (reference ``io.load_vars``). Filters the host-side arrays BEFORE any
+    device transfer, so selecting one layer out of a multi-GB checkpoint
+    moves only that layer to the device."""
     pred = predicate or (lambda name: True)
-    full = load_params(dirname, filename_prefix)
+    params = _load_dict(os.path.join(dirname, filename_prefix + _PARAMS_FILE))
+    state_path = os.path.join(dirname, filename_prefix + _STATE_FILE)
+    state = _load_dict(state_path) if os.path.exists(state_path) else {}
     return Variables(
-        params={k: v for k, v in full.params.items() if pred(k)},
-        state={k: v for k, v in full.state.items() if pred(k)},
+        params={k: jax.numpy.asarray(v) for k, v in params.items() if pred(k)},
+        state={k: jax.numpy.asarray(v) for k, v in state.items() if pred(k)},
     )
 
 
