@@ -1,0 +1,90 @@
+"""Flash-kernel autotune + feature A/B on a live TPU: block_q/block_k sweep
+vs composed XLA at T in {1024, 4096, 8192}, then GQA and sliding-window
+speedups. Run opportunistically when the axon tunnel is up:
+
+    python tests/tpu_flash_tune.py
+"""
+import sys
+sys.path.insert(0, "/root/repo")
+import time
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+except Exception:
+    pass
+
+from paddle_tpu.ops.pallas import flash_attention
+from paddle_tpu.ops.pallas.flash_attention import _reference_attention
+
+assert jax.default_backend() == "tpu", jax.default_backend()
+
+
+def sync(tree):
+    leaf = jax.tree_util.tree_leaves(tree)[0]
+    return float(jax.device_get(leaf.ravel()[0]))
+
+
+def time_fn(g, args, iters=10):
+    out = g(*args)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = g(*args)
+    sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+for T in (1024, 4096, 8192):
+    B, H, d = (4, 16, 64) if T <= 2048 else (1, 16, 64)
+    rng = np.random.RandomState(0)
+    mk = lambda: jax.device_put(jnp.asarray(rng.randn(B, H, T, d).astype(np.float32)).astype(jnp.bfloat16))
+    q, k, v = mk(), mk(), mk()
+
+    g_ref = jax.jit(jax.grad(lambda a, b, c: _reference_attention(a, b, c, True, d ** -0.5).astype(jnp.float32).sum(), (0, 1, 2)))
+    t_ref = time_fn(g_ref, (q, k, v))
+    print(f"T={T}: xla composed fwd+bwd {t_ref*1e3:.3f} ms")
+
+    for bq in (128, 256, 512):
+        for bk in (128, 256, 512):
+            if bq > T or bk > T:
+                continue
+            try:
+                fn = lambda a, b, c, bq=bq, bk=bk: flash_attention(
+                    a, b, c, causal=True, block_q=bq, block_k=bk, interpret=False
+                ).astype(jnp.float32).sum()
+                g = jax.jit(jax.grad(fn, (0, 1, 2)))
+                t = time_fn(g, (q, k, v))
+                print(f"T={T} bq={bq} bk={bk}: {t*1e3:.3f} ms  speedup_vs_xla={t_ref/t:.2f}x")
+            except Exception as e:
+                print(f"T={T} bq={bq} bk={bk}: FAILED {type(e).__name__}: {str(e)[:120]}")
+
+# ---- r3 feature speedups: GQA and sliding window at T=8192 ----
+T, B, H, d = 8192, 1, 16, 64
+rng = np.random.RandomState(0)
+mk = lambda h: jax.device_put(jnp.asarray(rng.randn(B, h, T, d).astype(np.float32)).astype(jnp.bfloat16))
+q = mk(H)
+
+g_full = jax.jit(jax.grad(lambda a, b, c: flash_attention(a, b, c, causal=True).astype(jnp.float32).sum(), (0, 1, 2)))
+k, v = mk(H), mk(H)
+t_full = time_fn(g_full, (q, k, v))
+print(f"T={T} full-head flash fwd+bwd: {t_full*1e3:.3f} ms")
+
+for hkv in (4, 1):
+    kg, vg = mk(hkv), mk(hkv)
+    g_gqa = jax.jit(jax.grad(lambda a, b, c: flash_attention(a, b, c, causal=True).astype(jnp.float32).sum(), (0, 1, 2)))
+    try:
+        t = time_fn(g_gqa, (q, kg, vg))
+        print(f"T={T} GQA h_kv={hkv}: {t*1e3:.3f} ms  speedup_vs_full={t_full/t:.2f}x")
+    except Exception as e:
+        print(f"T={T} GQA h_kv={hkv}: FAILED {type(e).__name__}: {str(e)[:120]}")
+
+for w in (1024, 2048):
+    g_win = jax.jit(jax.grad(lambda a, b, c: flash_attention(a, b, c, causal=True, window=w).astype(jnp.float32).sum(), (0, 1, 2)))
+    try:
+        t = time_fn(g_win, (q, k, v))
+        print(f"T={T} window={w}: {t*1e3:.3f} ms  speedup_vs_full={t_full/t:.2f}x")
+    except Exception as e:
+        print(f"T={T} window={w}: FAILED {type(e).__name__}: {str(e)[:120]}")
