@@ -101,7 +101,30 @@ if ckpt_dir:
     import glob as _g
     assert len(_g.glob(os.path.join(path, "shards_p*.npz"))) == 2
 
-print("RESULT " + json.dumps({"pid": pid, "losses": losses}))
+# 4) ZeRO-1 across processes: optimizer slots declared data-sharded span
+# BOTH processes' devices; the step must still run and agree
+from paddle_tpu.parallel import DataParallel
+
+def net2(x, y):
+    h = layers.fc(x, 8, name="h", act="relu")
+    p2 = layers.fc(h, 1, name="w2")
+    return pt.layers.square_error_cost(p2[:, 0], y).mean()
+
+model2 = pt.build(net2)
+dpz = DataParallel(model2, pt.optimizer.Adam(learning_rate=1e-2), mesh=mesh,
+                   zero_shard_optimizer=True, donate=False)
+vz, oz = dpz.init(0, gx[:1], gy[:1])
+slot = oz.slots["moment1"]["h/w"]
+assert "data" in str(slot.sharding.spec), slot.sharding
+zx = jax.make_array_from_process_local_data(xsh, lx, (8, 3))
+zy = jax.make_array_from_process_local_data(ysh, ly, (8,))
+zero_losses = []
+for i in range(2):
+    o = dpz.step(vz, oz, zx, zy)
+    vz, oz = o.variables, o.opt_state
+    zero_losses.append(float(jax.device_get(o.loss)))
+
+print("RESULT " + json.dumps({"pid": pid, "losses": losses, "zero_losses": zero_losses}))
 """
 
 
@@ -140,18 +163,24 @@ def test_two_process_dcn_mesh(tmp_path):
             )
         )
     results = {}
+    zero_results = {}
     for p in procs:
-        out, err = p.communicate(timeout=240)
+        out, err = p.communicate(timeout=300)
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
         for line in out.splitlines():
             if line.startswith("RESULT "):
                 r = json.loads(line[len("RESULT "):])
                 results[r["pid"]] = r["losses"]
+                zero_results[r["pid"]] = r.get("zero_losses")
     assert set(results) == {0, 1}
     # both processes computed the same global losses
     np.testing.assert_allclose(results[0], results[1], rtol=0, atol=0)
     # and training moved the loss
     assert results[0][-1] < results[0][0]
+    # ZeRO-1 slots sharded across the TWO PROCESSES ran and agreed
+    assert zero_results[0] is not None
+    np.testing.assert_allclose(zero_results[0], zero_results[1], rtol=0, atol=0)
+    assert zero_results[0][-1] < zero_results[0][0]
 
 
 def test_single_process_baseline_matches(tmp_path):
