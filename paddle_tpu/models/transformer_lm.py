@@ -350,3 +350,138 @@ def get_model(
         examples_per_row=seq_len,
         extra={"cfg": cfg, "seq_len": seq_len},
     )
+
+
+def generate_beam(
+    variables,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    cfg: dict,
+    beam_size: int = 4,
+    eos_id: int = 1,
+    length_penalty_alpha: float = 0.0,
+):
+    """Beam-search continuation of ``prompt``: returns
+    ``(sequences [B, beam, max_new_tokens], scores [B, beam])`` best-first.
+
+    Built on the generic :func:`paddle_tpu.ops.control_flow.beam_search`
+    (the reference's beam_search/beam_search_decode op pair) over the same
+    static k/v cache layout as :func:`generate`: the prompt minus its last
+    token is prefilled into the cache, each row's last prompt token seeds
+    its beams, and every scan step attends against cache[0..t]. Same decode
+    math as ``generate`` (same param names/ops); GQA cache layout included.
+    """
+    from paddle_tpu.core.enforce import enforce
+    from paddle_tpu.models.transformer import sinusoid_position_encoding
+    from paddle_tpu.ops import control_flow as ocf
+
+    params = variables.params if hasattr(variables, "params") else variables
+    B, Tp = prompt.shape
+    enforce(Tp >= 1, "generate_beam needs a non-empty prompt")
+    T_max = Tp + max_new_tokens
+    D, H, L = cfg["d_model"], cfg["num_heads"], cfg["n_layers"]
+    dh = D // H
+    H_kv = cfg.get("num_kv_heads") or H
+    G = H // H_kv
+    enforce(
+        cfg.get("pos_encoding", "sinusoid") == "sinusoid",
+        "generate_beam: RoPE decode is not supported yet (see generate())",
+    )
+    pe = sinusoid_position_encoding(max(cfg["max_len"], T_max), D)
+    scale = 1.0 / np.sqrt(dh)
+
+    def p(name):
+        return params[name]
+
+    def ln(x, pfx):
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.mean((x - mu) ** 2, -1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-5) * p(f"{pfx}/scale") + p(f"{pfx}/bias")
+
+    def proj(x, pfx, bias=True):
+        out = x @ p(f"{pfx}/w")
+        return out + p(f"{pfx}/b") if bias else out
+
+    def heads(x, n):
+        return x.reshape(x.shape[0], x.shape[1], n, dh).transpose(0, 2, 1, 3)
+
+    def embed(ids, pos0):
+        e = jnp.take(p("emb/embedding/word_emb"), ids, axis=0) * (D ** 0.5)
+        return e + jax.lax.dynamic_slice_in_dim(pe, pos0, ids.shape[1], axis=0)
+
+    def attn_vs_cache(q, kc_l, vc_l, t):
+        # q [N, H, 1, dh]; kc_l/vc_l [N, H_kv, T_max, dh]; attend over [0, t]
+        n = q.shape[0]
+        qg = q.reshape(n, H_kv, G, 1, dh)
+        s = jnp.einsum("bkgqd,bktd->bkgqt", qg, kc_l) * scale
+        live = jnp.arange(T_max) <= t
+        s = jnp.where(live[None, None, None, None, :], s, -1e9)
+        o = jnp.einsum("bkgqt,bktd->bkgqd", jax.nn.softmax(s, -1), vc_l)
+        return o.reshape(n, H, 1, dh)
+
+    def block(x, i, attend):
+        pfx = f"layer_{i}/self_attn"
+        q = heads(proj(x, f"{pfx}/q"), H)
+        k = heads(proj(x, f"{pfx}/k"), H_kv)
+        v = heads(proj(x, f"{pfx}/v"), H_kv)
+        ctx = attend(q, k, v, i)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], D)
+        x = ln(x + proj(ctx, f"{pfx}/out"), f"layer_{i}/layer_norm")
+        h = jax.nn.relu(proj(x, f"layer_{i}/ffn/fc1"))
+        return ln(x + proj(h, f"layer_{i}/ffn/fc2"), f"layer_{i}/layer_norm_1")
+
+    def logits_of(x_last):
+        return ln(x_last, "layer_norm") @ p("project/logits/w")
+
+    # --- prefill positions [0, Tp-1): full causal pass over the prompt head
+    kc0 = jnp.zeros((B, L, H_kv, T_max, dh), jnp.float32)
+    vc0 = jnp.zeros((B, L, H_kv, T_max, dh), jnp.float32)
+    caches = {"k": kc0, "v": vc0}
+    Thead = Tp - 1
+    if Thead > 0:
+        def prefill_attend(q, k, v, i):
+            caches["k"] = caches["k"].at[:, i, :, :Thead].set(k)
+            caches["v"] = caches["v"].at[:, i, :, :Thead].set(v)
+            qg = q.reshape(B, H_kv, G, Thead, dh)
+            s = jnp.einsum("bkgqd,bktd->bkgqt", qg, k) * scale
+            mask = jnp.tril(jnp.ones((Thead, Thead), bool))
+            s = jnp.where(mask[None, None, None], s, -1e9)
+            o = jnp.einsum("bkgqt,bktd->bkgqd", jax.nn.softmax(s, -1), v)
+            return o.reshape(B, H, Thead, dh)
+
+        x = embed(prompt[:, :Thead], 0)
+        for i in range(L):
+            x = block(x, i, prefill_attend)
+
+    # --- beam decode: carry leaves are [B, ...] (beam_search tiles dim 0)
+    init_carry = {"k": caches["k"], "v": caches["v"],
+                  "t": jnp.full((B,), Thead, jnp.int32)}
+
+    def step_fn(carry, tokens):
+        t = carry["t"][0]
+        xt = embed(tokens[:, None], t)
+        kc, vc = carry["k"], carry["v"]
+
+        def attend(q, k, v, i):
+            nonlocal kc, vc
+            kc = jax.lax.dynamic_update_slice(kc, k[:, None], (0, i, 0, t, 0))
+            vc = jax.lax.dynamic_update_slice(vc, v[:, None], (0, i, 0, t, 0))
+            return attn_vs_cache(q, kc[:, i], vc[:, i], t)
+
+        y = xt
+        for i in range(L):
+            y = block(y, i, attend)
+        logp = jax.nn.log_softmax(logits_of(y[:, -1]).astype(jnp.float32), -1)
+        return {"k": kc, "v": vc, "t": carry["t"] + 1}, logp
+
+    return ocf.beam_search(
+        step_fn,
+        init_carry,
+        batch_size=B,
+        beam_size=beam_size,
+        vocab_size=cfg["vocab"],
+        max_len=max_new_tokens,
+        bos_id=prompt[:, -1],
+        eos_id=eos_id,
+        length_penalty_alpha=length_penalty_alpha,
+    )

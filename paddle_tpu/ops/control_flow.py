@@ -20,6 +20,7 @@ from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.core.enforce import enforce
 
@@ -283,7 +284,12 @@ def beam_search(
         return jnp.repeat(leaf, beam_size, axis=0)
 
     carry = jax.tree_util.tree_map(tile, init_carry)
-    tokens = jnp.full((batch_size, beam_size), bos_id, jnp.int32)
+    # bos_id: a vocabulary id, or a [B] array of per-row start tokens (e.g.
+    # an LM continuing each row's prompt from its own last token)
+    if isinstance(bos_id, (int, np.integer)):
+        tokens = jnp.full((batch_size, beam_size), bos_id, jnp.int32)
+    else:
+        tokens = jnp.repeat(jnp.asarray(bos_id, jnp.int32)[:, None], beam_size, axis=1)
     # only beam 0 is live initially so the K identical copies don't crowd
     # the frontier (standard trick; reference seeds one prefix per source)
     scores = jnp.tile(

@@ -365,3 +365,35 @@ def test_lm_attention_window_trains_and_limits_context():
     os_ = opt.create_state(v.params)
     out = jax.jit(opt.minimize(spec.model))(v, os_, *[jnp.asarray(b) for b in batch], rng=jax.random.PRNGKey(0))
     assert np.isfinite(float(out.loss))
+
+
+def test_transformer_lm_generate_beam_matches_greedy_at_k1():
+    """beam_size=1 beam decode == greedy generate (the decode-math pin for
+    generate_beam), GQA config included; wider beams score >= the greedy
+    path's sequence under the same model."""
+    from paddle_tpu.models import transformer_lm
+
+    rng = np.random.RandomState(0)
+    for kw in (
+        dict(seq_len=8, vocab=64, d_model=32, d_inner=64, num_heads=2, n_layers=2),
+        dict(seq_len=8, vocab=64, d_model=32, d_inner=64, num_heads=4,
+             num_kv_heads=2, n_layers=1),
+    ):
+        spec = models.get_model("transformer_lm", **kw)
+        batch = spec.synth_batch(2, rng)
+        variables = spec.model.init(0, *batch)
+        cfg = spec.extra["cfg"]
+        prompt = jnp.asarray(rng.randint(2, 64, size=(2, 6)).astype(np.int32))
+
+        greedy = transformer_lm.generate(variables, prompt, 5, cfg)
+        seqs, scores = transformer_lm.generate_beam(
+            variables, prompt, 5, cfg, beam_size=1, eos_id=1
+        )
+        np.testing.assert_array_equal(np.asarray(seqs[:, 0]), np.asarray(greedy))
+
+        seqs4, scores4 = transformer_lm.generate_beam(
+            variables, prompt, 5, cfg, beam_size=4, eos_id=1
+        )
+        # beams come back best-first and the best is at least the greedy score
+        assert np.all(np.diff(np.asarray(scores4), axis=1) <= 1e-6)
+        assert np.all(np.asarray(scores4[:, 0]) >= np.asarray(scores[:, 0]) - 1e-5)
