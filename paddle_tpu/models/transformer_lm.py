@@ -186,16 +186,16 @@ def generate(
     G = H // H_kv
     enforce(max_new_tokens >= 1, f"max_new_tokens must be >= 1, got {max_new_tokens}")
     enforce(
-        cfg.get("pos_encoding", "sinusoid") == "sinusoid",
-        "generate(): the static-cache decoder assumes additive sinusoid PE; "
-        "RoPE decode needs per-step q/k rotation — decode with model.apply",
-    )
-    enforce(
         temperature == 0.0 or rng is not None,
         "generate: sampling (temperature > 0) needs an explicit rng key — "
         "a silent fixed default would return identical 'samples' every call",
     )
+    rope = cfg.get("pos_encoding", "sinusoid") == "rope"
     pe = sinusoid_position_encoding(max(cfg["max_len"], T_max), D)
+    if rope:
+        from paddle_tpu.ops.attention import apply_rope, rope_tables
+
+        rope_cos, rope_sin = rope_tables(dh, max(cfg["max_len"], T_max))
     scale = 1.0 / np.sqrt(dh)
 
     def p(name):
@@ -222,14 +222,28 @@ def generate(
 
     def embed(ids, pos0):
         e = jnp.take(p("emb/embedding/word_emb"), ids, axis=0) * (D ** 0.5)
+        if rope:  # position enters at the attention rotation instead
+            return e
         t = ids.shape[1]
         return e + jax.lax.dynamic_slice_in_dim(pe, pos0, t, axis=0)
 
-    def block(x, i, attend):
+    def rotate(x, pos0):
+        """RoPE at absolute positions [pos0, pos0+T): cached K is stored
+        PRE-rotated (rotation depends only on the key's own position, and
+        scores depend only on relative offsets)."""
+        t = x.shape[2]
+        cos = jax.lax.dynamic_slice_in_dim(rope_cos, pos0, t, axis=0)
+        sin = jax.lax.dynamic_slice_in_dim(rope_sin, pos0, t, axis=0)
+        return apply_rope(x, cos, sin)
+
+    def block(x, i, attend, pos0=0):
         pfx = f"layer_{i}/self_attn"
         q = heads(proj(x, f"{pfx}/q"))
         k = heads(proj(x, f"{pfx}/k"), H_kv)
         v = heads(proj(x, f"{pfx}/v"), H_kv)
+        if rope:
+            q = rotate(q, pos0)
+            k = rotate(k, pos0)
         ctx = attend(q, k, v, i)  # [B, H, Tq, dh]
         ctx = ctx.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], D)
         x = ln(x + proj(ctx, f"{pfx}/out"), f"layer_{i}/layer_norm")
@@ -259,7 +273,7 @@ def generate(
 
     x = embed(prompt, 0)
     for i in range(L):
-        x = block(x, i, prefill_attend)
+        x = block(x, i, prefill_attend, pos0=0)
     first_key, scan_rng = (
         jax.random.split(rng) if rng is not None else (None, None)
     )
@@ -282,7 +296,7 @@ def generate(
 
         y = xt
         for i in range(L):
-            y = block(y, i, attend)
+            y = block(y, i, attend, pos0=t)
         if key is not None:
             key, sub = jax.random.split(key)
         else:
@@ -383,11 +397,12 @@ def generate_beam(
     dh = D // H
     H_kv = cfg.get("num_kv_heads") or H
     G = H // H_kv
-    enforce(
-        cfg.get("pos_encoding", "sinusoid") == "sinusoid",
-        "generate_beam: RoPE decode is not supported yet (see generate())",
-    )
+    rope = cfg.get("pos_encoding", "sinusoid") == "rope"
     pe = sinusoid_position_encoding(max(cfg["max_len"], T_max), D)
+    if rope:
+        from paddle_tpu.ops.attention import apply_rope, rope_tables
+
+        rope_cos, rope_sin = rope_tables(dh, max(cfg["max_len"], T_max))
     scale = 1.0 / np.sqrt(dh)
 
     def p(name):
@@ -407,7 +422,15 @@ def generate_beam(
 
     def embed(ids, pos0):
         e = jnp.take(p("emb/embedding/word_emb"), ids, axis=0) * (D ** 0.5)
+        if rope:
+            return e
         return e + jax.lax.dynamic_slice_in_dim(pe, pos0, ids.shape[1], axis=0)
+
+    def rotate(x, pos0):  # pre-rotated K cache (see generate())
+        t = x.shape[2]
+        cos = jax.lax.dynamic_slice_in_dim(rope_cos, pos0, t, axis=0)
+        sin = jax.lax.dynamic_slice_in_dim(rope_sin, pos0, t, axis=0)
+        return apply_rope(x, cos, sin)
 
     def attn_vs_cache(q, kc_l, vc_l, t):
         # q [N, H, 1, dh]; kc_l/vc_l [N, H_kv, T_max, dh]; attend over [0, t]
@@ -419,11 +442,14 @@ def generate_beam(
         o = jnp.einsum("bkgqt,bktd->bkgqd", jax.nn.softmax(s, -1), vc_l)
         return o.reshape(n, H, 1, dh)
 
-    def block(x, i, attend):
+    def block(x, i, attend, pos0=0):
         pfx = f"layer_{i}/self_attn"
         q = heads(proj(x, f"{pfx}/q"), H)
         k = heads(proj(x, f"{pfx}/k"), H_kv)
         v = heads(proj(x, f"{pfx}/v"), H_kv)
+        if rope:
+            q = rotate(q, pos0)
+            k = rotate(k, pos0)
         ctx = attend(q, k, v, i)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], D)
         x = ln(x + proj(ctx, f"{pfx}/out"), f"layer_{i}/layer_norm")
@@ -451,7 +477,7 @@ def generate_beam(
 
         x = embed(prompt[:, :Thead], 0)
         for i in range(L):
-            x = block(x, i, prefill_attend)
+            x = block(x, i, prefill_attend, pos0=0)
 
     # --- beam decode: carry leaves are [B, ...] (beam_search tiles dim 0)
     init_carry = {"k": caches["k"], "v": caches["v"],
@@ -470,7 +496,7 @@ def generate_beam(
 
         y = xt
         for i in range(L):
-            y = block(y, i, attend)
+            y = block(y, i, attend, pos0=t)
         logp = jax.nn.log_softmax(logits_of(y[:, -1]).astype(jnp.float32), -1)
         return {"k": kc, "v": vc, "t": carry["t"] + 1}, logp
 

@@ -397,3 +397,32 @@ def test_transformer_lm_generate_beam_matches_greedy_at_k1():
         # beams come back best-first and the best is at least the greedy score
         assert np.all(np.diff(np.asarray(scores4), axis=1) <= 1e-6)
         assert np.all(np.asarray(scores4[:, 0]) >= np.asarray(scores[:, 0]) - 1e-5)
+
+
+def test_transformer_lm_generate_rope_matches_naive_decode():
+    """RoPE cached decode: K is cached pre-rotated at its own position, so
+    the scan decode must exactly match naive grow-the-prompt greedy decode
+    through the rope training forward."""
+    from paddle_tpu.models import transformer_lm
+
+    rng = np.random.RandomState(0)
+    spec = models.get_model(
+        "transformer_lm", seq_len=8, vocab=64, d_model=32, d_inner=64,
+        num_heads=2, n_layers=2, pos_encoding="rope",
+    )
+    batch = spec.synth_batch(2, rng)
+    variables = spec.model.init(0, *batch)
+    cfg = spec.extra["cfg"]
+    prompt = jnp.asarray(rng.randint(2, 64, size=(2, 8)).astype(np.int32))
+
+    out = transformer_lm.generate(variables, prompt, max_new_tokens=5, cfg=cfg)
+    seq = prompt
+    naive = []
+    for _ in range(5):
+        (_, _, logits), _ = spec.model.apply(
+            variables, seq, jnp.zeros_like(seq), is_train=False
+        )
+        nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+        naive.append(nxt)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(jnp.stack(naive, 1)))
