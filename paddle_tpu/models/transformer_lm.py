@@ -35,7 +35,7 @@ from paddle_tpu.models.transformer import (
     prepare_embedding,
 )
 
-__all__ = ["get_model", "lm_forward", "generate", "BASE_CFG"]
+__all__ = ["get_model", "lm_forward", "generate", "generate_beam", "BASE_CFG"]
 
 
 def _ring_core(ring_mesh):
