@@ -134,6 +134,11 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
     if tiny:
         result["notes"].append("cpu_fallback_tiny_config")
 
+    def checkpoint_result():
+        """Interim JSON after each section: if the wall-clock budget kills
+        this child mid-run, the parent still salvages the newest line."""
+        print(json.dumps(result), flush=True)
+
     # --- ResNet-50 (sweep bs; report the best stable throughput) ---
     sweep = (16,) if tiny else tuple(
         int(b) for b in os.environ.get("PT_BENCH_RESNET_BS", "64,128,256").split(",")
@@ -155,6 +160,12 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
             result[f"resnet_imgs_per_sec_bs{bs}"] = round(ips, 2)
             if best is None or ips > best[0]:
                 best = (ips, bs, dt, flops)
+                result["value"] = round(ips, 2)
+                result["resnet_batch_size"] = bs
+                result["vs_baseline"] = round(ips / BASELINE_IMG_PER_SEC, 3)
+                if peak and flops:
+                    result["resnet_mfu"] = round(flops / dt / peak, 4)
+            checkpoint_result()
         if best is None:
             raise RuntimeError("resnet sweep produced no result")
         ips, bs, dt, flops = best
@@ -166,6 +177,7 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
         print(f"resnet50: {result['value']} img/s (bs={bs})", file=sys.stderr)
     except Exception as e:  # keep going — transformer number still valuable
         result["notes"].append(f"resnet_failed: {type(e).__name__}: {e}"[:300])
+    checkpoint_result()
 
     # --- Flash attention A/B (fused Pallas fwd+bwd vs composed XLA) ---
     def bench_flash(T: int, iters: int = 8):
@@ -210,6 +222,7 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
                 print(f"flash T={T}: {t_flash*1e3:.2f}ms vs xla {t_xla*1e3:.2f}ms", file=sys.stderr)
             except Exception as e:
                 result["notes"].append(f"flash_t{T}_failed: {type(e).__name__}: {e}"[:300])
+        checkpoint_result()
 
     # --- Transformer ---
     if time.monotonic() < deadline:
@@ -224,6 +237,7 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
             print(f"transformer: {result['transformer_tokens_per_sec']} tok/s", file=sys.stderr)
         except Exception as e:
             result["notes"].append(f"transformer_failed: {type(e).__name__}: {e}"[:300])
+        checkpoint_result()
     else:
         result["notes"].append("transformer_skipped_budget")
 
@@ -239,6 +253,7 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
             print(f"transformer_lm: {result['lm_tokens_per_sec']} tok/s", file=sys.stderr)
         except Exception as e:
             result["notes"].append(f"lm_failed: {type(e).__name__}: {e}"[:300])
+        checkpoint_result()
     else:
         result["notes"].append("lm_skipped_budget")
 
@@ -273,6 +288,7 @@ def _run_child(extra_env: dict, timeout: float):
     """Run a measurement child; returns parsed JSON dict or None."""
     env = {**os.environ, **extra_env}
     env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(_REPO, ".jax_cache"))
+    stdout, stderr = "", ""
     try:
         args = [sys.executable, os.path.abspath(__file__), "--child"]
         if extra_env.get("PT_BENCH_FORCE_CPU"):
@@ -285,18 +301,24 @@ def _run_child(extra_env: dict, timeout: float):
             capture_output=True,
             text=True,
         )
-    except subprocess.TimeoutExpired:
-        print(f"bench child timed out after {timeout:.0f}s", file=sys.stderr)
-        return None
-    sys.stderr.write(proc.stderr[-2000:])
-    for line in reversed(proc.stdout.strip().splitlines()):
+        stdout, stderr = proc.stdout, proc.stderr
+        rc = proc.returncode
+    except subprocess.TimeoutExpired as te:
+        # the child prints interim JSON after every section — salvage the
+        # newest line instead of discarding the whole (possibly TPU!) run
+        print(f"bench child timed out after {timeout:.0f}s (salvaging)", file=sys.stderr)
+        stdout = te.stdout.decode() if isinstance(te.stdout, bytes) else (te.stdout or "")
+        stderr = te.stderr.decode() if isinstance(te.stderr, bytes) else (te.stderr or "")
+        rc = -1
+    sys.stderr.write(stderr[-2000:])
+    for line in reversed(stdout.strip().splitlines()):
         try:
             parsed = json.loads(line)
             if isinstance(parsed, dict) and "metric" in parsed:
                 return parsed
         except (json.JSONDecodeError, ValueError):
             continue
-    print(f"bench child rc={proc.returncode}, no JSON found", file=sys.stderr)
+    print(f"bench child rc={rc}, no JSON found", file=sys.stderr)
     return None
 
 
