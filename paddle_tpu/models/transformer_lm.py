@@ -75,6 +75,19 @@ def _rope_core(cfg):
     return core
 
 
+def _with_rope(core):
+    """Wrap a sequence-parallel attention core with RoPE: the rotation is
+    per-position (applied on the GLOBAL [B, H, T, d] arrays before the core
+    shards them), so rope composes exactly with ring/ulysses."""
+    from paddle_tpu.ops.attention import apply_rope, rope_tables
+
+    def rotated(qh, kh, vh):
+        cos, sin = rope_tables(qh.shape[-1], qh.shape[-2])
+        return core(apply_rope(qh, cos, sin), apply_rope(kh, cos, sin), vh)
+
+    return rotated
+
+
 def lm_block(x, cfg, name):
     ring_mesh = cfg.get("ring_mesh")
     ulysses_mesh = cfg.get("ulysses_mesh")
@@ -87,10 +100,10 @@ def lm_block(x, cfg, name):
         core = _ring_core(ring_mesh)
     elif ulysses_mesh is not None:
         core = _ulysses_core(ulysses_mesh)
-    elif cfg.get("pos_encoding") == "rope":
-        core = _rope_core(cfg)
     else:
         core = None
+    if cfg.get("pos_encoding") == "rope":
+        core = _with_rope(core) if core is not None else _rope_core(cfg)
     with name_scope(name):
         attn = multi_head_attention(
             x, x, x, cfg["d_model"], cfg["num_heads"],

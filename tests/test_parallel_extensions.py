@@ -563,3 +563,20 @@ def test_transformer_lm_ring_gqa_trains(rng):
     (l_plain, _, _), _ = plain.model.apply(variables, *batch, is_train=False)
     (l_ring, _, _), _ = ringm.model.apply(variables, *batch, is_train=False)
     np.testing.assert_allclose(float(l_plain), float(l_ring), rtol=1e-4)
+
+
+def test_transformer_lm_rope_ring_matches_plain(rng):
+    """RoPE composes with ring attention (rotation applied on the global
+    arrays before sharding): loss equals the plain rope LM."""
+    from paddle_tpu import models
+
+    mesh = make_mesh(seq=4, data=2)
+    kw = dict(seq_len=32, vocab=64, d_model=32, d_inner=64, num_heads=2,
+              n_layers=1, pos_encoding="rope")
+    plain = models.get_model("transformer_lm", **kw)
+    ringm = models.get_model("transformer_lm", ring_mesh=mesh, **kw)
+    batch = plain.synth_batch(8, rng)
+    v = plain.model.init(0, *batch)
+    (l1, *_), _ = plain.model.apply(v, *batch, is_train=False)
+    (l2, *_), _ = ringm.model.apply(v, *batch, is_train=False)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-4)
