@@ -595,3 +595,35 @@ def test_detection_map_metric():
     m.reset()
     with pytest.raises(ValueError):
         m.eval()
+
+
+def test_reference_layers_all_fully_covered():
+    """The VERDICT done-criterion: every name in the reference's
+    fluid.layers ``__all__`` lists exists in paddle_tpu.layers — except the
+    reference's internal doc/codegen helpers, which are not layers."""
+    import ast
+    import pathlib
+
+    from paddle_tpu import layers as L
+
+    NOT_LAYERS = {"autodoc", "deprecated", "generate_layer_fn", "templatedoc"}
+    names = set()
+    base = pathlib.Path("/root/reference/python/paddle/fluid/layers")
+    if not base.exists():
+        pytest.skip("reference tree not mounted")
+    for f in base.glob("*.py"):
+        try:
+            tree = ast.parse(f.read_text())
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if getattr(t, "id", "") == "__all__":
+                        try:
+                            names.update(ast.literal_eval(node.value))
+                        except Exception:
+                            pass
+    mine = set(dir(L))
+    missing = sorted(n for n in names - NOT_LAYERS if n not in mine)
+    assert not missing, f"reference layers missing: {missing}"
