@@ -126,6 +126,36 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         out["errors"].append(f"flash_gqa_compiled: {type(e).__name__}: {e}"[:400])
 
+    # --- 1c. compiled sliding-window flash ---
+    try:
+        B, H, T, d, W = 2, 4, 512, 64, 128
+        rng = np.random.RandomState(2)
+        qw = jax.device_put(jnp.asarray(rng.randn(B, H, T, d), dtype=jnp.float32))
+        kw_ = jax.device_put(jnp.asarray(rng.randn(B, H, T, d), dtype=jnp.float32))
+        vw = jax.device_put(jnp.asarray(rng.randn(B, H, T, d), dtype=jnp.float32))
+        o_f = jax.jit(
+            flash_attention, static_argnames=("causal", "interpret", "window")
+        )(qw, kw_, vw, causal=True, interpret=False, window=W)
+        o_r = _reference_attention(qw, kw_, vw, True, d ** -0.5, window=W)
+        err = float(jnp.max(jnp.abs(o_f - o_r)))
+        g_f = jax.jit(jax.grad(
+            lambda a, b, c: flash_attention(a, b, c, causal=True, interpret=False, window=W).sum(),
+            (0, 1, 2),
+        ))(qw, kw_, vw)
+        g_r = jax.jit(jax.grad(
+            lambda a, b, c: _reference_attention(a, b, c, True, d ** -0.5, window=W).sum(),
+            (0, 1, 2),
+        ))(qw, kw_, vw)
+        jax.block_until_ready((g_f, g_r))
+        bwd_err = max(float(jnp.max(jnp.abs(a - b))) for a, b in zip(g_f, g_r))
+        out["checks"]["flash_window_compiled"] = {
+            "fwd_max_abs_err": err,
+            "bwd_max_abs_err": bwd_err,
+            "pass": err < 2e-2 and bwd_err < 5e-2,
+        }
+    except Exception as e:  # noqa: BLE001
+        out["errors"].append(f"flash_window_compiled: {type(e).__name__}: {e}"[:400])
+
     # --- 2. one jit train step per model family (tiny shapes) ---
     from paddle_tpu import models
 
