@@ -38,24 +38,24 @@ from paddle_tpu.models.transformer import (
 __all__ = ["get_model", "lm_forward", "generate", "generate_beam", "BASE_CFG"]
 
 
-def _ring_core(ring_mesh):
+def _ring_core(ring_mesh, window=None):
     """Attention core for sequence-parallel long context: exact causal
     attention over the seq-sharded global sequence via the ring
     (``ops/ring_attention.py``) instead of XLA's all-gather lowering."""
     from paddle_tpu.ops.ring_attention import ring_attention_sharded
 
     return lambda qh, kh, vh: ring_attention_sharded(
-        qh, kh, vh, ring_mesh, causal=True
+        qh, kh, vh, ring_mesh, causal=True, window=window
     )
 
 
-def _ulysses_core(mesh):
+def _ulysses_core(mesh, window=None):
     """All-to-all sequence parallelism (``ops/ulysses.py``): re-shard
     seq->head, plain flash attention on full local sequences, shard back."""
     from paddle_tpu.ops.ulysses import ulysses_attention_sharded
 
     return lambda qh, kh, vh: ulysses_attention_sharded(
-        qh, kh, vh, mesh, causal=True
+        qh, kh, vh, mesh, causal=True, window=window
     )
 
 
@@ -91,15 +91,11 @@ def _with_rope(core):
 def lm_block(x, cfg, name):
     ring_mesh = cfg.get("ring_mesh")
     ulysses_mesh = cfg.get("ulysses_mesh")
-    if (ring_mesh is not None or ulysses_mesh is not None) and cfg.get("attention_window"):
-        raise NotImplementedError(
-            "attention_window is not supported together with ring/ulysses "
-            "sequence parallelism yet"
-        )
+    window = cfg.get("attention_window")
     if ring_mesh is not None:
-        core = _ring_core(ring_mesh)
+        core = _ring_core(ring_mesh, window=window)
     elif ulysses_mesh is not None:
-        core = _ulysses_core(ulysses_mesh)
+        core = _ulysses_core(ulysses_mesh, window=window)
     else:
         core = None
     if cfg.get("pos_encoding") == "rope":

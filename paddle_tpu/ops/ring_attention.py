@@ -24,6 +24,7 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from paddle_tpu.core.dtypes import NEG_INF
+from paddle_tpu.core.enforce import enforce
 from paddle_tpu.parallel import mesh as mesh_mod
 
 __all__ = ["ring_attention", "ring_attention_sharded"]
@@ -57,7 +58,7 @@ def _merge(m1, l1, o1, m2, l2, o2):
     return m, l, o
 
 
-def _ring_composed(q, k, v, axis: str, causal: bool) -> jax.Array:
+def _ring_composed(q, k, v, axis: str, causal: bool, window=None) -> jax.Array:
     """Composed-einsum ring body — the always-differentiable reference path
     (scan + ppermute autodiff) and the recompute backward for the flash
     forward below."""
@@ -75,7 +76,10 @@ def _ring_composed(q, k, v, axis: str, causal: bool) -> jax.Array:
         kv_rank = (rank - i) % n_dev
         k_pos = kv_rank * t_local + jnp.arange(t_local)
         if causal:
-            return jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, NEG_INF)[None, None]
+            keep = q_pos[:, None] >= k_pos[None, :]
+            if window is not None:  # sliding window over GLOBAL positions
+                keep = jnp.logical_and(keep, q_pos[:, None] - k_pos[None, :] < window)
+            return jnp.where(keep, 0.0, NEG_INF)[None, None]
         return jnp.zeros((1, 1, t_local, t_local), jnp.float32)
 
     # step 0 on the local block, then permute-then-compute for the remaining
@@ -236,6 +240,7 @@ def ring_attention(
     axis: str,
     causal: bool = False,
     use_flash: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Per-device body (call inside shard_map/pjit with ``axis`` a mesh axis
     over which the SEQUENCE dim is sharded). q/k/v: [B, H, T_local, d].
@@ -252,6 +257,11 @@ def ring_attention(
         from paddle_tpu.core.config import flags
 
         use_flash = flags().use_flash_attention
+    if window is not None:
+        enforce(causal, "ring_attention: window requires causal=True")
+        # window rides the composed body (global-position band bias); the
+        # flash ring's block kernels have no cross-block offset masking yet
+        return _ring_composed(q, k, v, axis, causal, window)
     if use_flash and q.ndim == 4:
         from paddle_tpu.ops.attention import _flash_block
 
@@ -269,6 +279,7 @@ def ring_attention_sharded(
     causal: bool = False,
     use_flash: Optional[bool] = None,
     batch_axis: Optional[str] = mesh_mod.DATA_AXIS,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Convenience wrapper: q/k/v are GLOBAL [B, H, T, d] arrays; shards the
     T dim over ``axis`` (and the batch dim over ``batch_axis`` when the mesh
@@ -288,7 +299,8 @@ def ring_attention_sharded(
         b_axis = None
     spec = P(b_axis, None, axis, None)
     return shard_map(
-        partial(ring_attention, axis=axis, causal=causal, use_flash=use_flash),
+        partial(ring_attention, axis=axis, causal=causal, use_flash=use_flash,
+                window=window),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -32,7 +32,7 @@ from paddle_tpu.parallel import mesh as mesh_mod
 __all__ = ["ulysses_attention", "ulysses_attention_sharded"]
 
 
-def _local_attention(q, k, v, causal: bool, use_flash: Optional[bool]):
+def _local_attention(q, k, v, causal: bool, use_flash: Optional[bool], window=None):
     from paddle_tpu.core import config as _cfg
 
     flash = use_flash if use_flash is not None else _cfg.flags().use_flash_attention
@@ -41,10 +41,10 @@ def _local_attention(q, k, v, causal: bool, use_flash: Optional[bool]):
 
         t = q.shape[-2]
         if t % 128 == 0 or t <= 128:
-            return flash_attention(q, k, v, causal=causal)
+            return flash_attention(q, k, v, causal=causal, window=window)
     from paddle_tpu.ops.pallas.flash_attention import _reference_attention
 
-    return _reference_attention(q, k, v, causal, q.shape[-1] ** -0.5)
+    return _reference_attention(q, k, v, causal, q.shape[-1] ** -0.5, window=window)
 
 
 def ulysses_attention(
@@ -54,6 +54,7 @@ def ulysses_attention(
     axis: str = mesh_mod.SEQ_AXIS,
     causal: bool = False,
     use_flash: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Per-shard body (call under ``shard_map``): q/k/v are LOCAL
     [B, H, T_local, d] blocks sharded over ``axis`` on the T dim. Returns the
@@ -72,7 +73,7 @@ def ulysses_attention(
     qh = jax.lax.all_to_all(q, axis, split_axis=1, concat_axis=2, tiled=True)
     kh = jax.lax.all_to_all(k, axis, split_axis=1, concat_axis=2, tiled=True)
     vh = jax.lax.all_to_all(v, axis, split_axis=1, concat_axis=2, tiled=True)
-    out = _local_attention(qh, kh, vh, causal, use_flash)
+    out = _local_attention(qh, kh, vh, causal, use_flash, window)
     # inverse: split seq back out, gather heads
     return jax.lax.all_to_all(out, axis, split_axis=2, concat_axis=1, tiled=True)
 
@@ -86,6 +87,7 @@ def ulysses_attention_sharded(
     causal: bool = False,
     use_flash: Optional[bool] = None,
     batch_axis: Optional[str] = mesh_mod.DATA_AXIS,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Convenience wrapper mirroring :func:`ring_attention_sharded`: q/k/v
     are GLOBAL [B, H, T, d]; shards T over ``axis`` (and batch over
@@ -96,7 +98,8 @@ def ulysses_attention_sharded(
         b_axis = None
     spec = P(b_axis, None, axis, None)
     return shard_map(
-        partial(ulysses_attention, axis=axis, causal=causal, use_flash=use_flash),
+        partial(ulysses_attention, axis=axis, causal=causal, use_flash=use_flash,
+                window=window),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
