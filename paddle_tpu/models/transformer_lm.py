@@ -167,6 +167,8 @@ def generate(
     cfg: dict,
     temperature: float = 0.0,
     rng: jax.Array | None = None,
+    top_k: int | None = None,
+    top_p: float | None = None,
 ) -> jax.Array:
     """Autoregressive decode with a static k/v cache — prefill once over the
     prompt, then one ``lax.scan`` step per new token (single compile, no
@@ -265,7 +267,22 @@ def generate(
     def sample(logits, key):
         if temperature == 0.0:
             return jnp.argmax(logits, -1).astype(jnp.int32)
-        return jax.random.categorical(key, logits / temperature).astype(jnp.int32)
+        logits = logits / temperature
+        if top_k is not None:
+            kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p is not None:
+            # nucleus: keep the smallest prefix of sorted probs with
+            # cumulative mass >= top_p (the top token always survives)
+            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep_sorted = cum - probs < top_p
+            cutoff = jnp.min(
+                jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+            )
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+        return jax.random.categorical(key, logits).astype(jnp.int32)
 
     # ---- prefill: full causal pass over the prompt fills caches [0, Tp)
     kc0 = jnp.zeros((L, B, H_kv, T_max, dh), jnp.float32)

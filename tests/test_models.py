@@ -426,3 +426,30 @@ def test_transformer_lm_generate_rope_matches_naive_decode():
         naive.append(nxt)
         seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(jnp.stack(naive, 1)))
+
+
+def test_transformer_lm_generate_topk_topp():
+    """top_k=1 sampling == greedy; top_p nucleus sampling yields valid ids."""
+    from paddle_tpu.models import transformer_lm
+
+    rng = np.random.RandomState(0)
+    spec = models.get_model(
+        "transformer_lm", seq_len=8, vocab=64, d_model=32, d_inner=64,
+        num_heads=2, n_layers=1,
+    )
+    batch = spec.synth_batch(2, rng)
+    v = spec.model.init(0, *batch)
+    cfg = spec.extra["cfg"]
+    prompt = jnp.asarray(rng.randint(2, 64, size=(2, 6)).astype(np.int32))
+
+    greedy = transformer_lm.generate(v, prompt, 4, cfg)
+    k1 = transformer_lm.generate(
+        v, prompt, 4, cfg, temperature=1.0, rng=jax.random.PRNGKey(7), top_k=1
+    )
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+
+    p9 = transformer_lm.generate(
+        v, prompt, 4, cfg, temperature=0.8, rng=jax.random.PRNGKey(7), top_p=0.9
+    )
+    ids = np.asarray(p9)
+    assert ids.shape == (2, 4) and (0 <= ids).all() and (ids < 64).all()
