@@ -308,3 +308,31 @@ def test_flash_sliding_window_requires_causal(rng):
     q = jnp.zeros((1, 1, 16, 8), jnp.float32)
     with pytest.raises(EnforceError, match="causal"):
         flash_attention(q, q, q, causal=False, window=8)
+
+
+def test_flash_attention_gqa_with_window(rng):
+    """GQA and sliding window together through the fused kernels."""
+    from paddle_tpu.ops.pallas.flash_attention import (
+        _reference_attention,
+        flash_attention,
+    )
+
+    B, H, Hkv, T, d, W = 1, 4, 2, 64, 16, 24
+    q = jnp.asarray(rng.randn(B, H, T, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, Hkv, T, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, Hkv, T, d).astype(np.float32))
+
+    out = flash_attention(q, k, v, causal=True, window=W, block_q=16, block_k=16)
+    ref = _reference_attention(q, k, v, True, d ** -0.5, window=W)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+    g_f = jax.grad(
+        lambda a, b, c: flash_attention(a, b, c, causal=True, window=W,
+                                        block_q=16, block_k=16).sum(), (0, 1, 2)
+    )(q, k, v)
+    g_r = jax.grad(
+        lambda a, b, c: _reference_attention(a, b, c, True, d ** -0.5,
+                                             window=W).sum(), (0, 1, 2)
+    )(q, k, v)
+    for a, b in zip(g_f, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5)
