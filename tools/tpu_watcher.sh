@@ -1,0 +1,113 @@
+#!/bin/bash
+# TPU-window watcher: the axon tunnel flaps (r2: never up; r3: one ~80-min
+# window). Probe every ~3 min all round; the moment the chip answers, run
+# the harvest chain IN VALUE ORDER, committing each artifact as it lands so
+# a mid-chain drop loses nothing. Steps are check-pointed via .harvest/*.done
+# markers; an interrupted step reruns at the next window.
+#
+# Usage: nohup bash tools/tpu_watcher.sh >/dev/null 2>&1 &
+cd /root/repo || exit 1
+mkdir -p .harvest
+LOG=.harvest/watcher.log
+export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
+
+log() { echo "$(date -u +%FT%TZ) $*" >> "$LOG"; }
+
+probe() {
+  timeout 150 python - >> "$LOG" 2>&1 <<'EOF'
+import jax, jax.numpy as jnp
+d = jax.devices()
+assert d[0].platform != "cpu", d
+jax.block_until_ready(jnp.ones((128, 128)) @ jnp.ones((128, 128)))
+print("PROBE_OK", d[0].device_kind, flush=True)
+EOF
+}
+
+commit_paths() {  # $1 = message; rest = paths. Only commits those paths.
+  local msg="$1"; shift
+  for i in 1 2 3; do
+    if git add -- "$@" >> "$LOG" 2>&1 && \
+       git commit -m "$msg" -- "$@" >> "$LOG" 2>&1; then
+      log "committed: $msg"; return 0
+    fi
+    sleep 7
+  done
+  log "commit FAILED: $msg"
+  return 1
+}
+
+# run_step <name> <timeout_s> <done_grep_file> <done_grep_pat> <commit_msg> <artifact...> -- <cmd...>
+run_step() {
+  local name=$1 tmo=$2 gfile=$3 gpat=$4 msg=$5; shift 5
+  local arts=()
+  while [ "$1" != "--" ]; do arts+=("$1"); shift; done
+  shift
+  [ -e ".harvest/$name.done" ] && return 0
+  log "step $name: starting (timeout ${tmo}s)"
+  timeout "$tmo" "$@" >> "$LOG" 2>&1
+  local rc=$?
+  if [ -f "$gfile" ] && grep -q "$gpat" "$gfile"; then
+    commit_paths "$msg" "${arts[@]}"
+    touch ".harvest/$name.done"
+    log "step $name: DONE (rc=$rc)"
+    return 0
+  fi
+  log "step $name: incomplete (rc=$rc); will retry next window"
+  # partial artifacts are still worth committing if they show tpu data
+  if [ -f "$gfile" ] && grep -q '"platform": "tpu"' "$gfile" 2>/dev/null; then
+    commit_paths "$msg (partial)" "${arts[@]}"
+  fi
+  return 1
+}
+
+harvest() {
+  # 1. smoke: numerics + steady-state throughput per family (~5-10 min)
+  PT_SMOKE_BUDGET_S=600 run_step smoke 700 SMOKE_TPU.json '_per_sec' \
+    "TPU window: smoke numerics + steady-state family throughput" \
+    SMOKE_TPU.json -- python tests/tpu_smoke.py || return 1
+  # 2. full bench: resnet50 sweep + lm_large MFU + flash A/B + decode + feed
+  if [ ! -e .harvest/bench.done ]; then
+    log "step bench: starting"
+    PT_BENCH_BUDGET_S=1600 PT_BENCH_CHILD_CAP_S=1500 \
+      timeout 1700 python bench.py > .harvest/bench_out.txt 2>> "$LOG"
+    tail -n 1 .harvest/bench_out.txt > BENCH_TPU_LIVE.json
+    if grep -q '"platform": "tpu"' BENCH_TPU_LIVE.json; then
+      commit_paths "TPU window: live bench (resnet50 sweep, MFU, decode, feed)" \
+        BENCH_TPU_LIVE.json
+      touch .harvest/bench.done
+      log "step bench: DONE"
+    else
+      log "step bench: no tpu result; will retry"
+      return 1
+    fi
+  fi
+  # 3. flash block autotune
+  PT_TUNE_BUDGET_S=900 run_step flashtune 1000 FLASH_TUNE_TPU.json '"ok": true' \
+    "TPU window: flash kernel block autotune + GQA/window A/B" \
+    FLASH_TUNE_TPU.json -- python tests/tpu_flash_tune.py || return 1
+  # 4. convergence to accuracy target
+  PT_CONV_BUDGET_S=1200 run_step convergence 1300 CONVERGENCE_r04.json '"ok": true' \
+    "TPU window: MNIST-to-97% + cifar resnet loss curve on chip" \
+    CONVERGENCE_r04.json -- python tests/tpu_convergence.py || return 1
+  # 5. op parity catalog on chip
+  run_step opparity 900 OP_PARITY_TPU.json '"platform": "tpu"' \
+    "TPU window: op catalog TPU-vs-CPU parity" \
+    OP_PARITY_TPU.json -- python tests/tpu_op_parity.py || return 1
+  return 0
+}
+
+log "watcher started (pid $$)"
+while true; do
+  if [ -e .harvest/smoke.done ] && [ -e .harvest/bench.done ] && \
+     [ -e .harvest/flashtune.done ] && [ -e .harvest/convergence.done ] && \
+     [ -e .harvest/opparity.done ]; then
+    log "all harvest steps done; watcher idling"
+    sleep 1800
+    continue
+  fi
+  if probe; then
+    log "chip UP — harvesting"
+    harvest && log "harvest chain complete" || log "harvest interrupted"
+  fi
+  sleep 170
+done
