@@ -359,8 +359,10 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
                     yield (r.rand(224, 224, 3).astype(np.float32), 1)
 
             batched = rdr.stack_batch(lambda: synth_source(), fbs)
-            pref = rdr.DevicePrefetcher(batched())
+            # t0 BEFORE construction: the prefetcher's fill thread starts
+            # synthesizing + transferring immediately
             t0 = time.perf_counter()
+            pref = rdr.DevicePrefetcher(batched())
             n = 0
             for imgs, labels in pref:
                 n += int(imgs.shape[0])

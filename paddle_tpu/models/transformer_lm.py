@@ -75,6 +75,39 @@ def _rope_core(cfg):
     return core
 
 
+def _decode_ffn_fn(proj, swiglu: bool):
+    """FFN for the cached decoders, pinned to ``positionwise_ffn``:
+    relu(fc1) or fc1 * silu(gate). One copy shared by generate and
+    generate_beam so train/decode FFN parity has a single edit point."""
+    def ffn(x, i):
+        if swiglu:
+            h = proj(x, f"layer_{i}/ffn/fc1") * jax.nn.silu(proj(x, f"layer_{i}/ffn/gate"))
+        else:
+            h = jax.nn.relu(proj(x, f"layer_{i}/ffn/fc1"))
+        return proj(h, f"layer_{i}/ffn/fc2")
+
+    return ffn
+
+
+def _prefill_mask(t: int, window):
+    """[t, t] bool causal(+sliding-band) mask — the decode-side single copy
+    of the training band ``i - j < window`` (scaled_dot_product_attention)."""
+    idx = jnp.arange(t)
+    mask = idx[None, :] <= idx[:, None]
+    if window is not None:
+        mask &= idx[:, None] - idx[None, :] < window
+    return mask
+
+
+def _live_mask(t_max: int, t, window):
+    """[t_max] bool mask of cache positions a token at position ``t`` may
+    attend: <= t, and within the last ``window`` positions when sliding."""
+    live = jnp.arange(t_max) <= t
+    if window is not None:
+        live &= jnp.arange(t_max) > t - window
+    return live
+
+
 def _with_rope(core):
     """Wrap a sequence-parallel attention core with RoPE: the rotation is
     per-position (applied on the GLOBAL [B, H, T, d] arrays before the core
@@ -202,6 +235,8 @@ def generate(
         "a silent fixed default would return identical 'samples' every call",
     )
     rope = cfg.get("pos_encoding", "sinusoid") == "rope"
+    swiglu = cfg.get("ffn_activation", "relu") == "swiglu"
+    window = cfg.get("attention_window")
     pe = sinusoid_position_encoding(max(cfg["max_len"], T_max), D)
     if rope:
         from paddle_tpu.ops.attention import apply_rope, rope_tables
@@ -220,6 +255,8 @@ def generate(
     def proj(x, pfx, bias=True):
         out = x @ p(f"{pfx}/w")
         return out + p(f"{pfx}/b") if bias else out
+
+    ffn = _decode_ffn_fn(proj, swiglu)
 
     def heads(x, n=None):  # [B, T, n*dh] -> [B, n, T, dh]
         n = n or H
@@ -258,8 +295,7 @@ def generate(
         ctx = attend(q, k, v, i)  # [B, H, Tq, dh]
         ctx = ctx.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], D)
         x = ln(x + proj(ctx, f"{pfx}/out"), f"layer_{i}/layer_norm")
-        h = jax.nn.relu(proj(x, f"layer_{i}/ffn/fc1"))
-        return ln(x + proj(h, f"layer_{i}/ffn/fc2"), f"layer_{i}/layer_norm_1")
+        return ln(x + ffn(x, i), f"layer_{i}/layer_norm_1")
 
     def logits_of(x_last):  # [B, D] -> [B, vocab]
         return ln(x_last, "layer_norm") @ p("project/logits/w")
@@ -293,8 +329,7 @@ def generate(
         caches["k"] = caches["k"].at[i, :, :, :Tp].set(k)
         caches["v"] = caches["v"].at[i, :, :, :Tp].set(v)
         s = jnp.einsum("bkgqd,bktd->bkgqt", grouped(q), k) * scale
-        mask = jnp.tril(jnp.ones((Tp, Tp), bool))
-        s = jnp.where(mask, s, -1e9)
+        s = jnp.where(_prefill_mask(Tp, window), s, -1e9)
         return ungrouped(jnp.einsum("bkgqt,bktd->bkgqd", jax.nn.softmax(s, -1), v))
 
     x = embed(prompt, 0)
@@ -316,7 +351,7 @@ def generate(
             kc = jax.lax.dynamic_update_slice(kc, k[None], (i, 0, 0, t, 0))
             vc = jax.lax.dynamic_update_slice(vc, v[None], (i, 0, 0, t, 0))
             s_ = jnp.einsum("bkgqd,bktd->bkgqt", grouped(q), kc[i]) * scale
-            live = jnp.arange(T_max) <= t
+            live = _live_mask(T_max, t, window)
             s_ = jnp.where(live[None, None, None, None, :], s_, -1e9)
             return ungrouped(jnp.einsum("bkgqt,bktd->bkgqd", jax.nn.softmax(s_, -1), vc[i]))
 
@@ -424,6 +459,8 @@ def generate_beam(
     H_kv = cfg.get("num_kv_heads") or H
     G = H // H_kv
     rope = cfg.get("pos_encoding", "sinusoid") == "rope"
+    swiglu = cfg.get("ffn_activation", "relu") == "swiglu"
+    window = cfg.get("attention_window")
     pe = sinusoid_position_encoding(max(cfg["max_len"], T_max), D)
     if rope:
         from paddle_tpu.ops.attention import apply_rope, rope_tables
@@ -442,6 +479,8 @@ def generate_beam(
     def proj(x, pfx, bias=True):
         out = x @ p(f"{pfx}/w")
         return out + p(f"{pfx}/b") if bias else out
+
+    ffn = _decode_ffn_fn(proj, swiglu)
 
     def heads(x, n):
         return x.reshape(x.shape[0], x.shape[1], n, dh).transpose(0, 2, 1, 3)
@@ -463,7 +502,7 @@ def generate_beam(
         n = q.shape[0]
         qg = q.reshape(n, H_kv, G, 1, dh)
         s = jnp.einsum("bkgqd,bktd->bkgqt", qg, kc_l) * scale
-        live = jnp.arange(T_max) <= t
+        live = _live_mask(T_max, t, window)
         s = jnp.where(live[None, None, None, None, :], s, -1e9)
         o = jnp.einsum("bkgqt,bktd->bkgqd", jax.nn.softmax(s, -1), vc_l)
         return o.reshape(n, H, 1, dh)
@@ -479,8 +518,7 @@ def generate_beam(
         ctx = attend(q, k, v, i)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], D)
         x = ln(x + proj(ctx, f"{pfx}/out"), f"layer_{i}/layer_norm")
-        h = jax.nn.relu(proj(x, f"layer_{i}/ffn/fc1"))
-        return ln(x + proj(h, f"layer_{i}/ffn/fc2"), f"layer_{i}/layer_norm_1")
+        return ln(x + ffn(x, i), f"layer_{i}/layer_norm_1")
 
     def logits_of(x_last):
         return ln(x_last, "layer_norm") @ p("project/logits/w")
@@ -496,8 +534,7 @@ def generate_beam(
             caches["v"] = caches["v"].at[:, i, :, :Thead].set(v)
             qg = q.reshape(B, H_kv, G, Thead, dh)
             s = jnp.einsum("bkgqd,bktd->bkgqt", qg, k) * scale
-            mask = jnp.tril(jnp.ones((Thead, Thead), bool))
-            s = jnp.where(mask[None, None, None], s, -1e9)
+            s = jnp.where(_prefill_mask(Thead, window)[None, None, None], s, -1e9)
             o = jnp.einsum("bkgqt,bktd->bkgqd", jax.nn.softmax(s, -1), v)
             return o.reshape(B, H, Thead, dh)
 

@@ -102,6 +102,14 @@ def scaled_dot_product_attention(
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
 
+    if window is not None and not causal:
+        # match flash_attention's contract on every path: a non-causal
+        # window would silently mean "past-limited but future-visible"
+        from paddle_tpu.core.enforce import enforce
+
+        enforce(False, "window requires causal=True (sliding-window attention "
+                       "is defined over the causal band)")
+
     from paddle_tpu.core import config as _cfg
 
     if (

@@ -170,6 +170,7 @@ def main() -> int:
         ro = ropt.create_state(rv.params)
         rstep = jax.jit(ropt.minimize(rspec.model))
         rcurve = []
+        aborted = None
         rt0 = time.monotonic()
         for s in range(1, rsteps + 1):
             try:
@@ -182,7 +183,7 @@ def main() -> int:
             if s % 10 == 0 or s == 1:
                 rcurve.append([s, round(float(jax.device_get(res.loss)), 4)])
             if _left() < 30:
-                out["resnet_cifar"]["aborted"] = "budget"
+                aborted = "budget"
                 break
         first_loss = rcurve[0][1] if rcurve else None
         last_loss = rcurve[-1][1] if rcurve else None
@@ -190,7 +191,9 @@ def main() -> int:
             "batch_size": rbs,
             "loss_curve": rcurve,
             "train_s": round(time.monotonic() - rt0, 1),
-            "pass": bool(rcurve) and last_loss < first_loss,
+            # a truncated curve is NOT a clean pass — mark it
+            "aborted": aborted,
+            "pass": aborted is None and bool(rcurve) and last_loss < first_loss,
         }
         _write(out)
     else:

@@ -167,6 +167,17 @@ for w in (1024, 2048):
         OUT["window"][f"w{w}_error"] = f"{type(e).__name__}: {str(e)[:120]}"
     _write()
 
-OUT["ok"] = bool(OUT["best"])
+# ok only when the WHOLE sweep ran: every T tuned without a budget cut and
+# the GQA/window A/B sections measured — a partial run must be retried at
+# the next chip window, not marked done by the watcher
+OUT["ok"] = (
+    all(
+        str(T) in OUT["best"] and not OUT["best"][str(T)].get("partial_sweep")
+        for T in (1024, 4096, 8192)
+    )
+    and "full_ms" in OUT["gqa"]
+    and any(k.endswith("_ms") for k in OUT["gqa"] if k != "full_ms")
+    and any(k.endswith("_ms") for k in OUT["window"])
+)
 _write()
 print(json.dumps(OUT))

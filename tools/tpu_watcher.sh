@@ -62,7 +62,7 @@ run_step() {
 
 harvest() {
   # 1. smoke: numerics + steady-state throughput per family (~5-10 min)
-  PT_SMOKE_BUDGET_S=600 run_step smoke 700 SMOKE_TPU.json '_per_sec' \
+  PT_SMOKE_BUDGET_S=600 run_step smoke 700 SMOKE_TPU.json '"complete": true' \
     "TPU window: smoke numerics + steady-state family throughput" \
     SMOKE_TPU.json -- python tests/tpu_smoke.py || return 1
   # 2. full bench: resnet50 sweep + lm_large MFU + flash A/B + decode + feed
