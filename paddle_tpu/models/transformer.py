@@ -106,7 +106,9 @@ def multi_head_attention(
                 f"cache={'set' if cache is not None else None}, "
                 f"dropout_rate={dropout_rate}",
             )
-            ctx = core(qh, kh, vh)
+            # kv_len DOES pass through: ring/ulysses cores mask global key
+            # positions >= kv_len[b] (ragged batches under seq parallelism)
+            ctx = core(qh, kh, vh, kv_len=kv_len) if kv_len is not None else core(qh, kh, vh)
         else:
             ctx = oattn.scaled_dot_product_attention(
                 qh, kh, vh, mask=mask, dropout_rate=dropout_rate,

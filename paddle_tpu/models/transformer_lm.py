@@ -44,8 +44,8 @@ def _ring_core(ring_mesh, window=None):
     (``ops/ring_attention.py``) instead of XLA's all-gather lowering."""
     from paddle_tpu.ops.ring_attention import ring_attention_sharded
 
-    return lambda qh, kh, vh: ring_attention_sharded(
-        qh, kh, vh, ring_mesh, causal=True, window=window
+    return lambda qh, kh, vh, kv_len=None: ring_attention_sharded(
+        qh, kh, vh, ring_mesh, causal=True, window=window, kv_len=kv_len
     )
 
 
@@ -54,8 +54,8 @@ def _ulysses_core(mesh, window=None):
     seq->head, plain flash attention on full local sequences, shard back."""
     from paddle_tpu.ops.ulysses import ulysses_attention_sharded
 
-    return lambda qh, kh, vh: ulysses_attention_sharded(
-        qh, kh, vh, mesh, causal=True, window=window
+    return lambda qh, kh, vh, kv_len=None: ulysses_attention_sharded(
+        qh, kh, vh, mesh, causal=True, window=window, kv_len=kv_len
     )
 
 
@@ -65,11 +65,11 @@ def _rope_core(cfg):
     relative-position functions."""
     from paddle_tpu.ops.attention import apply_rope, rope_tables, scaled_dot_product_attention
 
-    def core(qh, kh, vh):
+    def core(qh, kh, vh, kv_len=None):
         cos, sin = rope_tables(qh.shape[-1], qh.shape[-2])
         return scaled_dot_product_attention(
             apply_rope(qh, cos, sin), apply_rope(kh, cos, sin), vh, causal=True,
-            window=cfg.get("attention_window"),
+            window=cfg.get("attention_window"), kv_len=kv_len,
         )
 
     return core
@@ -114,14 +114,15 @@ def _with_rope(core):
     shards them), so rope composes exactly with ring/ulysses."""
     from paddle_tpu.ops.attention import apply_rope, rope_tables
 
-    def rotated(qh, kh, vh):
+    def rotated(qh, kh, vh, kv_len=None):
         cos, sin = rope_tables(qh.shape[-1], qh.shape[-2])
-        return core(apply_rope(qh, cos, sin), apply_rope(kh, cos, sin), vh)
+        q_r, k_r = apply_rope(qh, cos, sin), apply_rope(kh, cos, sin)
+        return core(q_r, k_r, vh, kv_len=kv_len) if kv_len is not None else core(q_r, k_r, vh)
 
     return rotated
 
 
-def lm_block(x, cfg, name):
+def lm_block(x, cfg, name, kv_len=None):
     ring_mesh = cfg.get("ring_mesh")
     ulysses_mesh = cfg.get("ulysses_mesh")
     window = cfg.get("attention_window")
@@ -138,7 +139,7 @@ def lm_block(x, cfg, name):
             x, x, x, cfg["d_model"], cfg["num_heads"],
             dropout_rate=cfg["attn_dropout"], causal=True, name="self_attn",
             core=core, num_kv_heads=cfg.get("num_kv_heads"),
-            window=cfg.get("attention_window"),
+            window=cfg.get("attention_window"), kv_len=kv_len,
         )
         x = _post_process(x, attn, cfg["residual_dropout"])
         ffn = positionwise_ffn(
@@ -158,24 +159,29 @@ def _block_caller(cfg):
     region, which is safe — creation is name-keyed and idempotent across
     the fwd/bwd re-traces."""
     if not cfg.get("remat"):
-        return lambda x, name: lm_block(x, cfg, name)
+        return lambda x, name, kv_len=None: lm_block(x, cfg, name, kv_len)
 
-    def call(x, name):
+    def call(x, name, kv_len=None):
         # remat only matters for the backward pass: during init the param
         # initializer outputs would leak out of checkpoint's inner trace,
         # and in eval mode checkpoint's CSE barriers are a pure slowdown
         if pt.framework.is_initializing() or not pt.framework.is_training():
-            return lm_block(x, cfg, name)
-        return jax.checkpoint(lambda y: lm_block(y, cfg, name))(x)
+            return lm_block(x, cfg, name, kv_len)
+        return jax.checkpoint(lambda y: lm_block(y, cfg, name, kv_len))(x)
 
     return call
 
 
-def lm_forward(ids, labels, *, cfg):
+def lm_forward(ids, labels, seq_lens=None, *, cfg):
     """Next-token LM training forward: returns (loss, token_count, logits).
 
-    ``ids``/``labels`` are [B, T] int32; every position is a target (synthetic
-    data has no padding — real data shifts by one and masks the tail)."""
+    ``ids``/``labels`` are [B, T] int32. ``seq_lens`` ([B] int32, optional)
+    marks suffix padding for ragged batches: attention masks key positions
+    >= seq_lens[b] structurally (kv_len through the flash kernels — and
+    through ring/ulysses when a sequence-parallel mesh is configured), and
+    the loss averages only positions p with p < seq_lens[b] - 1 (the last
+    real token has no next-token target). Without it every position is a
+    target (synthetic data has no padding)."""
     x = prepare_embedding(
         ids, cfg["vocab"], cfg["d_model"], cfg["max_len"],
         cfg["residual_dropout"], name="emb",
@@ -183,12 +189,17 @@ def lm_forward(ids, labels, *, cfg):
     )
     block = _block_caller(cfg)
     for i in range(cfg["n_layers"]):
-        x = block(x, name=f"layer_{i}")
+        x = block(x, name=f"layer_{i}", kv_len=seq_lens)
     x = layers.layer_norm(x, begin_norm_axis=x.ndim - 1)
     with name_scope("project"):
         logits = _proj(x, cfg["vocab"], shard_out=True, name="logits", bias=False)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if seq_lens is not None:
+        valid = (jnp.arange(labels.shape[1])[None, :] < seq_lens[:, None] - 1)
+        valid = valid.astype(jnp.float32)
+        n_tok = jnp.maximum(jnp.sum(valid), 1.0)
+        return jnp.sum(nll * valid) / n_tok, n_tok, logits
     n_tok = float(np.prod(labels.shape))
     return jnp.mean(nll), n_tok, logits
 

@@ -35,17 +35,24 @@ __all__ = ["flash_attention", "flash_attention_with_lse", "flash_attention_bwd_b
 
 
 def _flash_fwd_kernel(
-    q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+    q_ref, k_ref, v_ref, kvlen_ref, offs_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     *, block_q: int, block_k: int, causal: bool, sm_scale: float,
     has_kvlen: bool, window=None,
 ):
     """One (batch*head, q_block, kv_block) grid cell. Only the CURRENT
     [block_k, d] K/V tiles are VMEM-resident — long sequences stream through
     the innermost grid dimension with m/l/acc carried in VMEM scratch (the
-    kv dim iterates sequentially per core, so scratch persists across j)."""
+    kv dim iterates sequentially per core, so scratch persists across j).
+
+    ``offs_ref`` = [q_off, k_off] GLOBAL position offsets (SMEM scalars, may
+    be traced — e.g. ring-rank dependent): causal/window/kv_len masking is
+    applied at global positions, so an off-diagonal ring block pair runs this
+    same kernel with full block skipping instead of a composed fallback."""
     j = pl.program_id(2)
     n_kv = pl.num_programs(2)
     kv_limit = kvlen_ref[pl.program_id(0), 0] if has_kvlen else None
+    q_start = offs_ref[0] + pl.program_id(1) * block_q
+    k_start = offs_ref[1] + j * block_k
 
     @pl.when(j == 0)
     def _():
@@ -53,18 +60,15 @@ def _flash_fwd_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q_blk = pl.program_id(1)
     # causal: kv blocks fully above the diagonal contribute nothing — skip
     # their compute entirely (half the FLOPs on average); same for kv
     # blocks entirely past this row's kv_len (padded tails)
-    live = (j * block_k <= q_blk * block_q + block_q - 1) if causal else True
+    live = (k_start <= q_start + block_q - 1) if causal else True
     if window is not None:
         # kv block entirely left of every query's window -> dead
-        live = jnp.logical_and(
-            live, j * block_k + block_k - 1 >= q_blk * block_q - (window - 1)
-        )
+        live = jnp.logical_and(live, k_start + block_k - 1 >= q_start - (window - 1))
     if has_kvlen:
-        live = jnp.logical_and(live, j * block_k < kv_limit)
+        live = jnp.logical_and(live, k_start < kv_limit)
 
     @pl.when(live)
     def _():
@@ -75,13 +79,13 @@ def _flash_fwd_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k]
         if causal:
-            q_pos = q_blk * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
             if window is not None:
                 s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
         if has_kvlen:
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(k_pos < kv_limit, s, NEG_INF)
 
         m_prev, l_prev = m_ref[:], l_ref[:]
@@ -103,17 +107,21 @@ def _flash_fwd_kernel(
 
 
 def _flash_fwd_kernel_resident(
-    q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref,
+    q_ref, k_ref, v_ref, kvlen_ref, offs_ref, o_ref, lse_ref,
     *, block_k: int, causal: bool, sm_scale: float, has_kvlen: bool,
     window=None,
 ):
     """Fast path for K/V that fit in VMEM: one (batch*head, q_block) grid
     cell holds the whole K/V and loops kv blocks with a fori_loop — the
-    causal loop bound halves the work and Q is fetched once."""
+    causal loop bound halves the work and Q is fetched once. Global
+    position offsets as in :func:`_flash_fwd_kernel` (the loop bounds are
+    offset-shifted, so e.g. a fully-future ring block runs zero
+    iterations)."""
     _, block_q, d = q_ref.shape
     t_kv = k_ref.shape[1]
-    q_blk = pl.program_id(1)
     kv_limit = kvlen_ref[pl.program_id(0), 0] if has_kvlen else None
+    q_off, k_off = offs_ref[0], offs_ref[1]
+    q_start = q_off + pl.program_id(1) * block_q
 
     q = q_ref[0].astype(jnp.float32) * sm_scale
 
@@ -124,14 +132,15 @@ def _flash_fwd_kernel_resident(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
+        k_start = k_off + i * block_k
         if causal:
-            q_pos = q_blk * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
             if window is not None:
                 s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
         if has_kvlen:
-            k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(k_pos < kv_limit, s, NEG_INF)
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -145,14 +154,18 @@ def _flash_fwd_kernel_resident(
 
     n_kv = t_kv // block_k
     if causal:
-        n_kv_used = jnp.minimum(n_kv, pl.cdiv((q_blk + 1) * block_q, block_k))
+        # keys with global pos <= q_start + block_q - 1 -> local idx bound
+        hi = q_start + block_q - k_off
+        n_kv_used = jnp.clip((hi + block_k - 1) // block_k, 0, n_kv)
     else:
         n_kv_used = n_kv
     if has_kvlen:  # fully-padded tail blocks contribute nothing — skip them
-        n_kv_used = jnp.minimum(n_kv_used, pl.cdiv(kv_limit, block_k))
+        n_kv_used = jnp.minimum(
+            n_kv_used, jnp.maximum(0, (kv_limit - k_off + block_k - 1) // block_k)
+        )
     lo = 0
     if window is not None:  # kv blocks left of every window: skip entirely
-        lo = jnp.maximum(0, (q_blk * block_q - (window - 1)) // block_k)
+        lo = jnp.maximum(0, (q_start - k_off - (window - 1)) // block_k)
     init = (
         jnp.full((block_q, 1), NEG_INF, jnp.float32),
         jnp.zeros((block_q, 1), jnp.float32),
@@ -174,12 +187,21 @@ def _kvlen_rows(kv_len, B: int, H: int):
     return jnp.repeat(kv_len.astype(jnp.int32), H).reshape(B * H, 1)
 
 
+def _offs_arr(q_off, k_off):
+    """[2] i32 SMEM scalars: global position offsets (ints or traced)."""
+    return jnp.stack([
+        jnp.asarray(q_off, jnp.int32), jnp.asarray(k_off, jnp.int32)
+    ])
+
+
 def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int,
-               interpret: bool, kv_len=None, window=None):
+               interpret: bool, kv_len=None, window=None, q_off=0, k_off=0):
     """Returns ``(out [B,H,T,d], lse [B,H,T,1])`` — lse is the per-row
     logsumexp of the scaled scores, consumed by the fused backward.
     ``kv_len`` ([B] int) masks key positions >= kv_len[b] (suffix padding,
-    the LoD-replacement layout)."""
+    the LoD-replacement layout). ``q_off``/``k_off`` (ints or traced
+    scalars) shift causal/window/kv_len masking to GLOBAL positions — the
+    ring-attention block pairs pass their rank-derived offsets here."""
     B, H, T, d = q.shape
     h_kv = k.shape[1]
     t_kv = k.shape[2]
@@ -195,6 +217,7 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
     vr = v.reshape(B * h_kv, t_kv, d)
     has_kvlen = kv_len is not None
     lens = _kvlen_rows(kv_len, B, H) if has_kvlen else jnp.zeros((B * H, 1), jnp.int32)
+    offs = _offs_arr(q_off, k_off)
     from jax.experimental.pallas import tpu as pltpu
 
     def kvrow(b):  # combined q row -> combined kv row (GQA head sharing)
@@ -204,6 +227,7 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
         jax.ShapeDtypeStruct((B * H, T, d), q.dtype),
         jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32),
     ]
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     kv_bytes = 2 * t_kv * d * (4 if q.dtype == jnp.float32 else 2)
     if kv_bytes <= _VMEM_RESIDENT_BYTES:
         kernel = functools.partial(
@@ -218,7 +242,8 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
                 pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
                 pl.BlockSpec((1, t_kv, d), lambda b, i: (kvrow(b), 0, 0)),
                 pl.BlockSpec((1, t_kv, d), lambda b, i: (kvrow(b), 0, 0)),
-                pl.BlockSpec(memory_space=pltpu.SMEM),
+                smem,
+                smem,
             ],
             out_specs=[
                 pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -229,7 +254,7 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
                 dimension_semantics=("parallel", "arbitrary"),
             ),
             interpret=interpret,
-        )(qr, kr, vr, lens)
+        )(qr, kr, vr, lens, offs)
         return out.reshape(B, H, T, d), lse.reshape(B, H, T, 1)
 
     kernel = functools.partial(
@@ -244,7 +269,8 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (kvrow(b), j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (kvrow(b), j, 0)),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
+            smem,
+            smem,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -260,13 +286,13 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(qr, kr, vr, lens)
+    )(qr, kr, vr, lens, offs)
     return out.reshape(B, H, T, d), lse.reshape(B, H, T, 1)
 
 
 def _flash_bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, offs_ref,
+    dk_ref, dv_ref, dk_acc, dv_acc,
     *, block_q: int, block_k: int, causal: bool, sm_scale: float,
     has_kvlen: bool, n_qb: int, window=None,
 ):
@@ -282,6 +308,8 @@ def _flash_bwd_dkv_kernel(
     i = s_idx % n_qb  # q-block index within the current query head
     j = pl.program_id(1)
     kv_limit = kvlen_ref[pl.program_id(0), 0] if has_kvlen else None
+    q_start = offs_ref[0] + i * block_q  # GLOBAL positions (ring offsets)
+    k_start = offs_ref[1] + j * block_k
 
     @pl.when(s_idx == 0)
     def _():
@@ -290,13 +318,11 @@ def _flash_bwd_dkv_kernel(
 
     # causal: q blocks fully above this kv block's diagonal see none of it;
     # kv blocks fully past kv_len contribute zero grads — skip both
-    live = (i * block_q + block_q - 1 >= j * block_k) if causal else True
+    live = (q_start + block_q - 1 >= k_start) if causal else True
     if window is not None:
-        live = jnp.logical_and(
-            live, j * block_k + block_k - 1 >= i * block_q - (window - 1)
-        )
+        live = jnp.logical_and(live, k_start + block_k - 1 >= q_start - (window - 1))
     if has_kvlen:
-        live = jnp.logical_and(live, j * block_k < kv_limit)
+        live = jnp.logical_and(live, k_start < kv_limit)
 
     @pl.when(live)
     def _():
@@ -310,13 +336,13 @@ def _flash_bwd_dkv_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k]
         if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
             if window is not None:
                 s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
         if has_kvlen:
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(k_pos < kv_limit, s, NEG_INF)
         p = jnp.exp(s - lse)  # normalized probabilities, [block_q, block_k]
         dv_acc[:] += jax.lax.dot_general(
@@ -337,7 +363,8 @@ def _flash_bwd_dkv_kernel(
 
 
 def _flash_bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dq_ref, dq_acc,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, offs_ref,
+    dq_ref, dq_acc,
     *, block_q: int, block_k: int, causal: bool, sm_scale: float,
     has_kvlen: bool, window=None,
 ):
@@ -346,18 +373,18 @@ def _flash_bwd_dq_kernel(
     n_kv = pl.num_programs(2)
     i = pl.program_id(1)
     kv_limit = kvlen_ref[pl.program_id(0), 0] if has_kvlen else None
+    q_start = offs_ref[0] + i * block_q  # GLOBAL positions (ring offsets)
+    k_start = offs_ref[1] + j * block_k
 
     @pl.when(j == 0)
     def _():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    live = (j * block_k <= i * block_q + block_q - 1) if causal else True
+    live = (k_start <= q_start + block_q - 1) if causal else True
     if window is not None:
-        live = jnp.logical_and(
-            live, j * block_k + block_k - 1 >= i * block_q - (window - 1)
-        )
+        live = jnp.logical_and(live, k_start + block_k - 1 >= q_start - (window - 1))
     if has_kvlen:
-        live = jnp.logical_and(live, j * block_k < kv_limit)
+        live = jnp.logical_and(live, k_start < kv_limit)
 
     @pl.when(live)
     def _():
@@ -371,13 +398,13 @@ def _flash_bwd_dq_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
             if window is not None:
                 s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
         if has_kvlen:
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(k_pos < kv_limit, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
@@ -394,9 +421,10 @@ def _flash_bwd_dq_kernel(
 
 
 def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret,
-               kv_len=None, window=None):
+               kv_len=None, window=None, q_off=0, k_off=0):
     """Fused backward: returns (dq, dk, dv), each the dtype of its primal
-    (dk/dv at the kv head count under GQA)."""
+    (dk/dv at the kv head count under GQA). ``q_off``/``k_off``: global
+    position offsets, as in :func:`_flash_fwd`."""
     B, H, T, d = q.shape
     h_kv = k.shape[1]
     group = H // h_kv
@@ -422,6 +450,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
     lens_kv = (
         _kvlen_rows(kv_len, B, h_kv) if has_kvlen else jnp.zeros((B * h_kv, 1), jnp.int32)
     )
+    offs = _offs_arr(q_off, k_off)
     from jax.experimental.pallas import tpu as pltpu
 
     def kvrow(b):  # combined q row -> combined kv row
@@ -443,7 +472,8 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(B * h_kv, t_kv // block_k, group * n_qb),
-        in_specs=[q_stream, kv_fixed, kv_fixed, q_stream, row_stream, row_stream, len_spec3],
+        in_specs=[q_stream, kv_fixed, kv_fixed, q_stream, row_stream, row_stream,
+                  len_spec3, len_spec3],
         out_specs=[kv_fixed, kv_fixed],
         out_shape=[
             jax.ShapeDtypeStruct((B * h_kv, t_kv, d), k.dtype),
@@ -457,7 +487,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(qr, kr, vr, gr, lse_r, delta, lens_kv)
+    )(qr, kr, vr, gr, lse_r, delta, lens_kv, offs)
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel,
@@ -471,7 +501,8 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
     (dq,) = pl.pallas_call(
         dq_kernel,
         grid=(B * H, T // block_q, t_kv // block_k),
-        in_specs=[q_fixed, kv_stream, kv_stream, q_fixed, row_fixed, row_fixed, len_spec3],
+        in_specs=[q_fixed, kv_stream, kv_stream, q_fixed, row_fixed, row_fixed,
+                  len_spec3, len_spec3],
         out_specs=[q_fixed],
         out_shape=[jax.ShapeDtypeStruct((B * H, T, d), q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
@@ -479,7 +510,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(qr, kr, vr, gr, lse_r, delta, lens)
+    )(qr, kr, vr, gr, lse_r, delta, lens, offs)
 
     return (
         dq.reshape(B, H, T, d),
@@ -572,17 +603,34 @@ def flash_attention_with_lse(
     block_k: int = 128,
     interpret: Optional[bool] = None,
     kv_len: Optional[jax.Array] = None,
+    window: Optional[int] = None,
+    q_off=0,
+    k_off=0,
 ):
     """Forward-only fused attention returning ``(out, lse)`` with lse
     [B, H, T, 1] — the building block for outer blockwise schedules that
     merge partials themselves (ring attention merges per-ring-step outputs
     by lse). NOT differentiable: callers wrap the whole schedule in their
-    own ``jax.custom_vjp``."""
+    own ``jax.custom_vjp``.
+
+    ``q_off``/``k_off`` (ints or traced scalars) place the Q and K/V blocks
+    at GLOBAL sequence positions: causal, ``window`` (sliding band), and
+    ``kv_len`` masking all act on global positions, and block skipping
+    follows — a ring step whose K/V block is entirely future/out-of-window
+    costs (near) nothing. Rows with no live key come back with
+    lse ≈ NEG_INF, which the lse-merge weights to zero."""
+    if window is not None:
+        enforce(causal, "flash_attention_with_lse: window (sliding-window "
+                        "attention) requires causal=True")
+        enforce(window >= 1, f"window must be >= 1, got {window}")
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash_fwd(q, k, v, causal, float(sm_scale), block_q, block_k, interpret, kv_len)
+    return _flash_fwd(
+        q, k, v, causal, float(sm_scale), block_q, block_k, interpret, kv_len,
+        window, q_off, k_off,
+    )
 
 
 def flash_attention_bwd_block(
@@ -597,6 +645,10 @@ def flash_attention_bwd_block(
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    kv_len: Optional[jax.Array] = None,
+    window: Optional[int] = None,
+    q_off=0,
+    k_off=0,
 ):
     """One block-pair backward against GLOBAL residuals: returns
     ``(dq, dk, dv)`` for this (Q, K/V) pair, where ``out``/``lse`` are the
@@ -604,12 +656,22 @@ def flash_attention_bwd_block(
     (FlashAttention-2: Δ = rowsum(dO ∘ O) and P = exp(S − lse) both use
     global statistics, so per-block backward contributions are independent
     and sum to the exact gradients). The ring-attention backward calls this
-    per ring step, accumulating dK/dV in carriers that rotate with K/V."""
+    per ring step, accumulating dK/dV in carriers that rotate with K/V.
+    ``q_off``/``k_off``/``window``/``kv_len`` as in
+    :func:`flash_attention_with_lse` — masked entries have p = exp(NEG_INF
+    − lse) = 0, so dead blocks contribute exact zeros."""
+    if window is not None:
+        enforce(causal, "flash_attention_bwd_block: window (sliding-window "
+                        "attention) requires causal=True")
+        enforce(window >= 1, f"window must be >= 1, got {window}")
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash_bwd(q, k, v, out, lse, g, causal, float(sm_scale), block_q, block_k, interpret)
+    return _flash_bwd(
+        q, k, v, out, lse, g, causal, float(sm_scale), block_q, block_k,
+        interpret, kv_len, window, q_off, k_off,
+    )
 
 
 def flash_attention(

@@ -25,6 +25,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from paddle_tpu.core.dtypes import NEG_INF
 from paddle_tpu.core.enforce import enforce
+from paddle_tpu.ops.pallas.flash_attention import _float0_like
 from paddle_tpu.parallel import mesh as mesh_mod
 
 __all__ = ["ring_attention", "ring_attention_sharded"]
@@ -58,10 +59,11 @@ def _merge(m1, l1, o1, m2, l2, o2):
     return m, l, o
 
 
-def _ring_composed(q, k, v, axis: str, causal: bool, window=None) -> jax.Array:
+def _ring_composed(q, k, v, axis: str, causal: bool, window=None, kv_len=None) -> jax.Array:
     """Composed-einsum ring body — the always-differentiable reference path
     (scan + ppermute autodiff) and the recompute backward for the flash
-    forward below."""
+    forward below. ``kv_len`` ([B] int, GLOBAL lengths) masks key positions
+    >= kv_len[b] — ragged batches under sequence parallelism."""
     n_dev = jax.lax.psum(1, axis)
     rank = jax.lax.axis_index(axis)
     t_local = q.shape[2]
@@ -79,8 +81,13 @@ def _ring_composed(q, k, v, axis: str, causal: bool, window=None) -> jax.Array:
             keep = q_pos[:, None] >= k_pos[None, :]
             if window is not None:  # sliding window over GLOBAL positions
                 keep = jnp.logical_and(keep, q_pos[:, None] - k_pos[None, :] < window)
-            return jnp.where(keep, 0.0, NEG_INF)[None, None]
-        return jnp.zeros((1, 1, t_local, t_local), jnp.float32)
+            bias = jnp.where(keep, 0.0, NEG_INF)[None, None]
+        else:
+            bias = jnp.zeros((1, 1, t_local, t_local), jnp.float32)
+        if kv_len is not None:  # suffix padding at GLOBAL positions
+            lenm = jnp.where(k_pos[None, :] < kv_len[:, None], 0.0, NEG_INF)
+            bias = bias + lenm[:, None, None, :]
+        return bias
 
     # step 0 on the local block, then permute-then-compute for the remaining
     # n_dev-1 ring steps — no wasted final shift
@@ -112,37 +119,47 @@ def _merge_normalized(o1, lse1, o2, lse2):
     return o, m + jnp.log(l)
 
 
-def _ring_flash_fwd(q, k, v, axis: str, causal: bool) -> tuple[jax.Array, jax.Array]:
+def _ring_flash_fwd(
+    q, k, v, axis: str, causal: bool, window=None, kv_len=None,
+) -> tuple[jax.Array, jax.Array]:
     """Flash-kernel ring body: each (local-Q, rotating-KV) block pair runs
-    the fused Pallas kernel and partials merge by logsumexp. Step 0 is
-    always the diagonal block (causal kernel, top-left aligned — exact
-    because Q and KV start at the same global offset); later steps are
-    whole blocks: fully visible when the KV block is from an earlier rank,
-    dropped (lse=-inf) when from a later rank."""
+    the fused Pallas kernel AT ITS GLOBAL OFFSETS (q_off = rank·T_local,
+    k_off = kv_rank·T_local) and partials merge by logsumexp. The kernel's
+    offset-aware causal/window/kv_len masking subsumes the ring-level
+    bookkeeping: fully-future (or fully-out-of-window / fully-padded) K/V
+    blocks are block-skipped inside the kernel and come back with
+    lse ≈ NEG_INF, which the merge weights to zero — sliding-window cost
+    stays O(T·W) through the FLASH path."""
     from paddle_tpu.ops.attention import _flash_block
     from paddle_tpu.ops.pallas import flash_attention_with_lse
 
     n_dev = jax.lax.psum(1, axis)
     rank = jax.lax.axis_index(axis)
+    t_local = q.shape[-2]
     dtype = q.dtype
     # q in f32 (merge accumulates in its dtype); k/v keep the input dtype —
     # they rotate the ring, and bf16 halves the per-step ICI bytes (the
     # kernel upcasts tiles internally anyway)
     q32 = q.astype(jnp.float32)
     perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
-    bq = _flash_block(q.shape[-2])
+    bq = _flash_block(t_local)
     bk = _flash_block(k.shape[-2])
+    q_off = rank * t_local
 
-    o, lse = flash_attention_with_lse(q32, k, v, causal=causal, block_q=bq, block_k=bk)
+    o, lse = flash_attention_with_lse(
+        q32, k, v, causal=causal, block_q=bq, block_k=bk,
+        window=window, kv_len=kv_len, q_off=q_off, k_off=q_off,
+    )
 
     def step(carry, i):
         o, lse, kk, vv = carry
         kk = jax.lax.ppermute(kk, axis, perm)
         vv = jax.lax.ppermute(vv, axis, perm)
-        bo, blse = flash_attention_with_lse(q32, kk, vv, causal=False, block_q=bq, block_k=bk)
-        if causal:
-            kv_rank = (rank - i) % n_dev
-            blse = jnp.where(kv_rank > rank, NEG_INF, blse)
+        k_off = ((rank - i) % n_dev) * t_local
+        bo, blse = flash_attention_with_lse(
+            q32, kk, vv, causal=causal, block_q=bq, block_k=bk,
+            window=window, kv_len=kv_len, q_off=q_off, k_off=k_off,
+        )
         o, lse = _merge_normalized(o, lse, bo, blse)
         return (o, lse, kk, vv), None
 
@@ -150,11 +167,14 @@ def _ring_flash_fwd(q, k, v, axis: str, causal: bool) -> tuple[jax.Array, jax.Ar
     return o.astype(dtype), lse
 
 
-def _ring_flash_bwd_ring(q, k, v, out, lse, g, axis: str, causal: bool):
+def _ring_flash_bwd_ring(q, k, v, out, lse, g, axis: str, causal: bool,
+                         window=None, kv_len=None):
     """Fused-backward ring (Liu et al. ring attention, backward pass): each
-    ring step runs the Pallas block backward against the GLOBAL (out, lse)
-    residuals — Δ and P need only final statistics, so per-block dQ/dK/dV
-    contributions are exact and independent. dQ accumulates locally; dK/dV
+    ring step runs the Pallas block backward AT ITS GLOBAL OFFSETS against
+    the GLOBAL (out, lse) residuals — Δ and P need only final statistics,
+    so per-block dQ/dK/dV contributions are exact and independent, and the
+    kernel's offset masking zeroes dead (future / out-of-window / padded)
+    blocks with p = exp(NEG_INF − lse) = 0. dQ accumulates locally; dK/dV
     accumulate in f32 carriers that rotate WITH k/v, so after the full
     cycle (n-1 scan steps + one final shift) each block's gradient arrives
     back at its home device. Nothing [T_local, T_local]-shaped ever hits
@@ -164,18 +184,21 @@ def _ring_flash_bwd_ring(q, k, v, out, lse, g, axis: str, causal: bool):
 
     n_dev = jax.lax.psum(1, axis)
     rank = jax.lax.axis_index(axis)
+    t_local = q.shape[-2]
     perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
-    bq = _flash_block(q.shape[-2])
+    bq = _flash_block(t_local)
     bk = _flash_block(k.shape[-2])
     q32 = q.astype(jnp.float32)
     g32 = g.astype(jnp.float32)
     out32 = out.astype(jnp.float32)
+    q_off = rank * t_local
 
-    # step 0: the diagonal block (causal kernel when causal); f32 k/v so the
-    # gradient carriers start and stay full-precision
+    # step 0: the diagonal block; f32 k/v so the gradient carriers start and
+    # stay full-precision
     dq, dkk, dvv = flash_attention_bwd_block(
         q32, k.astype(jnp.float32), v.astype(jnp.float32), out32, lse, g32,
         causal=causal, block_q=bq, block_k=bk,
+        window=window, kv_len=kv_len, q_off=q_off, k_off=q_off,
     )
 
     def step(carry, i):
@@ -184,20 +207,14 @@ def _ring_flash_bwd_ring(q, k, v, out, lse, g, axis: str, causal: bool):
         vv = jax.lax.ppermute(vv, axis, perm)
         dkk = jax.lax.ppermute(dkk, axis, perm)
         dvv = jax.lax.ppermute(dvv, axis, perm)
-        step_lse = lse
-        if causal:
-            # blocks from later ranks contributed nothing to the merged lse;
-            # substituting a huge lse makes p = exp(s - lse) underflow to an
-            # exact 0 inside the kernel, zeroing this step's contributions
-            # without the inf·0 hazard of masking finished gradients
-            dead = (rank - i) % n_dev > rank
-            step_lse = jnp.where(dead, -NEG_INF, lse)
+        k_off = ((rank - i) % n_dev) * t_local
         # upcast the rotating K/V at the kernel call (ICI still moves the
         # input dtype): dk/dv then come back f32, so carrier accumulation
         # never rounds per step
         bdq, bdk, bdv = flash_attention_bwd_block(
             q32, kk.astype(jnp.float32), vv.astype(jnp.float32), out32,
-            step_lse, g32, causal=False, block_q=bq, block_k=bk,
+            lse, g32, causal=causal, block_q=bq, block_k=bk,
+            window=window, kv_len=kv_len, q_off=q_off, k_off=k_off,
         )
         dq = dq + bdq
         dkk = dkk + bdk
@@ -214,20 +231,28 @@ def _ring_flash_bwd_ring(q, k, v, out, lse, g, axis: str, causal: bool):
     return dq.astype(q.dtype), dkk.astype(k.dtype), dvv.astype(v.dtype)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _ring_flash(q, k, v, axis, causal):
-    out, _ = _ring_flash_fwd(q, k, v, axis, causal)
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _ring_flash(q, k, v, kv_len, axis, causal, window, has_kvlen):
+    out, _ = _ring_flash_fwd(
+        q, k, v, axis, causal, window, kv_len if has_kvlen else None
+    )
     return out
 
 
-def _ring_flash_vjp_fwd(q, k, v, axis, causal):
-    out, lse = _ring_flash_fwd(q, k, v, axis, causal)
-    return out, (q, k, v, out, lse)
+def _ring_flash_vjp_fwd(q, k, v, kv_len, axis, causal, window, has_kvlen):
+    out, lse = _ring_flash_fwd(
+        q, k, v, axis, causal, window, kv_len if has_kvlen else None
+    )
+    return out, (q, k, v, kv_len, out, lse)
 
 
-def _ring_flash_vjp_bwd(axis, causal, res, g):
-    q, k, v, out, lse = res
-    return _ring_flash_bwd_ring(q, k, v, out, lse, g, axis, causal)
+def _ring_flash_vjp_bwd(axis, causal, window, has_kvlen, res, g):
+    q, k, v, kv_len, out, lse = res
+    dq, dk, dv = _ring_flash_bwd_ring(
+        q, k, v, out, lse, g, axis, causal, window,
+        kv_len if has_kvlen else None,
+    )
+    return dq, dk, dv, _float0_like(kv_len)
 
 
 _ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
@@ -241,6 +266,7 @@ def ring_attention(
     causal: bool = False,
     use_flash: Optional[bool] = None,
     window: Optional[int] = None,
+    kv_len: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Per-device body (call inside shard_map/pjit with ``axis`` a mesh axis
     over which the SEQUENCE dim is sharded). q/k/v: [B, H, T_local, d].
@@ -252,22 +278,29 @@ def ring_attention(
     forward AND backward (a second ring of fused block-backwards against
     the global (out, lse) residuals) — so nothing [T_local, T_local]-shaped
     materializes in HBM in either direction: long-context training memory
-    stays O(T_local · d) per device."""
+    stays O(T_local · d) per device. ``window`` (sliding-window, causal
+    only) and ``kv_len`` ([B] GLOBAL lengths — ragged batches, the LoD
+    replacement) both ride the flash path natively via the kernels' global
+    position offsets. Note: gradients for queries at positions >= kv_len[b]
+    are only exact when the incoming cotangent is zero there (the loss must
+    mask pad positions — which defines them anyway)."""
     if use_flash is None:
         from paddle_tpu.core.config import flags
 
         use_flash = flags().use_flash_attention
     if window is not None:
         enforce(causal, "ring_attention: window requires causal=True")
-        # window rides the composed body (global-position band bias); the
-        # flash ring's block kernels have no cross-block offset masking yet
-        return _ring_composed(q, k, v, axis, causal, window)
     if use_flash and q.ndim == 4:
         from paddle_tpu.ops.attention import _flash_block
 
         if _flash_block(q.shape[-2]) and _flash_block(k.shape[-2]):
-            return _ring_flash(q, k, v, axis, causal)
-    return _ring_composed(q, k, v, axis, causal)
+            has_kvlen = kv_len is not None
+            if not has_kvlen:
+                kv_len = jnp.zeros((q.shape[0],), jnp.int32)
+            return _ring_flash(
+                q, k, v, kv_len.astype(jnp.int32), axis, causal, window, has_kvlen
+            )
+    return _ring_composed(q, k, v, axis, causal, window, kv_len)
 
 
 def ring_attention_sharded(
@@ -280,12 +313,14 @@ def ring_attention_sharded(
     use_flash: Optional[bool] = None,
     batch_axis: Optional[str] = mesh_mod.DATA_AXIS,
     window: Optional[int] = None,
+    kv_len: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Convenience wrapper: q/k/v are GLOBAL [B, H, T, d] arrays; shards the
     T dim over ``axis`` (and the batch dim over ``batch_axis`` when the mesh
     has it — each data group then rings only its own batch shard instead of
     all-gathering and redundantly computing the full batch), runs
-    :func:`ring_attention` under shard_map, and returns the global result."""
+    :func:`ring_attention` under shard_map, and returns the global result.
+    ``kv_len``: [B] GLOBAL sequence lengths (sharded with the batch)."""
     b_axis = batch_axis if (batch_axis and batch_axis in mesh.axis_names) else None
     if b_axis is not None and q.shape[0] % mesh.shape[b_axis] != 0:
         from paddle_tpu.core import logging as ptlog
@@ -298,11 +333,14 @@ def ring_attention_sharded(
         )
         b_axis = None
     spec = P(b_axis, None, axis, None)
+
+    def body(q_, k_, v_, *kl):
+        return ring_attention(q_, k_, v_, axis=axis, causal=causal,
+                              use_flash=use_flash, window=window,
+                              kv_len=kl[0] if kl else None)
+
+    args = (q, k, v) + ((kv_len,) if kv_len is not None else ())
+    in_specs = (spec, spec, spec) + ((P(b_axis),) if kv_len is not None else ())
     return shard_map(
-        partial(ring_attention, axis=axis, causal=causal, use_flash=use_flash,
-                window=window),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
-    )(q, k, v)
+        body, mesh=mesh, in_specs=in_specs, out_specs=spec, check_vma=False,
+    )(*args)

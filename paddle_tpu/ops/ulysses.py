@@ -32,7 +32,8 @@ from paddle_tpu.parallel import mesh as mesh_mod
 __all__ = ["ulysses_attention", "ulysses_attention_sharded"]
 
 
-def _local_attention(q, k, v, causal: bool, use_flash: Optional[bool], window=None):
+def _local_attention(q, k, v, causal: bool, use_flash: Optional[bool],
+                     window=None, kv_len=None):
     from paddle_tpu.core import config as _cfg
 
     flash = use_flash if use_flash is not None else _cfg.flags().use_flash_attention
@@ -40,11 +41,33 @@ def _local_attention(q, k, v, causal: bool, use_flash: Optional[bool], window=No
         from paddle_tpu.ops.pallas.flash_attention import flash_attention
 
         t = q.shape[-2]
+        if t % 128 and t > 128:
+            # pad T up to the next 128 multiple instead of silently
+            # materializing a [T, T] score matrix at exactly the long-T
+            # regime ulysses exists for: padded KEYS are masked via kv_len
+            # (reduced to the real length), padded QUERY rows are causal
+            # suffix rows sliced off below
+            pad = (-t) % 128
+            zpad = [(0, 0)] * (q.ndim - 2) + [(0, pad), (0, 0)]
+            qp, kp, vp = (jnp.pad(a, zpad) for a in (q, k, v))
+            real = jnp.full((q.shape[0],), t, jnp.int32)
+            eff_len = real if kv_len is None else jnp.minimum(kv_len, real)
+            from paddle_tpu.core import logging as ptlog
+
+            ptlog.vlog(
+                1, "ulysses: padding T=%d to %d for the flash kernel", t, t + pad
+            )
+            out = flash_attention(
+                qp, kp, vp, causal=causal, window=window, kv_len=eff_len
+            )
+            return out[..., :t, :]
         if t % 128 == 0 or t <= 128:
-            return flash_attention(q, k, v, causal=causal, window=window)
+            return flash_attention(q, k, v, causal=causal, window=window,
+                                   kv_len=kv_len)
     from paddle_tpu.ops.pallas.flash_attention import _reference_attention
 
-    return _reference_attention(q, k, v, causal, q.shape[-1] ** -0.5, window=window)
+    return _reference_attention(q, k, v, causal, q.shape[-1] ** -0.5,
+                                window=window, kv_len=kv_len)
 
 
 def ulysses_attention(
@@ -55,6 +78,7 @@ def ulysses_attention(
     causal: bool = False,
     use_flash: Optional[bool] = None,
     window: Optional[int] = None,
+    kv_len: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Per-shard body (call under ``shard_map``): q/k/v are LOCAL
     [B, H, T_local, d] blocks sharded over ``axis`` on the T dim. Returns the
@@ -62,6 +86,9 @@ def ulysses_attention(
 
     all_to_all #1: seq-sharded -> head-sharded ([B, H/n, T, d]);
     local full-sequence attention; all_to_all #2: back.
+    ``kv_len``: [B] GLOBAL lengths — after the first all_to_all the local
+    sequence IS global, so the flash kernel's kv_len masking applies
+    directly (ragged batches under sequence parallelism).
     """
     n = jax.lax.psum(1, axis)
     enforce(q.shape[1] % n == 0, f"num_heads {q.shape[1]} not divisible by {axis} size {n}")
@@ -73,7 +100,7 @@ def ulysses_attention(
     qh = jax.lax.all_to_all(q, axis, split_axis=1, concat_axis=2, tiled=True)
     kh = jax.lax.all_to_all(k, axis, split_axis=1, concat_axis=2, tiled=True)
     vh = jax.lax.all_to_all(v, axis, split_axis=1, concat_axis=2, tiled=True)
-    out = _local_attention(qh, kh, vh, causal, use_flash, window)
+    out = _local_attention(qh, kh, vh, causal, use_flash, window, kv_len)
     # inverse: split seq back out, gather heads
     return jax.lax.all_to_all(out, axis, split_axis=2, concat_axis=1, tiled=True)
 
@@ -88,20 +115,25 @@ def ulysses_attention_sharded(
     use_flash: Optional[bool] = None,
     batch_axis: Optional[str] = mesh_mod.DATA_AXIS,
     window: Optional[int] = None,
+    kv_len: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Convenience wrapper mirroring :func:`ring_attention_sharded`: q/k/v
     are GLOBAL [B, H, T, d]; shards T over ``axis`` (and batch over
     ``batch_axis`` when present), runs :func:`ulysses_attention` under
-    shard_map, returns the global result."""
+    shard_map, returns the global result. ``kv_len``: [B] GLOBAL lengths
+    (sharded with the batch)."""
     b_axis = batch_axis if (batch_axis and batch_axis in mesh.axis_names) else None
     if b_axis is not None and q.shape[0] % mesh.shape[b_axis] != 0:
         b_axis = None
     spec = P(b_axis, None, axis, None)
+
+    def body(q_, k_, v_, *kl):
+        return ulysses_attention(q_, k_, v_, axis=axis, causal=causal,
+                                 use_flash=use_flash, window=window,
+                                 kv_len=kl[0] if kl else None)
+
+    args = (q, k, v) + ((kv_len,) if kv_len is not None else ())
+    in_specs = (spec, spec, spec) + ((P(b_axis),) if kv_len is not None else ())
     return shard_map(
-        partial(ulysses_attention, axis=axis, causal=causal, use_flash=use_flash,
-                window=window),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
-    )(q, k, v)
+        body, mesh=mesh, in_specs=in_specs, out_specs=spec, check_vma=False,
+    )(*args)
