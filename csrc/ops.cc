@@ -266,22 +266,25 @@ NDArray dot_general(const NDArray& lhs, const NDArray& rhs,
 
   // R viewed as [B, N, K]; compute out[b, m, n] = sum_k L[b,m,k] * R[b,n,k].
   // Both operands are K-contiguous after arrange(), so the inner dot
-  // auto-vectorizes; rows are threaded across B*M and the n-loop is blocked
-  // so the active R panel stays in cache.
+  // auto-vectorizes. Rows are threaded across B*M and processed in tiles of
+  // MT: each streamed R row is reused for all MT L rows (L1-resident between
+  // the dots), cutting R's DRAM traffic MT-fold vs the row-at-a-time loop —
+  // the memory-bound regime of big-N fc layers.
   const float* Ld = L.data.data();
   const float* Rd = R.data.data();
   float* Od = out.data.data();
-  constexpr int64_t NB = 64;  // n-panel: NB rows of R (NB*K floats) per pass
+  constexpr int64_t MT = 8;
   parallel_for(B * M, 8, [&](int64_t lo, int64_t hi) {
-    for (int64_t bm = lo; bm < hi; ++bm) {
-      int64_t b = bm / M, m = bm % M;
-      const float* lrow = Ld + (b * M + m) * K;
+    for (int64_t t0 = lo; t0 < hi;) {
+      const int64_t b = t0 / M;
+      const int64_t t1 = std::min(std::min(hi, t0 + MT), (b + 1) * M);
       const float* Rp = Rd + b * N * K;
-      float* orow = Od + (b * M + m) * N;
-      for (int64_t n0 = 0; n0 < N; n0 += NB) {
-        int64_t n1 = std::min(N, n0 + NB);
-        for (int64_t n = n0; n < n1; ++n) orow[n] = dotf(lrow, Rp + n * K, K);
+      for (int64_t n = 0; n < N; ++n) {
+        const float* rrow = Rp + n * K;
+        for (int64_t bm = t0; bm < t1; ++bm)
+          Od[bm * N + n] = dotf(Ld + bm * K, rrow, K);
       }
+      t0 = t1;
     }
   });
   return out;
@@ -310,31 +313,44 @@ NDArray conv2d_nhwc(const NDArray& x, const NDArray& w,
     for (int64_t k = 0; k < K; ++k)
       for (int64_t oc = 0; oc < CO; ++oc) wt[oc * K + k] = w.data[k * CO + oc];
     const int64_t rows = Nb * OH * OW;
+    // Row tiles: the transposed filter panel wt [CO, K] streams from DRAM
+    // once per RT output positions instead of once per position (an RT-fold
+    // traffic cut — wt is ~9 MB for the late ResNet-50 stages and this loop
+    // is memory-bound); each wt row then stays L1-hot across the RT dots.
+    constexpr int64_t RT = 16;
     parallel_for(rows, 4, [&](int64_t lo, int64_t hi) {
-      std::vector<float> patch(static_cast<size_t>(K));
-      for (int64_t r = lo; r < hi; ++r) {
-        int64_t ow = r % OW, oh = (r / OW) % OH, n = r / (OW * OH);
-        float* p = patch.data();
-        for (int64_t kh = 0; kh < KH; ++kh) {
-          int64_t ih = oh * strides[0] + kh - pad_lo[0];
-          if (ih < 0 || ih >= H) {
-            std::memset(p, 0, sizeof(float) * KW * CI);
-            p += KW * CI;
-            continue;
-          }
-          for (int64_t kw = 0; kw < KW; ++kw) {
-            int64_t iw = ow * strides[1] + kw - pad_lo[1];
-            if (iw < 0 || iw >= W) {
-              std::memset(p, 0, sizeof(float) * CI);
-            } else {
-              std::memcpy(p, &x.data[((n * H + ih) * W + iw) * C],
-                          sizeof(float) * CI);
+      std::vector<float> patch(static_cast<size_t>(RT * K));
+      for (int64_t r0 = lo; r0 < hi; r0 += RT) {
+        const int64_t nr = std::min<int64_t>(RT, hi - r0);
+        for (int64_t rr = 0; rr < nr; ++rr) {
+          const int64_t r = r0 + rr;
+          int64_t ow = r % OW, oh = (r / OW) % OH, n = r / (OW * OH);
+          float* p = patch.data() + rr * K;
+          for (int64_t kh = 0; kh < KH; ++kh) {
+            int64_t ih = oh * strides[0] + kh - pad_lo[0];
+            if (ih < 0 || ih >= H) {
+              std::memset(p, 0, sizeof(float) * KW * CI);
+              p += KW * CI;
+              continue;
             }
-            p += CI;
+            for (int64_t kw = 0; kw < KW; ++kw) {
+              int64_t iw = ow * strides[1] + kw - pad_lo[1];
+              if (iw < 0 || iw >= W) {
+                std::memset(p, 0, sizeof(float) * CI);
+              } else {
+                std::memcpy(p, &x.data[((n * H + ih) * W + iw) * C],
+                            sizeof(float) * CI);
+              }
+              p += CI;
+            }
           }
         }
-        float* orow = &out.data[static_cast<size_t>(r) * CO];
-        for (int64_t oc = 0; oc < CO; ++oc) orow[oc] = dotf(patch.data(), &wt[oc * K], K);
+        for (int64_t oc = 0; oc < CO; ++oc) {
+          const float* wrow = &wt[oc * K];
+          for (int64_t rr = 0; rr < nr; ++rr)
+            out.data[static_cast<size_t>(r0 + rr) * CO + oc] =
+                dotf(patch.data() + rr * K, wrow, K);
+        }
       }
     });
     return out;

@@ -134,8 +134,12 @@ def scaled_dot_product_attention(
 
             out_dtype = q.dtype
             q, k, v = mxu_operands(q, k, v)  # bf16 halves K/V HBM traffic
+            # 128-divisible lengths defer to the kernel's chip-measured
+            # tuned_blocks table; shorter sequences pin the largest divisor
             return flash_attention(
-                q, k, v, causal=causal, sm_scale=scale, block_q=bq, block_k=bk,
+                q, k, v, causal=causal, sm_scale=scale,
+                block_q=None if bq == 128 else bq,
+                block_k=None if bk == 128 else bk,
                 kv_len=kv_len, window=window,
             ).astype(out_dtype)
     if kv_len is not None:

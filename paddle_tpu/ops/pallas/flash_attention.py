@@ -180,6 +180,24 @@ def _flash_fwd_kernel_resident(
 # K+V per (batch, head) beyond this stays in HBM and streams via the grid
 _VMEM_RESIDENT_BYTES = 4 * 1024 * 1024
 
+# Chip-measured (block_q, block_k) table, keyed by minimum sequence length —
+# populated from tests/tpu_flash_tune.py sweeps (FLASH_TUNE_TPU.json).
+# An empty or non-matching table -> the 128/128 MXU-aligned default. Rows are
+# ascending by min_T; the last row whose min_T <= T and whose blocks divide
+# the sequence lengths wins.
+_TUNED_BLOCKS: list[tuple[int, int, int]] = []
+
+
+def tuned_blocks(t_q: int, t_kv: int) -> tuple[int, int]:
+    """Resolve default (block_q, block_k) for the given sequence lengths:
+    the measured table when a row fits, else 128/128 (clamped by the
+    callers' divisibility requirements)."""
+    bq, bk = 128, 128
+    for min_t, q_, k_ in _TUNED_BLOCKS:
+        if t_q >= min_t and t_q % q_ == 0 and t_kv % k_ == 0:
+            bq, bk = q_, k_
+    return bq, bk
+
 
 def _kvlen_rows(kv_len, B: int, H: int):
     """[B] lengths → [B*H, 1] i32 so the kernel grid's combined batch*head
@@ -599,8 +617,8 @@ def flash_attention_with_lse(
     v: jax.Array,
     causal: bool = False,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
     kv_len: Optional[jax.Array] = None,
     window: Optional[int] = None,
@@ -627,6 +645,9 @@ def flash_attention_with_lse(
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if block_q is None or block_k is None:
+        tq, tk = tuned_blocks(q.shape[-2], k.shape[-2])
+        block_q, block_k = block_q or tq, block_k or tk
     return _flash_fwd(
         q, k, v, causal, float(sm_scale), block_q, block_k, interpret, kv_len,
         window, q_off, k_off,
@@ -642,8 +663,8 @@ def flash_attention_bwd_block(
     g: jax.Array,
     causal: bool = False,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
     kv_len: Optional[jax.Array] = None,
     window: Optional[int] = None,
@@ -668,6 +689,9 @@ def flash_attention_bwd_block(
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if block_q is None or block_k is None:
+        tq, tk = tuned_blocks(q.shape[-2], k.shape[-2])
+        block_q, block_k = block_q or tq, block_k or tk
     return _flash_bwd(
         q, k, v, out, lse, g, causal, float(sm_scale), block_q, block_k,
         interpret, kv_len, window, q_off, k_off,
@@ -680,8 +704,8 @@ def flash_attention(
     v: jax.Array,
     causal: bool = False,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
     kv_len: Optional[jax.Array] = None,
     window: Optional[int] = None,
@@ -698,11 +722,15 @@ def flash_attention(
     keys — sliding-window attention; out-of-window kv blocks are skipped
     entirely, making compute O(T * window) instead of O(T^2/2).
     ``interpret`` defaults to True off-TPU so the same code path runs under
-    the CPU test mesh."""
+    the CPU test mesh. ``block_q``/``block_k`` default to the chip-measured
+    :func:`tuned_blocks` table (128/128 until a tune run populates it)."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if block_q is None or block_k is None:
+        tq, tk = tuned_blocks(q.shape[-2], k.shape[-2])
+        block_q, block_k = block_q or tq, block_k or tk
     if window is not None:
         enforce(causal, "flash_attention: window (sliding-window attention) "
                         "requires causal=True")

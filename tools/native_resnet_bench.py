@@ -1,0 +1,63 @@
+"""Native C++ predictor throughput: ResNet-50 bs16 infer, the PARITY.md
+anchor config (reference MKL-DNN anchor: IntelOptimizedPaddle.md:93,
+217.69 img/s on 2S/40-core Xeon 6148 ~= 5.4 img/s/core).
+
+    python tools/native_resnet_bench.py [--bs 16] [--iters 3] [--depth 50]
+"""
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bs", type=int, default=16)
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--depth", type=int, default=50)
+    ap.add_argument("--threads", type=int, default=0, help="0 = all cores")
+    args = ap.parse_args()
+    if args.threads:
+        os.environ["PT_NATIVE_THREADS"] = str(args.threads)
+
+    import functools
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.resnet import resnet_imagenet
+    from paddle_tpu.native import NativePredictor
+    from paddle_tpu.native.export import save_native_model
+
+    # infer-only program (logits; no label gather — matches the serving
+    # artifact io.save_inference_model(native=True) produces)
+    net = pt.build(functools.partial(resnet_imagenet, class_dim=102,
+                                     depth=args.depth))
+    rng = np.random.RandomState(0)
+    x = rng.rand(args.bs, 224, 224, 3).astype(np.float32)
+    variables = net.init(0, x)
+
+    with tempfile.TemporaryDirectory() as td:
+        save_native_model(net, variables, [x], td)
+        pred = NativePredictor(td)
+        out = pred.run(x)  # warmup
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = pred.run(x)
+        dt = (time.perf_counter() - t0) / args.iters
+        print(f"native resnet{args.depth} bs{args.bs}: "
+              f"{args.bs / dt:.2f} img/s ({dt * 1e3:.0f} ms/batch)")
+        return out
+
+
+if __name__ == "__main__":
+    main()
