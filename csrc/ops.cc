@@ -14,6 +14,7 @@
 #include <atomic>
 #include <cmath>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <limits>
 #include <thread>
@@ -42,8 +43,13 @@ static inline float dotf(const float* a, const float* b, int64_t n) {
 // problems single-threaded so per-op dispatch stays cheap.
 void parallel_for(int64_t n, int64_t grain,
                   const std::function<void(int64_t, int64_t)>& body) {
+  static const int64_t env_threads = [] {
+    const char* s = std::getenv("PT_NATIVE_THREADS");
+    return s ? std::strtoll(s, nullptr, 10) : 0;
+  }();
   unsigned hw = std::thread::hardware_concurrency();
-  int64_t max_threads = hw ? static_cast<int64_t>(hw) : 1;
+  int64_t max_threads =
+      env_threads > 0 ? env_threads : (hw ? static_cast<int64_t>(hw) : 1);
   int64_t threads = std::min<int64_t>(max_threads, (n + grain - 1) / grain);
   if (threads <= 1) {
     body(0, n);
