@@ -266,12 +266,13 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
             dvars = dspec.model.init(0, *dspec.synth_batch(1, drng))
             Tp, N = 128, 64
 
-            def time_gen(bs, mnt):
+            def time_gen(bs, mnt, **gen_kw):
                 prompt = jnp.asarray(
                     drng.randint(1, dcfg["vocab"], size=(bs, Tp)).astype(np.int32)
                 )
                 fn = jax.jit(functools.partial(
-                    transformer_lm.generate, max_new_tokens=mnt, cfg=dcfg
+                    transformer_lm.generate, max_new_tokens=mnt, cfg=dcfg,
+                    **gen_kw,
                 ))
                 o = fn(dvars, prompt)
                 int(jax.device_get(o[0, -1]))
@@ -300,6 +301,19 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
                     f"decode bs={bs}: {result[f'decode_tok_per_sec_bs{bs}']} tok/s "
                     f"(prefill {result[f'prefill_ms_bs{bs}']} ms)", file=sys.stderr,
                 )
+            # bf16-cache A/B at bs=8: decode streams the whole cache per
+            # step, so halving its bytes is the decode-throughput lever
+            if time.monotonic() < deadline - 30:
+                t_p16 = time_gen(8, 1, cache_dtype=jnp.bfloat16)
+                t_f16 = time_gen(8, 1 + N, cache_dtype=jnp.bfloat16)
+                if t_f16 - t_p16 > t_p16 * 0.05:
+                    result["decode_tok_per_sec_bs8_bf16cache"] = round(
+                        8 * N / (t_f16 - t_p16), 1
+                    )
+                else:
+                    result["notes"].append("decode_bf16cache_noise_dominated")
+            else:
+                result["notes"].append("decode_bf16cache_skipped_budget")
         except Exception as e:
             result["notes"].append(f"decode_failed: {type(e).__name__}: {e}"[:300])
         checkpoint_result()

@@ -213,6 +213,7 @@ def generate(
     rng: jax.Array | None = None,
     top_k: int | None = None,
     top_p: float | None = None,
+    cache_dtype=None,
 ) -> jax.Array:
     """Autoregressive decode with a static k/v cache — prefill once over the
     prompt, then one ``lax.scan`` step per new token (single compile, no
@@ -228,6 +229,12 @@ def generate(
     would re-uniquify parameter names. The decode math is pinned to
     ``lm_forward`` by ``test_transformer_lm_generate_matches_naive_decode``
     — change one, and that exact-match test catches the drift.
+
+    ``cache_dtype`` (default f32): the k/v cache dtype. ``jnp.bfloat16``
+    halves decode HBM traffic — the decode-throughput lever on TPU, where
+    each step streams the whole cache — at bf16 rounding of cached keys/
+    values (scores still accumulate f32; confident predictions are
+    unaffected, see the memorized-decode test).
     """
     from paddle_tpu.core.enforce import enforce
     from paddle_tpu.models.transformer import sinusoid_position_encoding
@@ -332,13 +339,14 @@ def generate(
         return jax.random.categorical(key, logits).astype(jnp.int32)
 
     # ---- prefill: full causal pass over the prompt fills caches [0, Tp)
-    kc0 = jnp.zeros((L, B, H_kv, T_max, dh), jnp.float32)
-    vc0 = jnp.zeros((L, B, H_kv, T_max, dh), jnp.float32)
+    cdt = cache_dtype or jnp.float32
+    kc0 = jnp.zeros((L, B, H_kv, T_max, dh), cdt)
+    vc0 = jnp.zeros((L, B, H_kv, T_max, dh), cdt)
     caches = {"k": kc0, "v": vc0}
 
     def prefill_attend(q, k, v, i):
-        caches["k"] = caches["k"].at[i, :, :, :Tp].set(k)
-        caches["v"] = caches["v"].at[i, :, :, :Tp].set(v)
+        caches["k"] = caches["k"].at[i, :, :, :Tp].set(k.astype(cdt))
+        caches["v"] = caches["v"].at[i, :, :, :Tp].set(v.astype(cdt))
         s = jnp.einsum("bkgqd,bktd->bkgqt", grouped(q), k) * scale
         s = jnp.where(_prefill_mask(Tp, window), s, -1e9)
         return ungrouped(jnp.einsum("bkgqt,bktd->bkgqd", jax.nn.softmax(s, -1), v))
@@ -359,8 +367,8 @@ def generate(
 
         def attend(q, k, v, i):
             nonlocal kc, vc
-            kc = jax.lax.dynamic_update_slice(kc, k[None], (i, 0, 0, t, 0))
-            vc = jax.lax.dynamic_update_slice(vc, v[None], (i, 0, 0, t, 0))
+            kc = jax.lax.dynamic_update_slice(kc, k[None].astype(cdt), (i, 0, 0, t, 0))
+            vc = jax.lax.dynamic_update_slice(vc, v[None].astype(cdt), (i, 0, 0, t, 0))
             s_ = jnp.einsum("bkgqd,bktd->bkgqt", grouped(q), kc[i]) * scale
             live = _live_mask(T_max, t, window)
             s_ = jnp.where(live[None, None, None, None, :], s_, -1e9)
@@ -446,6 +454,7 @@ def generate_beam(
     beam_size: int = 4,
     eos_id: int = 1,
     length_penalty_alpha: float = 0.0,
+    cache_dtype=None,
 ):
     """Beam-search continuation of ``prompt``: returns
     ``(sequences [B, beam, max_new_tokens], scores [B, beam])`` best-first.
@@ -535,14 +544,15 @@ def generate_beam(
         return ln(x_last, "layer_norm") @ p("project/logits/w")
 
     # --- prefill positions [0, Tp-1): full causal pass over the prompt head
-    kc0 = jnp.zeros((B, L, H_kv, T_max, dh), jnp.float32)
-    vc0 = jnp.zeros((B, L, H_kv, T_max, dh), jnp.float32)
+    cdt = cache_dtype or jnp.float32  # bf16 halves decode HBM traffic
+    kc0 = jnp.zeros((B, L, H_kv, T_max, dh), cdt)
+    vc0 = jnp.zeros((B, L, H_kv, T_max, dh), cdt)
     caches = {"k": kc0, "v": vc0}
     Thead = Tp - 1
     if Thead > 0:
         def prefill_attend(q, k, v, i):
-            caches["k"] = caches["k"].at[:, i, :, :Thead].set(k)
-            caches["v"] = caches["v"].at[:, i, :, :Thead].set(v)
+            caches["k"] = caches["k"].at[:, i, :, :Thead].set(k.astype(cdt))
+            caches["v"] = caches["v"].at[:, i, :, :Thead].set(v.astype(cdt))
             qg = q.reshape(B, H_kv, G, Thead, dh)
             s = jnp.einsum("bkgqd,bktd->bkgqt", qg, k) * scale
             s = jnp.where(_prefill_mask(Thead, window)[None, None, None], s, -1e9)
@@ -564,8 +574,8 @@ def generate_beam(
 
         def attend(q, k, v, i):
             nonlocal kc, vc
-            kc = jax.lax.dynamic_update_slice(kc, k[:, None], (0, i, 0, t, 0))
-            vc = jax.lax.dynamic_update_slice(vc, v[:, None], (0, i, 0, t, 0))
+            kc = jax.lax.dynamic_update_slice(kc, k[:, None].astype(kc.dtype), (0, i, 0, t, 0))
+            vc = jax.lax.dynamic_update_slice(vc, v[:, None].astype(vc.dtype), (0, i, 0, t, 0))
             return attn_vs_cache(q, kc[:, i], vc[:, i], t)
 
         y = xt
