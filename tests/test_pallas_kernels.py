@@ -336,3 +336,24 @@ def test_flash_attention_gqa_with_window(rng):
     )(q, k, v)
     for a, b in zip(g_f, g_r):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5)
+
+
+def test_tuned_blocks_resolution():
+    """tuned_blocks: empty table -> 128/128; a populated row applies only
+    when its blocks divide the sequence lengths (no silent misconfig)."""
+    import importlib
+
+    # the package re-exports the flash_attention FUNCTION under the same
+    # name, so plain `import ... as fa` resolves to it — load the module
+    fa = importlib.import_module("paddle_tpu.ops.pallas.flash_attention")
+
+    assert fa.tuned_blocks(1024, 1024) == (128, 128)
+    old = fa._TUNED_BLOCKS
+    fa._TUNED_BLOCKS = [(0, 128, 128), (2048, 512, 256)]
+    try:
+        assert fa.tuned_blocks(4096, 4096) == (512, 256)
+        # 4096 q but kv=1920 (not 256-divisible): the tuned row must NOT apply
+        assert fa.tuned_blocks(4096, 1920) == (128, 128)
+        assert fa.tuned_blocks(1024, 1024) == (128, 128)  # below min_T
+    finally:
+        fa._TUNED_BLOCKS = old

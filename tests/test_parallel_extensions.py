@@ -871,3 +871,27 @@ def test_ring_attention_gqa_kvlen_window_matches_full(rng):
     for a, b, name in zip(g_ring, g_ref, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=3e-4, atol=3e-4, err_msg=f"d{name}")
+
+
+def test_transformer_lm_ragged_windowed_ring_matches_plain(rng):
+    """seq_lens AND attention_window together under ring sequence
+    parallelism: the masked loss equals the plain windowed LM's."""
+    from paddle_tpu import models
+
+    mesh = make_mesh(seq=4, data=2)
+    kw = dict(seq_len=32, vocab=64, d_model=32, d_inner=64, num_heads=4,
+              n_layers=1, attention_window=8)
+    plain = models.get_model("transformer_lm", **kw)
+    ringm = models.get_model("transformer_lm", ring_mesh=mesh, **kw)
+
+    rng_np = np.random.RandomState(7)
+    ids, labels = plain.synth_batch(8, rng_np)
+    seq_lens = rng_np.randint(4, 33, size=(8,)).astype(np.int32)
+    variables = plain.model.init(0, ids, labels, seq_lens)
+    (l_plain, _, _), _ = plain.model.apply(
+        variables, ids, labels, seq_lens, is_train=False
+    )
+    (l_ring, _, _), _ = ringm.model.apply(
+        variables, ids, labels, seq_lens, is_train=False
+    )
+    np.testing.assert_allclose(float(l_plain), float(l_ring), rtol=1e-4)
