@@ -24,6 +24,7 @@ Usage:  python tests/tpu_smoke.py            # writes SMOKE_TPU.json too
 """
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -227,6 +228,50 @@ def main() -> int:
         }
     except Exception as e:  # noqa: BLE001
         out["errors"].append(f"flash_window_compiled: {type(e).__name__}: {e}"[:400])
+    _write(out)
+
+    # --- 1d. compiled GLOBAL-OFFSET block pair (the ring building block):
+    # Mosaic-compiled kernels at q_off/k_off != 0, merged by lse, must equal
+    # the monolithic flash output. Single-chip proxy for the flash ring.
+    try:
+        from paddle_tpu.ops.pallas import flash_attention_with_lse
+
+        B, H, T, d = 2, 4, 512, 64
+        Tl = 256
+        rng = np.random.RandomState(3)
+        qo = jax.device_put(jnp.asarray(rng.randn(B, H, T, d), dtype=jnp.float32))
+        ko = jax.device_put(jnp.asarray(rng.randn(B, H, T, d), dtype=jnp.float32))
+        vo = jax.device_put(jnp.asarray(rng.randn(B, H, T, d), dtype=jnp.float32))
+
+        @functools.partial(jax.jit, static_argnames=("qi", "ki"))
+        def block(q, k, v, qi, ki):
+            return flash_attention_with_lse(
+                q[:, :, qi * Tl:(qi + 1) * Tl], k[:, :, ki * Tl:(ki + 1) * Tl],
+                v[:, :, ki * Tl:(ki + 1) * Tl], causal=True,
+                q_off=qi * Tl, k_off=ki * Tl, interpret=False,
+            )
+
+        def merge(o1, l1, o2, l2):
+            m = jnp.maximum(l1, l2)
+            a1, a2 = jnp.exp(l1 - m), jnp.exp(l2 - m)
+            return (o1 * a1 + o2 * a2) / (a1 + a2)
+
+        rows = []
+        for qi in range(2):
+            o0, l0 = block(qo, ko, vo, qi, 0)
+            o1, l1 = block(qo, ko, vo, qi, 1)
+            rows.append(merge(o0, l0, o1, l1))
+        got = jnp.concatenate(rows, axis=2)
+        full = jax.jit(flash_attention, static_argnames=("causal", "interpret"))(
+            qo, ko, vo, causal=True, interpret=False
+        )
+        err = float(jax.device_get(jnp.max(jnp.abs(got - full))))
+        out["checks"]["flash_offset_blocks_compiled"] = {
+            "max_abs_err_vs_monolithic": err,
+            "pass": err < 2e-2,
+        }
+    except Exception as e:  # noqa: BLE001
+        out["errors"].append(f"flash_offset_blocks: {type(e).__name__}: {e}"[:400])
     _write(out)
 
     # --- 2. train step per model family: correctness AND 6 steady-state
