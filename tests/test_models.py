@@ -554,3 +554,36 @@ def test_transformer_lm_generate_bf16_cache_matches_f32_when_confident():
         v, prompt, 6, cfg, beam_size=1, cache_dtype=jnp.bfloat16
     )
     np.testing.assert_array_equal(np.asarray(seqs32), np.asarray(seqs16))
+
+
+def test_transformer_lm_generate_modern_stack_matches_naive_decode():
+    """All modern-stack options AT ONCE — RoPE + GQA + SwiGLU + sliding
+    window: cached decode and beam_size=1 beam both exactly match naive
+    grow-the-prompt greedy decode through the training forward."""
+    from paddle_tpu.models import transformer_lm
+
+    rng = np.random.RandomState(5)
+    spec = models.get_model(
+        "transformer_lm", seq_len=8, vocab=64, d_model=32, d_inner=64,
+        num_heads=4, num_kv_heads=2, n_layers=2, pos_encoding="rope",
+        ffn_activation="swiglu", attention_window=4,
+    )
+    batch = spec.synth_batch(2, rng)
+    variables = spec.model.init(0, *batch)
+    cfg = spec.extra["cfg"]
+    prompt = jnp.asarray(rng.randint(2, 64, size=(2, 8)).astype(np.int32))
+
+    out = transformer_lm.generate(variables, prompt, max_new_tokens=6, cfg=cfg)
+    seq = prompt
+    naive = []
+    for _ in range(6):
+        (_, _, logits), _ = spec.model.apply(
+            variables, seq, jnp.zeros_like(seq), is_train=False
+        )
+        nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+        naive.append(nxt)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    naive = jnp.stack(naive, 1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(naive))
+    seqs, _ = transformer_lm.generate_beam(variables, prompt, 6, cfg, beam_size=1)
+    np.testing.assert_array_equal(np.asarray(seqs[:, 0]), np.asarray(naive))
