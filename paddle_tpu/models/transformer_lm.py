@@ -89,16 +89,6 @@ def _decode_ffn_fn(proj, swiglu: bool):
     return ffn
 
 
-def _prefill_mask(t: int, window):
-    """[t, t] bool causal(+sliding-band) mask — the decode-side single copy
-    of the training band ``i - j < window`` (scaled_dot_product_attention)."""
-    idx = jnp.arange(t)
-    mask = idx[None, :] <= idx[:, None]
-    if window is not None:
-        mask &= idx[:, None] - idx[None, :] < window
-    return mask
-
-
 def _live_mask(t_max: int, t, window):
     """[t_max] bool mask of cache positions a token at position ``t`` may
     attend: <= t, and within the last ``window`` positions when sliding."""
@@ -347,9 +337,13 @@ def generate(
     def prefill_attend(q, k, v, i):
         caches["k"] = caches["k"].at[i, :, :, :Tp].set(k.astype(cdt))
         caches["v"] = caches["v"].at[i, :, :, :Tp].set(v.astype(cdt))
-        s = jnp.einsum("bkgqd,bktd->bkgqt", grouped(q), k) * scale
-        s = jnp.where(_prefill_mask(Tp, window), s, -1e9)
-        return ungrouped(jnp.einsum("bkgqt,bktd->bkgqd", jax.nn.softmax(s, -1), v))
+        # sdpa routes long prompts through the flash kernel when the flag is
+        # on (no [Tp, Tp] materialization) and composes the identical
+        # causal+window einsum math otherwise — same path as the training
+        # forward, so decode-vs-forward stays exact
+        from paddle_tpu.ops.attention import scaled_dot_product_attention
+
+        return scaled_dot_product_attention(q, k, v, causal=True, window=window)
 
     x = embed(prompt, 0)
     for i in range(L):
@@ -553,11 +547,10 @@ def generate_beam(
         def prefill_attend(q, k, v, i):
             caches["k"] = caches["k"].at[:, i, :, :Thead].set(k.astype(cdt))
             caches["v"] = caches["v"].at[:, i, :, :Thead].set(v.astype(cdt))
-            qg = q.reshape(B, H_kv, G, Thead, dh)
-            s = jnp.einsum("bkgqd,bktd->bkgqt", qg, k) * scale
-            s = jnp.where(_prefill_mask(Thead, window)[None, None, None], s, -1e9)
-            o = jnp.einsum("bkgqt,bktd->bkgqd", jax.nn.softmax(s, -1), v)
-            return o.reshape(B, H, Thead, dh)
+            # flash-capable prefill, exactly as in generate()
+            from paddle_tpu.ops.attention import scaled_dot_product_attention
+
+            return scaled_dot_product_attention(q, k, v, causal=True, window=window)
 
         x = embed(prompt[:, :Thead], 0)
         for i in range(L):

@@ -587,3 +587,39 @@ def test_transformer_lm_generate_modern_stack_matches_naive_decode():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(naive))
     seqs, _ = transformer_lm.generate_beam(variables, prompt, 6, cfg, beam_size=1)
     np.testing.assert_array_equal(np.asarray(seqs[:, 0]), np.asarray(naive))
+
+
+def test_transformer_lm_generate_flash_prefill_matches_composed():
+    """With use_flash_attention ON, prefill routes through the fused kernel
+    (no [Tp, Tp] materialization); a confident (memorized) model must decode
+    the same tokens as the flag-off composed path, greedy and beam."""
+    from paddle_tpu.models import transformer_lm
+
+    spec = models.get_model(
+        "transformer_lm", seq_len=16, vocab=64, d_model=32, d_inner=64,
+        num_heads=4, num_kv_heads=2, n_layers=2, attention_window=8,
+    )
+    rng = np.random.RandomState(2)
+    ids = rng.randint(1, 64, size=(4, 16)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1)
+    v = spec.model.init(0, ids, labels)
+    opt = spec.optimizer()
+    o = opt.create_state(v.params)
+    step = jax.jit(opt.minimize(spec.model))
+    for s in range(120):
+        res = step(v, o, ids, labels, rng=jax.random.PRNGKey(s))
+        v, o = res.variables, res.opt_state
+    assert float(res.loss) < 0.5, float(res.loss)
+
+    cfg = spec.extra["cfg"]
+    prompt = jnp.asarray(ids[:, :8])
+    out_composed = transformer_lm.generate(v, prompt, 6, cfg)
+    beam_composed, _ = transformer_lm.generate_beam(v, prompt, 6, cfg, beam_size=1)
+    pt.core.config.set_flags(use_flash_attention=True)
+    try:
+        out_flash = transformer_lm.generate(v, prompt, 6, cfg)
+        beam_flash, _ = transformer_lm.generate_beam(v, prompt, 6, cfg, beam_size=1)
+    finally:
+        pt.core.config.set_flags(use_flash_attention=False)
+    np.testing.assert_array_equal(np.asarray(out_composed), np.asarray(out_flash))
+    np.testing.assert_array_equal(np.asarray(beam_composed), np.asarray(beam_flash))
