@@ -108,6 +108,20 @@ def _ring_composed(q, k, v, axis: str, causal: bool, window=None, kv_len=None) -
     return out.astype(dtype)
 
 
+def _ring_block_dead(causal: bool, window, q_off, k_off, t_local: int):
+    """True when an entire (local-Q, ring-step-KV) block pair is masked —
+    fully future under causal, or entirely left of every query's window.
+    The offset kernels would skip all compute anyway, but their grids still
+    STREAM the K/V tiles; callers lax.cond on this to skip even that HBM
+    traffic (about half the ring steps under causal)."""
+    if not causal:
+        return jnp.bool_(False)
+    dead = k_off > q_off + t_local - 1
+    if window is not None:
+        dead = jnp.logical_or(dead, k_off + t_local - 1 < q_off - (window - 1))
+    return dead
+
+
 def _merge_normalized(o1, lse1, o2, lse2):
     """Merge two NORMALIZED partials (o_i = softmax-weighted values over
     block i, lse_i = logsumexp of its scores, [B, H, T, 1])."""
@@ -156,9 +170,17 @@ def _ring_flash_fwd(
         kk = jax.lax.ppermute(kk, axis, perm)
         vv = jax.lax.ppermute(vv, axis, perm)
         k_off = ((rank - i) % n_dev) * t_local
-        bo, blse = flash_attention_with_lse(
-            q32, kk, vv, causal=causal, block_q=bq, block_k=bk,
-            window=window, kv_len=kv_len, q_off=q_off, k_off=k_off,
+        bo, blse = jax.lax.cond(
+            _ring_block_dead(causal, window, q_off, k_off, t_local),
+            lambda a, b, c: (
+                jnp.zeros(a.shape, jnp.float32),
+                jnp.full(a.shape[:-1] + (1,), NEG_INF, jnp.float32),
+            ),
+            lambda a, b, c: flash_attention_with_lse(
+                a, b, c, causal=causal, block_q=bq, block_k=bk,
+                window=window, kv_len=kv_len, q_off=q_off, k_off=k_off,
+            ),
+            q32, kk, vv,
         )
         o, lse = _merge_normalized(o, lse, bo, blse)
         return (o, lse, kk, vv), None
@@ -210,11 +232,21 @@ def _ring_flash_bwd_ring(q, k, v, out, lse, g, axis: str, causal: bool,
         k_off = ((rank - i) % n_dev) * t_local
         # upcast the rotating K/V at the kernel call (ICI still moves the
         # input dtype): dk/dv then come back f32, so carrier accumulation
-        # never rounds per step
-        bdq, bdk, bdv = flash_attention_bwd_block(
-            q32, kk.astype(jnp.float32), vv.astype(jnp.float32), out32,
-            lse, g32, causal=causal, block_q=bq, block_k=bk,
-            window=window, kv_len=kv_len, q_off=q_off, k_off=k_off,
+        # never rounds per step. Dead block pairs contribute exact zeros —
+        # lax.cond skips even their K/V tile streaming.
+        bdq, bdk, bdv = jax.lax.cond(
+            _ring_block_dead(causal, window, q_off, k_off, t_local),
+            lambda a, b, c: (
+                jnp.zeros(a.shape, jnp.float32),
+                jnp.zeros(b.shape, jnp.float32),
+                jnp.zeros(c.shape, jnp.float32),
+            ),
+            lambda a, b, c: flash_attention_bwd_block(
+                a, b.astype(jnp.float32), c.astype(jnp.float32), out32,
+                lse, g32, causal=causal, block_q=bq, block_k=bk,
+                window=window, kv_len=kv_len, q_off=q_off, k_off=k_off,
+            ),
+            q32, kk, vv,
         )
         dq = dq + bdq
         dkk = dkk + bdk

@@ -521,6 +521,23 @@ def test_transformer_lm_generate_topk_topp():
     assert ids.shape == (2, 4) and (0 <= ids).all() and (ids < 64).all()
 
 
+def _memorize_lm(spec, seed=0, steps=120):
+    """Train an LM to memorize a fixed next-token batch (confident logits
+    so decode A/B tests are deterministic). Returns (variables, prompt)."""
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(1, 64, size=(4, 16)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1)
+    v = spec.model.init(0, ids, labels)
+    opt = spec.optimizer()
+    o = opt.create_state(v.params)
+    step = jax.jit(opt.minimize(spec.model))
+    for s in range(steps):
+        res = step(v, o, ids, labels, rng=jax.random.PRNGKey(s))
+        v, o = res.variables, res.opt_state
+    assert float(res.loss) < 0.5, float(res.loss)
+    return v, jnp.asarray(ids[:, :8])
+
+
 def test_transformer_lm_generate_bf16_cache_matches_f32_when_confident():
     """cache_dtype=bf16 (half the decode HBM traffic) decodes the same
     tokens as the f32 cache once the model is confident: memorize a fixed
@@ -531,20 +548,8 @@ def test_transformer_lm_generate_bf16_cache_matches_f32_when_confident():
         "transformer_lm", seq_len=16, vocab=64, d_model=32, d_inner=64,
         num_heads=2, n_layers=2,
     )
-    rng = np.random.RandomState(0)
-    ids = rng.randint(1, 64, size=(4, 16)).astype(np.int32)
-    labels = np.roll(ids, -1, axis=1)
-    v = spec.model.init(0, ids, labels)
-    opt = spec.optimizer()
-    o = opt.create_state(v.params)
-    step = jax.jit(opt.minimize(spec.model))
-    for s in range(120):
-        res = step(v, o, ids, labels, rng=jax.random.PRNGKey(s))
-        v, o = res.variables, res.opt_state
-    assert float(res.loss) < 0.5, float(res.loss)
-
+    v, prompt = _memorize_lm(spec, seed=0)
     cfg = spec.extra["cfg"]
-    prompt = jnp.asarray(ids[:, :8])
     out32 = transformer_lm.generate(v, prompt, 6, cfg)
     out16 = transformer_lm.generate(v, prompt, 6, cfg, cache_dtype=jnp.bfloat16)
     np.testing.assert_array_equal(np.asarray(out32), np.asarray(out16))
@@ -599,20 +604,8 @@ def test_transformer_lm_generate_flash_prefill_matches_composed():
         "transformer_lm", seq_len=16, vocab=64, d_model=32, d_inner=64,
         num_heads=4, num_kv_heads=2, n_layers=2, attention_window=8,
     )
-    rng = np.random.RandomState(2)
-    ids = rng.randint(1, 64, size=(4, 16)).astype(np.int32)
-    labels = np.roll(ids, -1, axis=1)
-    v = spec.model.init(0, ids, labels)
-    opt = spec.optimizer()
-    o = opt.create_state(v.params)
-    step = jax.jit(opt.minimize(spec.model))
-    for s in range(120):
-        res = step(v, o, ids, labels, rng=jax.random.PRNGKey(s))
-        v, o = res.variables, res.opt_state
-    assert float(res.loss) < 0.5, float(res.loss)
-
+    v, prompt = _memorize_lm(spec, seed=2)
     cfg = spec.extra["cfg"]
-    prompt = jnp.asarray(ids[:, :8])
     out_composed = transformer_lm.generate(v, prompt, 6, cfg)
     beam_composed, _ = transformer_lm.generate_beam(v, prompt, 6, cfg, beam_size=1)
     pt.core.config.set_flags(use_flash_attention=True)
