@@ -461,9 +461,13 @@ def _probe_default_backend(timeout: float = 150.0) -> bool:
     a matmul at all? The round-1 failure mode was an axon tunnel that hangs
     indefinitely on backend init — don't burn the main budget on that."""
     code = (
+        # the pass condition is a device_get ROUNDTRIP: on the axon tunnel
+        # block_until_ready can return before any data flows, green-lighting
+        # a bench child that then hangs at its first op (seen r4)
         "import jax, jax.numpy as jnp; d = jax.devices(); "
-        "x = jnp.ones((128, 128)); jax.block_until_ready(x @ x); "
-        "print('PROBE_OK', d[0].platform, d[0].device_kind)"
+        "o = jax.jit(lambda a: a @ a)(jnp.ones((128, 128))); "
+        "v = float(jax.device_get(o.ravel()[0])); "
+        "print('PROBE_OK', d[0].platform, d[0].device_kind, v)"
     )
     try:
         proc = subprocess.run(

@@ -1,0 +1,48 @@
+"""Shared stall watchdog for the chip-harvest scripts.
+
+The axon tunnel can die MID-run with device ops blocking forever (r4: the
+watcher probe succeeded, then the very next op hung until the outer step
+timeout killed the process ~11 minutes later). Every harvest script writes
+its artifact incrementally, so a stalled check holds no new data — exiting
+early costs nothing and lets the watcher re-probe minutes sooner.
+
+Usage (one line, BEFORE the first ``import jax`` — backend init itself can
+hang on a dead tunnel, the round-1 failure mode):
+    _PROGRESS = _stall_watchdog.install("SMOKE", "PT_SMOKE_STALL_S", 300)
+    ...
+    _PROGRESS[0] = time.monotonic()          # refresh in every _write()/step
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+
+def install(name: str, env_var: str, default_s: float) -> list:
+    """Arm the watchdog (stall budget from ``env_var``) and return the
+    progress stamp the caller must refresh after each completed check."""
+    progress = [time.monotonic()]
+    start(progress, float(os.environ.get(env_var, str(default_s))), name)
+    return progress
+
+
+def start(last_progress: list, stall_s: float, name: str) -> None:
+    """Arm a daemon thread that os._exit(3)s when ``last_progress[0]``
+    (a time.monotonic() stamp the caller refreshes after each completed
+    check) goes stale for ``stall_s`` seconds."""
+
+    def _watch() -> None:
+        while True:
+            time.sleep(10)
+            if time.monotonic() - last_progress[0] > stall_s:
+                print(
+                    f"{name}_STALL: no check completed in {stall_s:.0f}s; "
+                    "exiting (incremental artifact keeps earlier checks)",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                os._exit(3)
+
+    threading.Thread(target=_watch, daemon=True).start()

@@ -33,12 +33,31 @@ BUDGET_S = float(os.environ.get("PT_CONV_BUDGET_S", "900"))
 _T0 = time.monotonic()
 ART = os.path.join(_REPO, "CONVERGENCE_r04.json")
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _stall_watchdog  # noqa: E402
+
+if "--cpu-mesh" in sys.argv:
+    # software-only fallback: no tunnel to stall, and cold compiles + long
+    # train loops on 8 virtual CPU devices can legitimately exceed any
+    # tunnel-sized stall budget
+    _PROGRESS = [time.monotonic()]
+else:
+    # armed BEFORE the jax import in main(): backend init can hang too
+    _PROGRESS = _stall_watchdog.install("CONVERGENCE", "PT_CONV_STALL_S", 360)
+
+
+def _tick():
+    """Refresh the stall stamp at per-step syncs inside the training loops —
+    steps make progress between artifact writes."""
+    _PROGRESS[0] = time.monotonic()
+
 
 def _left():
     return BUDGET_S - (time.monotonic() - _T0)
 
 
 def _write(out):
+    _tick()
     out["elapsed_s"] = round(time.monotonic() - _T0, 1)
     with open(ART, "w") as f:
         f.write(json.dumps(out) + "\n")
@@ -128,6 +147,7 @@ def main() -> int:
         v, o = res.variables, res.opt_state
         if s % 25 == 0:
             curve.append([s, round(float(jax.device_get(res.loss)), 4)])
+            _tick()
         if s % eval_every == 0 or s == max_steps:
             acc = test_acc(v)
             accs.append([s, round(acc, 4)])
@@ -184,6 +204,7 @@ def main() -> int:
             rv, ro = res.variables, res.opt_state
             if s % 10 == 0 or s == 1:
                 rcurve.append([s, round(float(jax.device_get(res.loss)), 4)])
+                _tick()
             if _left() < 30:
                 aborted = "budget"
                 break
@@ -232,6 +253,7 @@ def main() -> int:
                 lv, lo = res.variables, res.opt_state
                 if s % 20 == 0 or s == 1:
                     lcurve.append([s, round(float(jax.device_get(res.loss)), 4)])
+                    _tick()
                 if _left() < 30:
                     laborted = "budget"
                     break

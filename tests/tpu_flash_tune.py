@@ -19,6 +19,12 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+# armed BEFORE the jax import: backend init itself can hang on a dead tunnel
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _stall_watchdog  # noqa: E402
+
+_PROGRESS = _stall_watchdog.install("FLASH_TUNE", "PT_TUNE_STALL_S", 300)
+
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
@@ -45,6 +51,7 @@ def _left():
 
 
 def _write():
+    _PROGRESS[0] = time.monotonic()
     OUT["elapsed_s"] = round(time.monotonic() - _T0, 1)
     with open(ART, "w") as f:
         f.write(json.dumps(OUT) + "\n")

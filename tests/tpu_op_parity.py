@@ -21,6 +21,26 @@ sys.path.insert(0, _REPO)
 BUDGET_S = float(os.environ.get("PT_OPPARITY_BUDGET_S", "600"))
 _T0 = time.monotonic()
 
+_PROGRESS = [time.monotonic()]
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _stall_watchdog  # noqa: E402
+
+_stall_watchdog.start(
+    _PROGRESS, float(os.environ.get("PT_OPPARITY_STALL_S", "300")), "OP_PARITY"
+)
+
+
+def _write(out: dict) -> None:
+    """Incremental write per case: a mid-sweep tunnel drop keeps the cases
+    compared so far (same discipline as the other harvest artifacts)."""
+    _PROGRESS[0] = time.monotonic()
+    out["elapsed_s"] = round(time.monotonic() - _T0, 1)
+    try:
+        with open(os.path.join(_REPO, "OP_PARITY_TPU.json"), "w") as f:
+            f.write(json.dumps(out) + "\n")
+    except OSError:
+        pass
+
 
 def main() -> int:
     import jax
@@ -102,16 +122,15 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             out["n_fail"] += 1
             out["failures"].append(f"{name}: {type(e).__name__}: {str(e)[:160]}")
+        _write(out)
 
     out["ok"] = out["n_fail"] == 0 and out["n_pass"] > 0
-    out["elapsed_s"] = round(time.monotonic() - _T0, 1)
-    line = json.dumps(out)
-    print(line)
-    try:
-        with open(os.path.join(_REPO, "OP_PARITY_TPU.json"), "w") as f:
-            f.write(line + "\n")
-    except OSError:
-        pass
+    # terminal marker: with incremental writes, '"platform": "tpu"' appears
+    # after the FIRST case — the watcher's done-grep must key on this instead
+    # so a stalled partial sweep is retried, not marked done
+    out["complete"] = True
+    _write(out)
+    print(json.dumps(out))
     return 0
 
 

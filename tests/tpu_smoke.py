@@ -36,6 +36,15 @@ sys.path.insert(0, _REPO)
 BUDGET_S = float(os.environ.get("PT_SMOKE_BUDGET_S", "480"))
 _T0 = time.monotonic()
 
+# the tunnel can die MID-run (or at backend init) with ops blocking forever
+# (r4: probe OK, then the opening matmul hung until the watcher's outer 700s
+# timeout); a stalled check holds no new data, so exit early and let the
+# watcher re-probe sooner — armed before the first jax import on purpose
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _stall_watchdog  # noqa: E402
+
+_LAST_PROGRESS = _stall_watchdog.install("SMOKE", "PT_SMOKE_STALL_S", 300)
+
 
 def _left() -> float:
     return BUDGET_S - (time.monotonic() - _T0)
@@ -43,6 +52,7 @@ def _left() -> float:
 
 def _write(out: dict) -> None:
     """Incremental artifact write: every completed check survives a drop."""
+    _LAST_PROGRESS[0] = time.monotonic()
     out["elapsed_s"] = round(time.monotonic() - _T0, 1)
     try:
         with open(os.path.join(_REPO, "SMOKE_TPU.json"), "w") as f:

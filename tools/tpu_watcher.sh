@@ -96,7 +96,7 @@ harvest() {
     "TPU window: MNIST-to-97% + cifar resnet loss curve on chip" \
     CONVERGENCE_r04.json -- python tests/tpu_convergence.py || return 1
   # 5. op parity catalog on chip
-  run_step opparity 900 OP_PARITY_TPU.json '"platform": "tpu"' \
+  run_step opparity 900 OP_PARITY_TPU.json '"complete": true' \
     "TPU window: op catalog TPU-vs-CPU parity" \
     OP_PARITY_TPU.json -- python tests/tpu_op_parity.py || return 1
   return 0
