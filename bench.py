@@ -460,21 +460,15 @@ def _probe_default_backend(timeout: float = 150.0) -> bool:
     """Cheap liveness check: can the default (TPU) backend initialize and run
     a matmul at all? The round-1 failure mode was an axon tunnel that hangs
     indefinitely on backend init — don't burn the main budget on that."""
-    code = (
-        # the pass condition is a device_get ROUNDTRIP: on the axon tunnel
-        # block_until_ready can return before any data flows, green-lighting
-        # a bench child that then hangs at its first op (seen r4)
-        "import jax, jax.numpy as jnp; d = jax.devices(); "
-        "o = jax.jit(lambda a: a @ a)(jnp.ones((128, 128))); "
-        "v = float(jax.device_get(o.ravel()[0])); "
-        "print('PROBE_OK', d[0].platform, d[0].device_kind, v)"
-    )
+    # single-sourced roundtrip probe (tools/tpu_probe.py documents why a
+    # device_get roundtrip, not block_until_ready, is the pass condition)
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", code],
+            [sys.executable, os.path.join(_REPO, "tools", "tpu_probe.py")],
             timeout=timeout,
             capture_output=True,
             text=True,
+            cwd=_REPO,
             env=dict(os.environ),
         )
     except subprocess.TimeoutExpired:

@@ -56,6 +56,15 @@ from paddle_tpu.trainer import (
     EndStepEvent,
     Trainer,
 )
+from paddle_tpu import concurrency
+from paddle_tpu.concurrency import (
+    Select,
+    channel_close,
+    channel_recv,
+    channel_send,
+    go,
+    make_channel,
+)
 from paddle_tpu import nets
 from paddle_tpu import tensor
 from paddle_tpu.tensor import create_lod_tensor, create_random_int_lodtensor
@@ -72,6 +81,13 @@ TPUPlace = config.TPUPlace
 
 __all__ = [
     "__version__",
+    "concurrency",
+    "Select",
+    "make_channel",
+    "channel_send",
+    "channel_recv",
+    "channel_close",
+    "go",
     "config",
     "enforce",
     "dtypes",

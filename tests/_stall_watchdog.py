@@ -24,11 +24,11 @@ def install(name: str, env_var: str, default_s: float) -> list:
     """Arm the watchdog (stall budget from ``env_var``) and return the
     progress stamp the caller must refresh after each completed check."""
     progress = [time.monotonic()]
-    start(progress, float(os.environ.get(env_var, str(default_s))), name)
+    _start(progress, float(os.environ.get(env_var, str(default_s))), name)
     return progress
 
 
-def start(last_progress: list, stall_s: float, name: str) -> None:
+def _start(last_progress: list, stall_s: float, name: str) -> None:
     """Arm a daemon thread that os._exit(3)s when ``last_progress[0]``
     (a time.monotonic() stamp the caller refreshes after each completed
     check) goes stale for ``stall_s`` seconds."""

@@ -1,0 +1,276 @@
+"""CSP channels / select / goroutines — host-side concurrency parity.
+
+Reference semantics under test: Go-style channels in
+``paddle/fluid/framework/channel.h:25-130`` via the
+``python/paddle/fluid/concurrency.py`` API (make_channel/channel_send/
+channel_recv/channel_close/Select), re-designed host-side (threads around
+the device, not ops inside the graph).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import concurrency as cc
+
+
+def test_buffered_fifo_send_recv():
+    ch = cc.make_channel(capacity=4)
+    for i in range(4):
+        cc.channel_send(ch, i)
+    got = [cc.channel_recv(ch) for _ in range(4)]
+    assert got == [(0, True), (1, True), (2, True), (3, True)]
+
+
+def test_buffered_send_blocks_when_full_until_recv():
+    ch = cc.Channel(capacity=1)
+    ch.send("a")
+    state = {}
+
+    def sender():
+        t0 = time.monotonic()
+        ch.send("b")  # must block until the consumer pops "a"
+        state["sent_after"] = time.monotonic() - t0
+
+    t = cc.go(sender)
+    time.sleep(0.15)
+    assert "sent_after" not in state  # still parked
+    assert ch.recv() == ("a", True)
+    t.join(timeout=5)
+    assert state["sent_after"] >= 0.1
+    assert ch.recv() == ("b", True)
+
+
+def test_unbuffered_rendezvous():
+    ch = cc.Channel(capacity=0)
+    order = []
+
+    def sender():
+        ch.send(42)
+        order.append("send_done")
+
+    t = cc.go(sender)
+    time.sleep(0.1)
+    assert order == []  # sender blocked: nobody has received
+    assert ch.recv() == (42, True)
+    t.join(timeout=5)
+    assert order == ["send_done"]
+
+
+def test_recv_blocks_until_send():
+    ch = cc.Channel(capacity=0)
+    out = []
+    t = cc.go(lambda: out.append(ch.recv()))
+    time.sleep(0.05)
+    assert out == []
+    ch.send("x")
+    t.join(timeout=5)
+    assert out == [("x", True)]
+
+
+def test_close_semantics_match_go():
+    ch = cc.Channel(capacity=2)
+    ch.send(1)
+    ch.close()
+    # drain survives the close; then (None, False); send raises
+    assert ch.recv() == (1, True)
+    assert ch.recv() == (None, False)
+    assert ch.recv() == (None, False)  # stays closed
+    with pytest.raises(cc.ChannelClosedError):
+        ch.send(2)
+    ch.close()  # idempotent
+
+
+def test_close_wakes_parked_sender():
+    ch = cc.Channel(capacity=0)
+    errs = []
+
+    def sender():
+        try:
+            ch.send("never")
+        except cc.ChannelClosedError as e:
+            errs.append(e)
+
+    t = cc.go(sender)
+    time.sleep(0.05)
+    ch.close()
+    t.join(timeout=5)
+    assert len(errs) == 1
+
+
+def test_send_recv_timeouts():
+    ch = cc.Channel(capacity=0)
+    with pytest.raises(TimeoutError):
+        ch.send(1, timeout=0.05)
+    with pytest.raises(TimeoutError):
+        ch.recv(timeout=0.05)
+
+
+def test_channel_iteration_drains_until_close():
+    ch = cc.Channel(capacity=8)
+    for i in range(5):
+        ch.send(i)
+    ch.close()
+    assert list(ch) == [0, 1, 2, 3, 4]
+
+
+def test_many_producers_many_consumers():
+    ch = cc.Channel(capacity=3)
+    n_prod, per = 8, 50
+    results = []
+    res_lock = threading.Lock()
+
+    def producer(pid):
+        for i in range(per):
+            ch.send(pid * per + i)
+
+    def consumer():
+        while True:
+            v, ok = ch.recv()
+            if not ok:
+                return
+            with res_lock:
+                results.append(v)
+
+    prods = [cc.go(producer, p) for p in range(n_prod)]
+    cons = [cc.go(consumer) for _ in range(4)]
+    for t in prods:
+        t.join(timeout=20)
+    ch.close()
+    for t in cons:
+        t.join(timeout=20)
+    assert sorted(results) == list(range(n_prod * per))
+
+
+def test_select_picks_ready_recv():
+    a, b = cc.Channel(capacity=1), cc.Channel(capacity=1)
+    b.send("from_b")
+    hits = []
+    s = cc.Select()
+    s.recv(a, lambda v, ok: hits.append(("a", v, ok)))
+    s.recv(b, lambda v, ok: hits.append(("b", v, ok)))
+    s.run(timeout=2)
+    assert hits == [("b", "from_b", True)]
+
+
+def test_select_default_when_nothing_ready():
+    a = cc.Channel(capacity=1)
+    hits = []
+    with cc.Select() as s:
+        s.recv(a, lambda v, ok: hits.append("recv"))
+        s.default(lambda: hits.append("default"))
+    assert hits == ["default"]
+
+
+def test_select_send_case_fires_when_space():
+    ch = cc.Channel(capacity=1)
+    fired = []
+    s = cc.Select().send(ch, 99, lambda: fired.append(True))
+    s.run(timeout=2)
+    assert fired == [True]
+    assert ch.recv() == (99, True)
+
+
+def test_select_blocks_then_fires():
+    ch = cc.Channel(capacity=0)
+    hits = []
+
+    def late_sender():
+        time.sleep(0.1)
+        ch.send("late")
+
+    cc.go(late_sender)
+    cc.Select().recv(ch, lambda v, ok: hits.append((v, ok))).run(timeout=5)
+    assert hits == [("late", True)]
+
+
+def test_select_recv_on_closed_channel_fires_not_ok():
+    ch = cc.Channel(capacity=0)
+    ch.close()
+    hits = []
+    cc.Select().recv(ch, lambda v, ok: hits.append((v, ok))).run(timeout=2)
+    assert hits == [(None, False)]
+
+
+def test_select_vs_select_rendezvous_unbuffered():
+    """Two Selects facing each other across an unbuffered channel must
+    complete the handoff (each wait round parks in one case, making it
+    visible to the counterpart) — pure polling would livelock here."""
+    ch = cc.Channel(capacity=0)
+    got = []
+
+    def receiver():
+        cc.Select().recv(ch, lambda v, ok: got.append((v, ok))).run(timeout=10)
+
+    t = cc.go(receiver)
+    cc.Select().send(ch, "handoff").run(timeout=10)
+    t.join(timeout=10)
+    assert got == [("handoff", True)]
+
+
+def test_select_timeout():
+    ch = cc.Channel(capacity=0)
+    with pytest.raises(TimeoutError):
+        cc.Select().recv(ch).run(timeout=0.1)
+
+
+def test_go_ping_pong():
+    ping, pong = cc.Channel(0), cc.Channel(0)
+
+    def ponger():
+        while True:
+            v, ok = ping.recv()
+            if not ok:
+                return
+            pong.send(v + 1)
+
+    cc.go(ponger)
+    vals = []
+    for i in range(5):
+        ping.send(i)
+        vals.append(pong.recv()[0])
+    ping.close()
+    assert vals == [1, 2, 3, 4, 5]
+
+
+def test_from_reader_as_reader_pipeline():
+    """Goroutine producer -> channel -> reader combinators: the CSP glue to
+    the input pipeline (host-side double buffering like the reference's
+    buffered_reader.cc)."""
+    from paddle_tpu import reader
+
+    def source():
+        for i in range(10):
+            yield (np.full((4,), i, np.float32), i)
+
+    ch = cc.from_reader(source, capacity=2)
+    batches = list(reader.stack_batch(cc.as_reader(ch), 5)())
+    assert len(batches) == 2
+    assert batches[0][1].tolist() == [0, 1, 2, 3, 4]
+    assert ch.error is None
+
+
+def test_from_reader_records_producer_error():
+    def bad_source():
+        yield 1
+        raise ValueError("boom")
+
+    ch = cc.from_reader(bad_source, capacity=4)
+    assert list(ch) == [1]
+    assert isinstance(ch.error, ValueError)
+
+
+def test_from_reader_consumer_closes_early():
+    produced = []
+
+    def source():
+        for i in range(1000):
+            produced.append(i)
+            yield i
+
+    ch = cc.from_reader(source, capacity=2)
+    assert ch.recv() == (0, True)
+    ch.close()
+    time.sleep(0.2)  # give the pump a beat to notice and exit
+    assert len(produced) < 1000  # producer stopped early, not exhausted

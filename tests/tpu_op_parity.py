@@ -21,13 +21,10 @@ sys.path.insert(0, _REPO)
 BUDGET_S = float(os.environ.get("PT_OPPARITY_BUDGET_S", "600"))
 _T0 = time.monotonic()
 
-_PROGRESS = [time.monotonic()]
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import _stall_watchdog  # noqa: E402
 
-_stall_watchdog.start(
-    _PROGRESS, float(os.environ.get("PT_OPPARITY_STALL_S", "300")), "OP_PARITY"
-)
+_PROGRESS = _stall_watchdog.install("OP_PARITY", "PT_OPPARITY_STALL_S", 300)
 
 
 def _write(out: dict) -> None:

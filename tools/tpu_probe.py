@@ -1,0 +1,21 @@
+"""Single-sourced TPU liveness probe: exits 0 iff the default backend is a
+real chip AND a compiled matmul completes a device_get ROUNDTRIP.
+
+block_until_ready can return before any data flows on the axon tunnel
+(observed r3/r4: it inflated timings 8x and green-lit harvests that then
+hung at their first op), so a roundtrip is the only trustworthy pass
+condition. Shared by bench.py and tools/tpu_watcher.sh — refine the probe
+HERE, in one place.
+"""
+import sys
+
+import jax
+import jax.numpy as jnp
+
+d = jax.devices()
+if d[0].platform == "cpu":
+    print(f"PROBE_CPU_ONLY {d}", flush=True)
+    sys.exit(1)
+o = jax.jit(lambda a: a @ a)(jnp.ones((128, 128)))
+v = float(jax.device_get(o.ravel()[0]))
+print("PROBE_OK", d[0].platform, d[0].device_kind, "roundtrip", v, flush=True)

@@ -14,19 +14,9 @@ export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
 log() { echo "$(date -u +%FT%TZ) $*" >> "$LOG"; }
 
 probe() {
-  # device_get (NOT block_until_ready) is the pass condition: on the axon
-  # tunnel block_until_ready can return before any data flows, so a probe
-  # without a real device->host roundtrip green-lights a harvest that then
-  # hangs at its first op for the full step timeout (seen r4: probe OK,
-  # smoke stuck in the opening matmul until killed).
-  timeout 150 python - >> "$LOG" 2>&1 <<'EOF'
-import jax, jax.numpy as jnp
-d = jax.devices()
-assert d[0].platform != "cpu", d
-o = jax.jit(lambda a: a @ a)(jnp.ones((128, 128)))
-v = float(jax.device_get(o.ravel()[0]))
-print("PROBE_OK", d[0].device_kind, "roundtrip", v, flush=True)
-EOF
+  # single-sourced roundtrip probe — see tools/tpu_probe.py for why a
+  # device_get roundtrip (not block_until_ready) is the pass condition
+  timeout 150 python tools/tpu_probe.py >> "$LOG" 2>&1
 }
 
 commit_paths() {  # $1 = message; rest = paths. Only commits those paths.
