@@ -17,25 +17,161 @@
 #include <cstdlib>
 #include <cstring>
 #include <limits>
+#include <memory>
 #include <thread>
+
+#if defined(__x86_64__) || defined(__i386__)
+#define PT_NATIVE_X86 1
+#include <immintrin.h>
+#endif
 
 namespace ptnative {
 
 // ---------------------------------------------------------------- helpers
 
-// Vectorizable dot product: 16 independent accumulators break the serial
-// float-add dependency chain so the compiler can map the reduction onto
-// SIMD lanes without -ffast-math (the scalar form runs ~1.6 GFLOP/s; this
-// form is bound by FMA throughput instead).
-static inline float dotf(const float* a, const float* b, int64_t n) {
-  float acc[16] = {0};
+// ---- register-blocked GEMM microkernel with runtime ISA dispatch --------
+//
+// out tile [mr<=6][8] = A rows (stride lda, K-contiguous) x packed panel
+// Bp [K][8]. The packed layout turns each k-step into one 8-wide load plus
+// mr broadcast-multiply-accumulates with every accumulator held in a
+// register — the outer-product microkernel form (the previous inner-product
+// dot streamed both operands and burned issue slots on horizontal adds).
+// The AVX2+FMA variant is compiled per-function (gcc target attribute) and
+// picked at runtime via __builtin_cpu_supports, so the .so keeps the
+// deployment-safe x86-64-v2 baseline (see Makefile MARCH) while using FMA
+// silicon when the host has it.
+
+constexpr int64_t kPanelN = 8;  // packed panel width (output channels/cols)
+constexpr int kPanelMR = 6;     // row tile height (register-blocked)
+
+// Pack panel ``p`` of a rows-layout source [N][K] (K-contiguous rows) into
+// dst [K][8]; short tail panels are zero-padded. Per-panel so callers can
+// parallelize the pack itself.
+static void pack_panel8_rows(const float* src, int64_t N, int64_t K,
+                             int64_t p, float* dst) {
+  for (int64_t k = 0; k < K; ++k) {
+    float* dk = dst + k * kPanelN;
+    for (int64_t j = 0; j < kPanelN; ++j) {
+      const int64_t n = p * kPanelN + j;
+      dk[j] = n < N ? src[n * K + k] : 0.0f;
+    }
+  }
+}
+
+// Pack a column-major source [K][N] (N-contiguous, e.g. HWIO conv filters
+// flattened to [K, CO]) into the same panel layout — a strided copy, no
+// transpose pass needed.
+static void pack_panels8_cols(const float* src, int64_t K, int64_t N,
+                              float* dst) {
+  const int64_t panels = (N + kPanelN - 1) / kPanelN;
+  for (int64_t p = 0; p < panels; ++p) {
+    float* d = dst + p * K * kPanelN;
+    const int64_t n0 = p * kPanelN;
+    const int64_t w = std::min<int64_t>(kPanelN, N - n0);
+    for (int64_t k = 0; k < K; ++k) {
+      const float* s = src + k * N + n0;
+      float* dk = d + k * kPanelN;
+      for (int64_t j = 0; j < w; ++j) dk[j] = s[j];
+      for (int64_t j = w; j < kPanelN; ++j) dk[j] = 0.0f;
+    }
+  }
+}
+
+template <int MR>
+static void gemm_tile_scalar(const float* A, int64_t lda, const float* Bp,
+                             int64_t K, float* out) {
+  float acc[MR][kPanelN] = {};
+  for (int64_t k = 0; k < K; ++k) {
+    const float* b = Bp + k * kPanelN;
+    for (int m = 0; m < MR; ++m) {
+      const float a = A[m * lda + k];
+      for (int j = 0; j < kPanelN; ++j) acc[m][j] += a * b[j];
+    }
+  }
+  std::memcpy(out, acc, sizeof(acc));
+}
+
+#ifdef PT_NATIVE_X86
+template <int MR>
+__attribute__((target("avx2,fma"))) static void gemm_tile_avx2(
+    const float* A, int64_t lda, const float* Bp, int64_t K, float* out) {
+  // two accumulator banks break the per-acc FMA dependency chain (2-cycle
+  // issue vs 4-5 cycle latency); 2*MR + 2 <= 14 ymm registers at MR=6
+  __m256 acc0[MR], acc1[MR];
+  for (int m = 0; m < MR; ++m) {
+    acc0[m] = _mm256_setzero_ps();
+    acc1[m] = _mm256_setzero_ps();
+  }
   int64_t k = 0;
-  for (; k + 16 <= n; k += 16)
-    for (int j = 0; j < 16; ++j) acc[j] += a[k + j] * b[k + j];
-  float total = 0.0f;
-  for (int j = 0; j < 16; ++j) total += acc[j];
-  for (; k < n; ++k) total += a[k] * b[k];
-  return total;
+  for (; k + 2 <= K; k += 2) {
+    const __m256 b0 = _mm256_loadu_ps(Bp + k * kPanelN);
+    const __m256 b1 = _mm256_loadu_ps(Bp + (k + 1) * kPanelN);
+    for (int m = 0; m < MR; ++m) {
+      acc0[m] = _mm256_fmadd_ps(_mm256_set1_ps(A[m * lda + k]), b0, acc0[m]);
+      acc1[m] =
+          _mm256_fmadd_ps(_mm256_set1_ps(A[m * lda + k + 1]), b1, acc1[m]);
+    }
+  }
+  for (; k < K; ++k) {
+    const __m256 b = _mm256_loadu_ps(Bp + k * kPanelN);
+    for (int m = 0; m < MR; ++m)
+      acc0[m] = _mm256_fmadd_ps(_mm256_set1_ps(A[m * lda + k]), b, acc0[m]);
+  }
+  for (int m = 0; m < MR; ++m)
+    _mm256_storeu_ps(out + m * kPanelN, _mm256_add_ps(acc0[m], acc1[m]));
+}
+#endif
+
+using GemmTileFn = void (*)(const float*, int64_t, const float*, int64_t,
+                            float*);
+
+template <int MR>
+static GemmTileFn pick_tile() {
+#ifdef PT_NATIVE_X86
+  if (__builtin_cpu_supports("avx2") && __builtin_cpu_supports("fma"))
+    return gemm_tile_avx2<MR>;
+#endif
+  return gemm_tile_scalar<MR>;
+}
+
+static GemmTileFn tile_fn(int mr) {
+  static const GemmTileFn fns[kPanelMR + 1] = {
+      nullptr,        pick_tile<1>(), pick_tile<2>(), pick_tile<3>(),
+      pick_tile<4>(), pick_tile<5>(), pick_tile<6>()};
+  return fns[mr];
+}
+
+// C rows [m0, m1), columns [n0, n0 + w) (stride ldc) = A rows (stride lda)
+// x ONE packed panel [K][8] with w valid columns. The shared inner loop of
+// gemm_packed and dot_general; the full-height kernel pointer is hoisted
+// out of the tile loop (the static-init guard in tile_fn is not free on
+// the hot path).
+static void gemm_panel(const float* A, int64_t lda, const float* panel,
+                       int64_t K, int64_t w, float* C, int64_t ldc,
+                       int64_t n0, int64_t m0, int64_t m1) {
+  alignas(32) float tile[kPanelMR * kPanelN];
+  const GemmTileFn full = tile_fn(kPanelMR);
+  for (int64_t m = m0; m < m1; m += kPanelMR) {
+    const int mr = static_cast<int>(std::min<int64_t>(kPanelMR, m1 - m));
+    (mr == kPanelMR ? full : tile_fn(mr))(A + m * lda, lda, panel, K, tile);
+    for (int r = 0; r < mr; ++r)
+      std::memcpy(C + (m + r) * ldc + n0, tile + r * kPanelN,
+                  sizeof(float) * w);
+  }
+}
+
+// C rows [m0, m1) (stride ldc) = A rows (stride lda) x packed panels
+// [panels][K][8] covering N columns. Panel-outer loop order: one panel
+// (K*8 floats) stays cache-hot across all the row tiles it feeds.
+static void gemm_packed(const float* A, int64_t lda, const float* Bp,
+                        int64_t K, int64_t N, float* C, int64_t ldc,
+                        int64_t m0, int64_t m1) {
+  const int64_t panels = (N + kPanelN - 1) / kPanelN;
+  for (int64_t p = 0; p < panels; ++p) {
+    const int64_t n0 = p * kPanelN;
+    gemm_panel(A, lda, Bp + p * K * kPanelN, K,
+               std::min<int64_t>(kPanelN, N - n0), C, ldc, n0, m0, m1);
+  }
 }
 
 // Static-partition parallel_for over [0, n): the serving-throughput analogue
@@ -271,26 +407,35 @@ NDArray dot_general(const NDArray& lhs, const NDArray& rhs,
   out.data.assign(static_cast<size_t>(std::max<int64_t>(out.numel(), 1)), 0.0f);
 
   // R viewed as [B, N, K]; compute out[b, m, n] = sum_k L[b,m,k] * R[b,n,k].
-  // Both operands are K-contiguous after arrange(), so the inner dot
-  // auto-vectorizes. Rows are threaded across B*M and processed in tiles of
-  // MT: each streamed R row is reused for all MT L rows (L1-resident between
-  // the dots), cutting R's DRAM traffic MT-fold vs the row-at-a-time loop —
-  // the memory-bound regime of big-N fc layers.
+  // R is packed into 8-wide panels and the register-blocked microkernel
+  // (gemm_tile_*) does the FLOPs. Work splits across (b, panel, m-chunk)
+  // tasks: each loaded panel (K*8 floats, cache-resident) feeds up to
+  // kMChunk/kPanelMR row tiles before the next panel streams in.
   const float* Ld = L.data.data();
   const float* Rd = R.data.data();
   float* Od = out.data.data();
-  constexpr int64_t MT = 8;
-  parallel_for(B * M, 8, [&](int64_t lo, int64_t hi) {
-    for (int64_t t0 = lo; t0 < hi;) {
-      const int64_t b = t0 / M;
-      const int64_t t1 = std::min(std::min(hi, t0 + MT), (b + 1) * M);
-      const float* Rp = Rd + b * N * K;
-      for (int64_t n = 0; n < N; ++n) {
-        const float* rrow = Rp + n * K;
-        for (int64_t bm = t0; bm < t1; ++bm)
-          Od[bm * N + n] = dotf(Ld + bm * K, rrow, K);
-      }
-      t0 = t1;
+  const int64_t panels = (N + kPanelN - 1) / kPanelN;
+  // uninitialized on purpose: every element is written by the pack (value-
+  // init would memset a buffer the size of R first — a wasted DRAM sweep)
+  std::unique_ptr<float[]> Rp(new float[static_cast<size_t>(
+      std::max<int64_t>(B * panels * K * kPanelN, 1))]);
+  parallel_for(B * panels, 1, [&](int64_t lo, int64_t hi) {
+    for (int64_t t = lo; t < hi; ++t) {
+      const int64_t b = t / panels, p = t % panels;
+      pack_panel8_rows(Rd + b * N * K, N, K, p, Rp.get() + t * K * kPanelN);
+    }
+  });
+  constexpr int64_t kMChunk = 256;
+  const int64_t mchunks = (M + kMChunk - 1) / kMChunk;
+  parallel_for(B * panels * mchunks, 1, [&](int64_t lo, int64_t hi) {
+    for (int64_t t = lo; t < hi; ++t) {
+      const int64_t mc = t % mchunks;
+      const int64_t p = (t / mchunks) % panels;
+      const int64_t b = t / (mchunks * panels);
+      const int64_t n0 = p * kPanelN;
+      gemm_panel(Ld + b * M * K, K, Rp.get() + (b * panels + p) * K * kPanelN,
+                 K, std::min<int64_t>(kPanelN, N - n0), Od + b * M * N, N, n0,
+                 mc * kMChunk, std::min<int64_t>(M, (mc + 1) * kMChunk));
     }
   });
   return out;
@@ -315,15 +460,17 @@ NDArray conv2d_nhwc(const NDArray& x, const NDArray& w,
     // per-thread row range, each multiplied against the K-contiguous
     // transposed filter panel [CO, KH*KW*CI].
     const int64_t K = KH * KW * CI;
-    std::vector<float> wt(static_cast<size_t>(CO * K));
-    for (int64_t k = 0; k < K; ++k)
-      for (int64_t oc = 0; oc < CO; ++oc) wt[oc * K + k] = w.data[k * CO + oc];
+    // filters [K, CO] packed once into 8-wide panels for the microkernel
+    // (uninitialized alloc: the pack writes every element, padding included)
+    const int64_t panels = (CO + kPanelN - 1) / kPanelN;
+    std::unique_ptr<float[]> wp(new float[static_cast<size_t>(panels * K * kPanelN)]);
+    pack_panels8_cols(w.data.data(), K, CO, wp.get());
     const int64_t rows = Nb * OH * OW;
-    // Row tiles: the transposed filter panel wt [CO, K] streams from DRAM
-    // once per RT output positions instead of once per position (an RT-fold
-    // traffic cut — wt is ~9 MB for the late ResNet-50 stages and this loop
-    // is memory-bound); each wt row then stays L1-hot across the RT dots.
-    constexpr int64_t RT = 16;
+    // Row tiles: the packed filter panels (~K*CO floats, ~9 MB for the late
+    // ResNet-50 stages) stream from DRAM once per RT output positions
+    // instead of once per position; inside a tile gemm_packed keeps each
+    // panel cache-hot across all its row sub-tiles.
+    constexpr int64_t RT = 32;
     parallel_for(rows, 4, [&](int64_t lo, int64_t hi) {
       std::vector<float> patch(static_cast<size_t>(RT * K));
       for (int64_t r0 = lo; r0 < hi; r0 += RT) {
@@ -351,12 +498,8 @@ NDArray conv2d_nhwc(const NDArray& x, const NDArray& w,
             }
           }
         }
-        for (int64_t oc = 0; oc < CO; ++oc) {
-          const float* wrow = &wt[oc * K];
-          for (int64_t rr = 0; rr < nr; ++rr)
-            out.data[static_cast<size_t>(r0 + rr) * CO + oc] =
-                dotf(patch.data() + rr * K, wrow, K);
-        }
+        gemm_packed(patch.data(), K, wp.get(), K, CO,
+                    out.data.data() + r0 * CO, CO, 0, nr);
       }
     });
     return out;
