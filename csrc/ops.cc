@@ -31,23 +31,26 @@ namespace ptnative {
 
 // ---- register-blocked GEMM microkernel with runtime ISA dispatch --------
 //
-// out tile [mr<=6][8] = A rows (stride lda, K-contiguous) x packed panel
-// Bp [K][8]. The packed layout turns each k-step into one 8-wide load plus
-// mr broadcast-multiply-accumulates with every accumulator held in a
+// out tile [mr<=6][16] = A rows (stride lda, K-contiguous) x packed panel
+// Bp [K][16]. The packed layout turns each k-step into two 8-wide loads
+// plus mr broadcasts feeding 2*mr FMAs with every accumulator held in a
 // register — the outer-product microkernel form (the previous inner-product
 // dot streamed both operands and burned issue slots on horizontal adds).
+// The 16-wide tile makes the kernel FMA-throughput bound: at 8 wide the
+// 6 broadcasts + 1 panel load per k-step saturated the two load ports
+// before the FMA ports (measured ~27 GF/s vs the ~67 GF/s FMA ceiling).
 // The AVX2+FMA variant is compiled per-function (gcc target attribute) and
 // picked at runtime via __builtin_cpu_supports, so the .so keeps the
 // deployment-safe x86-64-v2 baseline (see Makefile MARCH) while using FMA
 // silicon when the host has it.
 
-constexpr int64_t kPanelN = 8;  // packed panel width (output channels/cols)
-constexpr int kPanelMR = 6;     // row tile height (register-blocked)
+constexpr int64_t kPanelN = 16;  // packed panel width (output channels/cols)
+constexpr int kPanelMR = 6;      // row tile height (register-blocked)
 
 // Pack panel ``p`` of a rows-layout source [N][K] (K-contiguous rows) into
-// dst [K][8]; short tail panels are zero-padded. Per-panel so callers can
-// parallelize the pack itself.
-static void pack_panel8_rows(const float* src, int64_t N, int64_t K,
+// dst [K][kPanelN]; short tail panels are zero-padded. Per-panel so callers
+// can parallelize the pack itself.
+static void pack_panel_rows(const float* src, int64_t N, int64_t K,
                              int64_t p, float* dst) {
   for (int64_t k = 0; k < K; ++k) {
     float* dk = dst + k * kPanelN;
@@ -61,7 +64,7 @@ static void pack_panel8_rows(const float* src, int64_t N, int64_t K,
 // Pack a column-major source [K][N] (N-contiguous, e.g. HWIO conv filters
 // flattened to [K, CO]) into the same panel layout — a strided copy, no
 // transpose pass needed.
-static void pack_panels8_cols(const float* src, int64_t K, int64_t N,
+static void pack_panels_cols(const float* src, int64_t K, int64_t N,
                               float* dst) {
   const int64_t panels = (N + kPanelN - 1) / kPanelN;
   for (int64_t p = 0; p < panels; ++p) {
@@ -93,32 +96,59 @@ static void gemm_tile_scalar(const float* A, int64_t lda, const float* Bp,
 
 #ifdef PT_NATIVE_X86
 template <int MR>
-__attribute__((target("avx2,fma"))) static void gemm_tile_avx2(
+__attribute__((target("avx512f"))) static void gemm_tile_avx512(
     const float* A, int64_t lda, const float* Bp, int64_t K, float* out) {
-  // two accumulator banks break the per-acc FMA dependency chain (2-cycle
-  // issue vs 4-5 cycle latency); 2*MR + 2 <= 14 ymm registers at MR=6
-  __m256 acc0[MR], acc1[MR];
+  // one zmm covers the whole 16-wide panel row: 2 accumulator banks (k
+  // unrolled by 2) keep 2*MR independent FMA chains in flight — 14 of 32
+  // zmm registers at MR=6.
+  __m512 acc0[MR], acc1[MR];
   for (int m = 0; m < MR; ++m) {
-    acc0[m] = _mm256_setzero_ps();
-    acc1[m] = _mm256_setzero_ps();
+    acc0[m] = _mm512_setzero_ps();
+    acc1[m] = _mm512_setzero_ps();
   }
   int64_t k = 0;
   for (; k + 2 <= K; k += 2) {
-    const __m256 b0 = _mm256_loadu_ps(Bp + k * kPanelN);
-    const __m256 b1 = _mm256_loadu_ps(Bp + (k + 1) * kPanelN);
+    const __m512 b0 = _mm512_loadu_ps(Bp + k * kPanelN);
+    const __m512 b1 = _mm512_loadu_ps(Bp + (k + 1) * kPanelN);
     for (int m = 0; m < MR; ++m) {
-      acc0[m] = _mm256_fmadd_ps(_mm256_set1_ps(A[m * lda + k]), b0, acc0[m]);
+      acc0[m] = _mm512_fmadd_ps(_mm512_set1_ps(A[m * lda + k]), b0, acc0[m]);
       acc1[m] =
-          _mm256_fmadd_ps(_mm256_set1_ps(A[m * lda + k + 1]), b1, acc1[m]);
+          _mm512_fmadd_ps(_mm512_set1_ps(A[m * lda + k + 1]), b1, acc1[m]);
     }
   }
   for (; k < K; ++k) {
-    const __m256 b = _mm256_loadu_ps(Bp + k * kPanelN);
+    const __m512 b = _mm512_loadu_ps(Bp + k * kPanelN);
     for (int m = 0; m < MR; ++m)
-      acc0[m] = _mm256_fmadd_ps(_mm256_set1_ps(A[m * lda + k]), b, acc0[m]);
+      acc0[m] = _mm512_fmadd_ps(_mm512_set1_ps(A[m * lda + k]), b, acc0[m]);
   }
   for (int m = 0; m < MR; ++m)
-    _mm256_storeu_ps(out + m * kPanelN, _mm256_add_ps(acc0[m], acc1[m]));
+    _mm512_storeu_ps(out + m * kPanelN, _mm512_add_ps(acc0[m], acc1[m]));
+}
+
+template <int MR>
+__attribute__((target("avx2,fma"))) static void gemm_tile_avx2(
+    const float* A, int64_t lda, const float* Bp, int64_t K, float* out) {
+  // low/high ymm halves of the 16-wide tile: 2*MR accumulators + 2 panel
+  // registers + 1 broadcast <= 15 ymm at MR=6. 12 independent FMA chains
+  // per k-step keep both FMA ports busy past the 4-5 cycle latency.
+  __m256 accL[MR], accH[MR];
+  for (int m = 0; m < MR; ++m) {
+    accL[m] = _mm256_setzero_ps();
+    accH[m] = _mm256_setzero_ps();
+  }
+  for (int64_t k = 0; k < K; ++k) {
+    const __m256 bL = _mm256_loadu_ps(Bp + k * kPanelN);
+    const __m256 bH = _mm256_loadu_ps(Bp + k * kPanelN + 8);
+    for (int m = 0; m < MR; ++m) {
+      const __m256 s = _mm256_set1_ps(A[m * lda + k]);
+      accL[m] = _mm256_fmadd_ps(s, bL, accL[m]);
+      accH[m] = _mm256_fmadd_ps(s, bH, accH[m]);
+    }
+  }
+  for (int m = 0; m < MR; ++m) {
+    _mm256_storeu_ps(out + m * kPanelN, accL[m]);
+    _mm256_storeu_ps(out + m * kPanelN + 8, accH[m]);
+  }
 }
 #endif
 
@@ -128,6 +158,11 @@ using GemmTileFn = void (*)(const float*, int64_t, const float*, int64_t,
 template <int MR>
 static GemmTileFn pick_tile() {
 #ifdef PT_NATIVE_X86
+  // PT_NATIVE_NO_AVX512 escape hatch: some parts downclock under 512-bit
+  // load; the AVX2 kernel is within ~15% of peak either way
+  if (__builtin_cpu_supports("avx512f") &&
+      std::getenv("PT_NATIVE_NO_AVX512") == nullptr)
+    return gemm_tile_avx512<MR>;
   if (__builtin_cpu_supports("avx2") && __builtin_cpu_supports("fma"))
     return gemm_tile_avx2<MR>;
 #endif
@@ -142,7 +177,7 @@ static GemmTileFn tile_fn(int mr) {
 }
 
 // C rows [m0, m1), columns [n0, n0 + w) (stride ldc) = A rows (stride lda)
-// x ONE packed panel [K][8] with w valid columns. The shared inner loop of
+// x ONE packed panel [K][kPanelN] with w valid columns. The shared inner loop of
 // gemm_packed and dot_general; the full-height kernel pointer is hoisted
 // out of the tile loop (the static-init guard in tile_fn is not free on
 // the hot path).
@@ -161,8 +196,8 @@ static void gemm_panel(const float* A, int64_t lda, const float* panel,
 }
 
 // C rows [m0, m1) (stride ldc) = A rows (stride lda) x packed panels
-// [panels][K][8] covering N columns. Panel-outer loop order: one panel
-// (K*8 floats) stays cache-hot across all the row tiles it feeds.
+// [panels][K][kPanelN] covering N columns. Panel-outer loop order: one panel
+// (K*kPanelN floats) stays cache-hot across all the row tiles it feeds.
 static void gemm_packed(const float* A, int64_t lda, const float* Bp,
                         int64_t K, int64_t N, float* C, int64_t ldc,
                         int64_t m0, int64_t m1) {
@@ -287,8 +322,14 @@ NDArray broadcast_in_dim(const NDArray& x, const std::vector<int64_t>& out_shape
   return out;
 }
 
-NDArray binary(const NDArray& a, const NDArray& b,
-               const std::function<float(float, float)>& f) {
+// Templated so the functor inlines into the element loops — the
+// std::function wrappers below pay an indirect call PER ELEMENT, which
+// dominated the profile for the full-activation mul/add/max (BN + relu)
+// chains. binary_op/unary_op (enum dispatch) route the hot primitives to
+// fully-inlined instantiations; the std::function overloads stay for
+// closures with captures (integer_pow) and external callers.
+template <class F>
+static NDArray binary_impl(const NDArray& a, const NDArray& b, F f) {
   // fast path: identical shapes
   if (a.shape == b.shape) {
     NDArray out(a.shape);
@@ -316,17 +357,31 @@ NDArray binary(const NDArray& a, const NDArray& b,
   NDArray out(out_shape);
   auto as = a.strides();
   auto bs = b.strides();
-  // allocation-free carried multi-index over broadcast strides
   const size_t nd = out_shape.size();
-  std::vector<int64_t> oc(nd, 0), astride(nd), bstride(nd);
-  for (size_t d = 0; d < nd; ++d) {
+  // split off the longest equal-shape suffix: within it both operands are
+  // contiguous, so the inner loop vectorizes (the BN-scale pattern
+  // [N,H,W,C]*[1,1,1,C] runs C-wide inner loops instead of per-element
+  // carried-index stepping)
+  size_t ond = nd;
+  int64_t inner = 1;
+  while (ond > 0 && a.shape[ond - 1] == b.shape[ond - 1]) {
+    inner *= out_shape[ond - 1];
+    --ond;
+  }
+  // allocation-free carried multi-index over the outer broadcast dims
+  std::vector<int64_t> oc(ond, 0), astride(ond), bstride(ond);
+  for (size_t d = 0; d < ond; ++d) {
     astride[d] = (a.shape[d] == 1) ? 0 : as[d];
     bstride[d] = (b.shape[d] == 1) ? 0 : bs[d];
   }
   int64_t ai = 0, bi = 0;
-  for (int64_t i = 0; i < out.numel(); ++i) {
-    out.data[i] = f(a.data[ai], b.data[bi]);
-    for (int64_t d = static_cast<int64_t>(nd) - 1; d >= 0; --d) {
+  const int64_t outer = out.numel() / std::max<int64_t>(inner, 1);
+  for (int64_t o = 0; o < outer; ++o) {
+    float* op = out.data.data() + o * inner;
+    const float* ap = a.data.data() + ai;
+    const float* bp = b.data.data() + bi;
+    for (int64_t i = 0; i < inner; ++i) op[i] = f(ap[i], bp[i]);
+    for (int64_t d = static_cast<int64_t>(ond) - 1; d >= 0; --d) {
       ai += astride[d];
       bi += bstride[d];
       if (++oc[d] < out_shape[d]) break;
@@ -338,10 +393,75 @@ NDArray binary(const NDArray& a, const NDArray& b,
   return out;
 }
 
+NDArray binary(const NDArray& a, const NDArray& b,
+               const std::function<float(float, float)>& f) {
+  return binary_impl(a, b, [&f](float x, float y) { return f(x, y); });
+}
+
 NDArray unary(const NDArray& x, const std::function<float(float)>& f) {
   NDArray out(x.shape);
   for (size_t i = 0; i < x.data.size(); ++i) out.data[i] = f(x.data[i]);
   return out;
+}
+
+NDArray binary_op(const NDArray& a, const NDArray& b, BinOp op) {
+  switch (op) {
+    case BinOp::Add: return binary_impl(a, b, [](float x, float y) { return x + y; });
+    case BinOp::Sub: return binary_impl(a, b, [](float x, float y) { return x - y; });
+    case BinOp::Mul: return binary_impl(a, b, [](float x, float y) { return x * y; });
+    case BinOp::Div: return binary_impl(a, b, [](float x, float y) { return x / y; });
+    case BinOp::Max: return binary_impl(a, b, [](float x, float y) { return x > y ? x : y; });
+    case BinOp::Min: return binary_impl(a, b, [](float x, float y) { return x < y ? x : y; });
+    case BinOp::Pow: return binary_impl(a, b, [](float x, float y) { return std::pow(x, y); });
+    case BinOp::Eq: return binary_impl(a, b, [](float x, float y) { return x == y ? 1.0f : 0.0f; });
+    case BinOp::Ne: return binary_impl(a, b, [](float x, float y) { return x != y ? 1.0f : 0.0f; });
+    case BinOp::Lt: return binary_impl(a, b, [](float x, float y) { return x < y ? 1.0f : 0.0f; });
+    case BinOp::Gt: return binary_impl(a, b, [](float x, float y) { return x > y ? 1.0f : 0.0f; });
+    case BinOp::Ge: return binary_impl(a, b, [](float x, float y) { return x >= y ? 1.0f : 0.0f; });
+    case BinOp::Le: return binary_impl(a, b, [](float x, float y) { return x <= y ? 1.0f : 0.0f; });
+    case BinOp::And: return binary_impl(a, b, [](float x, float y) { return (x != 0 && y != 0) ? 1.0f : 0.0f; });
+    case BinOp::Or: return binary_impl(a, b, [](float x, float y) { return (x != 0 || y != 0) ? 1.0f : 0.0f; });
+    case BinOp::Rem: return binary_impl(a, b, [](float x, float y) { return std::fmod(x, y); });
+    case BinOp::Atan2: return binary_impl(a, b, [](float x, float y) { return std::atan2(x, y); });
+  }
+  check(false, "unknown BinOp");
+  return NDArray();
+}
+
+template <class F>
+static NDArray unary_impl(const NDArray& x, F f) {
+  NDArray out(x.shape);
+  for (size_t i = 0; i < x.data.size(); ++i) out.data[i] = f(x.data[i]);
+  return out;
+}
+
+NDArray unary_op(const NDArray& x, UnOp op) {
+  switch (op) {
+    case UnOp::Exp: return unary_impl(x, [](float a) { return std::exp(a); });
+    case UnOp::Log: return unary_impl(x, [](float a) { return std::log(a); });
+    case UnOp::Neg: return unary_impl(x, [](float a) { return -a; });
+    case UnOp::Abs: return unary_impl(x, [](float a) { return std::fabs(a); });
+    case UnOp::Sign: return unary_impl(x, [](float a) { return a > 0 ? 1.0f : (a < 0 ? -1.0f : 0.0f); });
+    case UnOp::Floor: return unary_impl(x, [](float a) { return std::floor(a); });
+    case UnOp::Ceil: return unary_impl(x, [](float a) { return std::ceil(a); });
+    case UnOp::Rsqrt: return unary_impl(x, [](float a) { return 1.0f / std::sqrt(a); });
+    case UnOp::Sqrt: return unary_impl(x, [](float a) { return std::sqrt(a); });
+    case UnOp::Tanh: return unary_impl(x, [](float a) { return std::tanh(a); });
+    case UnOp::Logistic: return unary_impl(x, [](float a) { return 1.0f / (1.0f + std::exp(-a)); });
+    case UnOp::Sin: return unary_impl(x, [](float a) { return std::sin(a); });
+    case UnOp::Cos: return unary_impl(x, [](float a) { return std::cos(a); });
+    case UnOp::Erf: return unary_impl(x, [](float a) { return std::erf(a); });
+    case UnOp::RoundEven: return unary_impl(x, [](float a) { return std::nearbyint(a); });
+    case UnOp::RoundAway: return unary_impl(x, [](float a) { return std::round(a); });
+    case UnOp::Expm1: return unary_impl(x, [](float a) { return std::expm1(a); });
+    case UnOp::Log1p: return unary_impl(x, [](float a) { return std::log1p(a); });
+    case UnOp::Not: return unary_impl(x, [](float a) { return a != 0 ? 0.0f : 1.0f; });
+    case UnOp::IsFinite: return unary_impl(x, [](float a) { return std::isfinite(a) ? 1.0f : 0.0f; });
+    case UnOp::ToBf16: return unary_impl(x, f32_to_bf16_rn);
+    case UnOp::Trunc: return unary_impl(x, [](float a) { return std::trunc(a); });
+  }
+  check(false, "unknown UnOp");
+  return NDArray();
 }
 
 NDArray reduce(const NDArray& x, const std::vector<int64_t>& axes, float init,
@@ -373,30 +493,68 @@ NDArray reduce(const NDArray& x, const std::vector<int64_t>& axes, float init,
 
 // dot_general with arbitrary batch/contracting dims: permute both operands to
 // [batch..., free..., contract...] and run a blocked GEMM per batch.
+static std::vector<int64_t> dot_free_dims(const NDArray& x,
+                                          const std::vector<int64_t>& batch,
+                                          const std::vector<int64_t>& contract) {
+  std::vector<bool> used(x.shape.size(), false);
+  for (auto d : batch) used[d] = true;
+  for (auto d : contract) used[d] = true;
+  std::vector<int64_t> free_dims;
+  for (int d = 0; d < x.ndim(); ++d)
+    if (!used[d]) free_dims.push_back(d);
+  return free_dims;
+}
+
+// Move batch dims first, contract dims last; returns (transposed, free dims).
+static std::pair<NDArray, std::vector<int64_t>> dot_arrange(
+    const NDArray& x, const std::vector<int64_t>& batch,
+    const std::vector<int64_t>& contract) {
+  const std::vector<int64_t> free_dims = dot_free_dims(x, batch, contract);
+  std::vector<int64_t> perm(batch);
+  perm.insert(perm.end(), free_dims.begin(), free_dims.end());
+  perm.insert(perm.end(), contract.begin(), contract.end());
+  return std::make_pair(transpose(x, perm), free_dims);
+}
+
+WeightPack prepack_dot_rhs(const NDArray& rhs, const std::vector<int64_t>& rc,
+                           const std::vector<int64_t>& rb) {
+  auto [R, rfree] = dot_arrange(rhs, rb, rc);
+  int64_t B = 1;
+  for (auto d : rb) B *= rhs.shape[d];
+  int64_t K = 1;
+  for (auto d : rc) K *= rhs.shape[d];
+  const int64_t N = R.numel() / std::max<int64_t>(B * K, 1);
+  const int64_t panels = (N + kPanelN - 1) / kPanelN;
+  WeightPack pack;
+  // uninitialized on purpose: every element is written by the pack (value-
+  // init would memset a buffer the size of R first — a wasted DRAM sweep)
+  pack.data.reset(new float[static_cast<size_t>(
+      std::max<int64_t>(B * panels * K * kPanelN, 1))]);
+  const float* Rd = R.data.data();
+  float* Pd = pack.data.get();
+  parallel_for(B * panels, 1, [&](int64_t lo, int64_t hi) {
+    for (int64_t t = lo; t < hi; ++t) {
+      const int64_t b = t / panels, p = t % panels;
+      pack_panel_rows(Rd + b * N * K, N, K, p, Pd + t * K * kPanelN);
+    }
+  });
+  return pack;
+}
+
 NDArray dot_general(const NDArray& lhs, const NDArray& rhs,
                     const std::vector<int64_t>& lc, const std::vector<int64_t>& rc,
-                    const std::vector<int64_t>& lb, const std::vector<int64_t>& rb) {
-  auto arrange = [](const NDArray& x, const std::vector<int64_t>& batch,
-                    const std::vector<int64_t>& contract) {
-    std::vector<bool> used(x.shape.size(), false);
-    std::vector<int64_t> perm;
-    for (auto d : batch) { perm.push_back(d); used[d] = true; }
-    for (auto d : contract) used[d] = true;
-    std::vector<int64_t> free_dims;
-    for (int d = 0; d < x.ndim(); ++d)
-      if (!used[d]) { perm.push_back(d); free_dims.push_back(d); }
-    for (auto d : contract) perm.push_back(d);
-    return std::make_pair(transpose(x, perm), free_dims);
-  };
-  auto [L, lfree] = arrange(lhs, lb, lc);
-  auto [R, rfree] = arrange(rhs, rb, rc);
+                    const std::vector<int64_t>& lb, const std::vector<int64_t>& rb,
+                    const WeightPack* rhs_pack) {
+  auto [L, lfree] = dot_arrange(lhs, lb, lc);
+  const std::vector<int64_t> rfree = dot_free_dims(rhs, rb, rc);
 
   int64_t B = 1;
   for (auto d : lb) B *= lhs.shape[d];
   int64_t K = 1;
   for (auto d : lc) K *= lhs.shape[d];
-  int64_t M = L.numel() / (B * K);
-  int64_t N = R.numel() / (B * K);
+  int64_t M = L.numel() / std::max<int64_t>(B * K, 1);
+  int64_t N = 1;
+  for (auto d : rfree) N *= rhs.shape[d];
 
   std::vector<int64_t> out_shape;
   for (auto d : lb) out_shape.push_back(lhs.shape[d]);
@@ -406,25 +564,21 @@ NDArray dot_general(const NDArray& lhs, const NDArray& rhs,
   out.shape = out_shape.empty() ? std::vector<int64_t>{} : out_shape;
   out.data.assign(static_cast<size_t>(std::max<int64_t>(out.numel(), 1)), 0.0f);
 
-  // R viewed as [B, N, K]; compute out[b, m, n] = sum_k L[b,m,k] * R[b,n,k].
-  // R is packed into 8-wide panels and the register-blocked microkernel
-  // (gemm_tile_*) does the FLOPs. Work splits across (b, panel, m-chunk)
-  // tasks: each loaded panel (K*8 floats, cache-resident) feeds up to
+  // out[b, m, n] = sum_k L[b,m,k] * R[b,n,k], with R pre-arranged + packed
+  // into kPanelN-wide panels (rhs_pack when the caller cached it — constant
+  // serving weights — else packed here). The register-blocked microkernel
+  // (gemm_tile_*) does the FLOPs; work splits across (b, panel, m-chunk)
+  // tasks so each loaded panel (K*kPanelN floats, cache-resident) feeds up to
   // kMChunk/kPanelMR row tiles before the next panel streams in.
+  WeightPack local;
+  if (rhs_pack == nullptr) {
+    local = prepack_dot_rhs(rhs, rc, rb);
+    rhs_pack = &local;
+  }
   const float* Ld = L.data.data();
-  const float* Rd = R.data.data();
+  const float* Rp = rhs_pack->data.get();
   float* Od = out.data.data();
   const int64_t panels = (N + kPanelN - 1) / kPanelN;
-  // uninitialized on purpose: every element is written by the pack (value-
-  // init would memset a buffer the size of R first — a wasted DRAM sweep)
-  std::unique_ptr<float[]> Rp(new float[static_cast<size_t>(
-      std::max<int64_t>(B * panels * K * kPanelN, 1))]);
-  parallel_for(B * panels, 1, [&](int64_t lo, int64_t hi) {
-    for (int64_t t = lo; t < hi; ++t) {
-      const int64_t b = t / panels, p = t % panels;
-      pack_panel8_rows(Rd + b * N * K, N, K, p, Rp.get() + t * K * kPanelN);
-    }
-  });
   constexpr int64_t kMChunk = 256;
   const int64_t mchunks = (M + kMChunk - 1) / kMChunk;
   parallel_for(B * panels * mchunks, 1, [&](int64_t lo, int64_t hi) {
@@ -433,7 +587,7 @@ NDArray dot_general(const NDArray& lhs, const NDArray& rhs,
       const int64_t p = (t / mchunks) % panels;
       const int64_t b = t / (mchunks * panels);
       const int64_t n0 = p * kPanelN;
-      gemm_panel(Ld + b * M * K, K, Rp.get() + (b * panels + p) * K * kPanelN,
+      gemm_panel(Ld + b * M * K, K, Rp + (b * panels + p) * K * kPanelN,
                  K, std::min<int64_t>(kPanelN, N - n0), Od + b * M * N, N, n0,
                  mc * kMChunk, std::min<int64_t>(M, (mc + 1) * kMChunk));
     }
@@ -443,10 +597,24 @@ NDArray dot_general(const NDArray& lhs, const NDArray& rhs,
 
 // NHWC x HWIO -> NHWC convolution (im2col-free direct loop; groups for
 // depthwise). Matches lax.conv_general_dilated with dilations == 1.
+WeightPack prepack_conv_filter(const NDArray& w) {
+  // HWIO filters flattened to [K = KH*KW*CI, CO], packed into kPanelN-wide panels
+  const int64_t CO = w.shape[3];
+  const int64_t K = w.numel() / std::max<int64_t>(CO, 1);
+  const int64_t panels = (CO + kPanelN - 1) / kPanelN;
+  WeightPack pack;
+  pack.data.reset(new float[static_cast<size_t>(
+      std::max<int64_t>(panels * K * kPanelN, 1))]);
+  pack_panels_cols(w.data.data(), K, CO, pack.data.get());
+  return pack;
+}
+
 NDArray conv2d_nhwc(const NDArray& x, const NDArray& w,
                     const std::vector<int64_t>& strides,
                     const std::vector<int64_t>& pad_lo,
-                    const std::vector<int64_t>& pad_hi, int64_t groups) {
+                    const std::vector<int64_t>& pad_hi, int64_t groups,
+                    const WeightPack* w_pack, const NDArray* addend,
+                    bool relu) {
   int64_t Nb = x.shape[0], H = x.shape[1], W = x.shape[2], C = x.shape[3];
   int64_t KH = w.shape[0], KW = w.shape[1], CI = w.shape[2], CO = w.shape[3];
   check(CI * groups == C, "conv channel mismatch");
@@ -454,17 +622,29 @@ NDArray conv2d_nhwc(const NDArray& x, const NDArray& w,
   int64_t OW = (W + pad_lo[1] + pad_hi[1] - KW) / strides[1] + 1;
   int64_t co_per_g = CO / groups;
   NDArray out({Nb, OH, OW, CO});
+  // fused epilogue applies inside the tile loop only when the addend is
+  // elementwise-compatible; otherwise fall through to the unfused tail
+  const bool inline_epilogue =
+      groups == 1 &&
+      (addend == nullptr || addend->numel() == out.numel()) &&
+      (addend != nullptr || relu);
+  const bool tail_epilogue =
+      !inline_epilogue && (addend != nullptr || relu);
   if (groups == 1) {
     // im2col + GEMM (the reference's gemm-conv path,
     // operators/math/im2col.cc): patches [Nb*OH*OW, KH*KW*CI] are built
     // per-thread row range, each multiplied against the K-contiguous
     // transposed filter panel [CO, KH*KW*CI].
     const int64_t K = KH * KW * CI;
-    // filters [K, CO] packed once into 8-wide panels for the microkernel
-    // (uninitialized alloc: the pack writes every element, padding included)
-    const int64_t panels = (CO + kPanelN - 1) / kPanelN;
-    std::unique_ptr<float[]> wp(new float[static_cast<size_t>(panels * K * kPanelN)]);
-    pack_panels8_cols(w.data.data(), K, CO, wp.get());
+    // filters [K, CO] packed into kPanelN-wide panels for the microkernel —
+    // reused from w_pack when the caller cached it (constant serving
+    // filters; the predictor packs each conv's filter once at first run)
+    WeightPack local;
+    if (w_pack == nullptr) {
+      local = prepack_conv_filter(w);
+      w_pack = &local;
+    }
+    const float* wp = w_pack->data.get();
     const int64_t rows = Nb * OH * OW;
     // Row tiles: the packed filter panels (~K*CO floats, ~9 MB for the late
     // ResNet-50 stages) stream from DRAM once per RT output positions
@@ -498,10 +678,34 @@ NDArray conv2d_nhwc(const NDArray& x, const NDArray& w,
             }
           }
         }
-        gemm_packed(patch.data(), K, wp.get(), K, CO,
+        gemm_packed(patch.data(), K, wp, K, CO,
                     out.data.data() + r0 * CO, CO, 0, nr);
+        if (inline_epilogue) {
+          // residual-add + relu while the nr*CO output block is cache-hot
+          // (fuse-conv-epilogue pass) — saves full-tensor sweeps later
+          float* orow = out.data.data() + r0 * CO;
+          const float* ad =
+              addend ? addend->data.data() + r0 * CO : nullptr;
+          const int64_t cnt = nr * CO;
+          if (ad && relu) {
+            for (int64_t i = 0; i < cnt; ++i) {
+              const float v = orow[i] + ad[i];
+              orow[i] = v > 0.0f ? v : 0.0f;
+            }
+          } else if (ad) {
+            for (int64_t i = 0; i < cnt; ++i) orow[i] += ad[i];
+          } else {
+            for (int64_t i = 0; i < cnt; ++i)
+              orow[i] = orow[i] > 0.0f ? orow[i] : 0.0f;
+          }
+        }
       }
     });
+    if (tail_epilogue) {
+      if (addend) out = binary_op(out, *addend, BinOp::Add);
+      if (relu)
+        for (auto& v : out.data) v = v > 0.0f ? v : 0.0f;
+    }
     return out;
   }
   parallel_for(Nb * OH, 1, [&](int64_t lo, int64_t hi) {
@@ -528,6 +732,11 @@ NDArray conv2d_nhwc(const NDArray& x, const NDArray& w,
           }
     }
   });
+  if (tail_epilogue) {
+    if (addend) out = binary_op(out, *addend, BinOp::Add);
+    if (relu)
+      for (auto& v : out.data) v = v > 0.0f ? v : 0.0f;
+  }
   return out;
 }
 
@@ -588,6 +797,30 @@ NDArray pad_op(const NDArray& x, float value, const std::vector<int64_t>& lo,
     out.shape[d] = lo[d] + hi[d] + x.shape[d] + (x.shape[d] - 1) * interior[d];
   out.data.assign(static_cast<size_t>(out.numel()), value);
   auto os = out.strides();
+  const int nd = x.ndim();
+  bool plain = true;  // no interior dilation, no negative (trimming) pads
+  for (int d = 0; d < nd; ++d)
+    plain = plain && interior[d] == 0 && lo[d] >= 0 && hi[d] >= 0;
+  if (plain && nd > 0) {
+    // row-copy fast path: the innermost x-row is contiguous in both arrays
+    const int64_t row = x.shape[nd - 1];
+    const int64_t rows = x.numel() / std::max<int64_t>(row, 1);
+    std::vector<int64_t> xc(nd - 1, 0);
+    int64_t dst0 = 0;
+    for (int d = 0; d < nd; ++d) dst0 += lo[d] * os[d];
+    int64_t dst = dst0;
+    for (int64_t r = 0; r < rows; ++r) {
+      std::memcpy(out.data.data() + dst, x.data.data() + r * row,
+                  sizeof(float) * row);
+      for (int d = nd - 2; d >= 0; --d) {
+        dst += os[d];
+        if (++xc[d] < x.shape[d]) break;
+        dst -= os[d] * x.shape[d];
+        xc[d] = 0;
+      }
+    }
+    return out;
+  }
   for (int64_t i = 0; i < x.numel(); ++i) {
     auto xc = unravel(i, x.shape);
     int64_t dst = 0;

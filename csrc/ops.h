@@ -1,7 +1,9 @@
 // Primitive op declarations for the native interpreter (see ops.cc).
 #pragma once
 
+#include <cstdint>
 #include <functional>
+#include <memory>
 #include <vector>
 
 #include "ndarray.h"
@@ -19,15 +21,39 @@ NDArray broadcast_in_dim(const NDArray& x, const std::vector<int64_t>& out_shape
 NDArray binary(const NDArray& a, const NDArray& b,
                const std::function<float(float, float)>& f);
 NDArray unary(const NDArray& x, const std::function<float(float)>& f);
+// Enum-dispatched variants: the functor inlines into the element loop
+// (the std::function forms pay an indirect call per element — measurable
+// on full-activation elementwise chains). Use these for the hot path.
+enum class BinOp { Add, Sub, Mul, Div, Max, Min, Pow, Eq, Ne, Lt, Gt, Ge, Le, And, Or, Rem, Atan2 };
+enum class UnOp { Exp, Log, Neg, Abs, Sign, Floor, Ceil, Rsqrt, Sqrt, Tanh, Logistic, Sin, Cos, Erf, RoundEven, RoundAway, Expm1, Log1p, Not, IsFinite, ToBf16, Trunc };
+NDArray binary_op(const NDArray& a, const NDArray& b, BinOp op);
+NDArray unary_op(const NDArray& x, UnOp op);
 NDArray reduce(const NDArray& x, const std::vector<int64_t>& axes, float init,
                const std::function<float(float, float)>& f);
+// Weights packed once into the GEMM microkernel's kPanelN-wide panel layout.
+// For constant weights (serving) the predictor caches one per instruction
+// so the pack (and the rhs transpose) are paid at first run, not per call.
+struct WeightPack {
+  std::unique_ptr<float[]> data;
+};
+WeightPack prepack_dot_rhs(const NDArray& rhs, const std::vector<int64_t>& rc,
+                           const std::vector<int64_t>& rb);
+WeightPack prepack_conv_filter(const NDArray& w);
 NDArray dot_general(const NDArray& lhs, const NDArray& rhs,
                     const std::vector<int64_t>& lc, const std::vector<int64_t>& rc,
-                    const std::vector<int64_t>& lb, const std::vector<int64_t>& rb);
+                    const std::vector<int64_t>& lb, const std::vector<int64_t>& rb,
+                    const WeightPack* rhs_pack = nullptr);
+// ``addend``/``relu``: fused epilogue (out = max(conv + addend, 0)) from
+// the fuse-conv-epilogue program pass — applied inside the row-tile
+// scatter while the output tile is cache-hot. A shape-mismatched addend
+// (defensive; the pass only fuses same-shape residual adds) falls back to
+// an unfused elementwise pass over the result.
 NDArray conv2d_nhwc(const NDArray& x, const NDArray& w,
                     const std::vector<int64_t>& strides,
                     const std::vector<int64_t>& pad_lo,
-                    const std::vector<int64_t>& pad_hi, int64_t groups);
+                    const std::vector<int64_t>& pad_hi, int64_t groups,
+                    const WeightPack* w_pack = nullptr,
+                    const NDArray* addend = nullptr, bool relu = false);
 NDArray reduce_window_2d(const NDArray& x, const std::vector<int64_t>& window,
                          const std::vector<int64_t>& strides,
                          const std::vector<int64_t>& pad_lo,

@@ -195,62 +195,58 @@ static std::unique_ptr<Program> load_program(const std::string& dir) {
   return prog;
 }
 
-static NDArray run_instr(const Instr& ins, const Env& env) {
+static NDArray run_instr(const Instr& ins, const Env& env,
+                         const WeightPack* pack = nullptr) {
   auto in = [&](int i) -> const NDArray& { return env.at(ins.ins[i]); };
   auto attr = [&](const char* k) -> const std::vector<int64_t>& {
     return ins.attrs.at(k);
   };
   const std::string& p = ins.prim;
-  if (p == "add") return binary(in(0), in(1), [](float a, float b) { return a + b; });
-  if (p == "sub") return binary(in(0), in(1), [](float a, float b) { return a - b; });
-  if (p == "mul") return binary(in(0), in(1), [](float a, float b) { return a * b; });
-  if (p == "div") return binary(in(0), in(1), [](float a, float b) { return a / b; });
-  if (p == "max") return binary(in(0), in(1), [](float a, float b) { return a > b ? a : b; });
-  if (p == "min") return binary(in(0), in(1), [](float a, float b) { return a < b ? a : b; });
-  if (p == "pow") return binary(in(0), in(1), [](float a, float b) { return std::pow(a, b); });
-  if (p == "eq") return binary(in(0), in(1), [](float a, float b) { return a == b ? 1.0f : 0.0f; });
-  if (p == "lt") return binary(in(0), in(1), [](float a, float b) { return a < b ? 1.0f : 0.0f; });
-  if (p == "gt") return binary(in(0), in(1), [](float a, float b) { return a > b ? 1.0f : 0.0f; });
-  if (p == "ge") return binary(in(0), in(1), [](float a, float b) { return a >= b ? 1.0f : 0.0f; });
-  if (p == "le") return binary(in(0), in(1), [](float a, float b) { return a <= b ? 1.0f : 0.0f; });
-  if (p == "and") return binary(in(0), in(1), [](float a, float b) { return (a != 0 && b != 0) ? 1.0f : 0.0f; });
-  if (p == "or") return binary(in(0), in(1), [](float a, float b) { return (a != 0 || b != 0) ? 1.0f : 0.0f; });
-  if (p == "exp") return unary(in(0), [](float a) { return std::exp(a); });
-  if (p == "log") return unary(in(0), [](float a) { return std::log(a); });
-  if (p == "neg") return unary(in(0), [](float a) { return -a; });
-  if (p == "abs") return unary(in(0), [](float a) { return std::fabs(a); });
-  if (p == "sign") return unary(in(0), [](float a) { return a > 0 ? 1.0f : (a < 0 ? -1.0f : 0.0f); });
-  if (p == "floor") return unary(in(0), [](float a) { return std::floor(a); });
-  if (p == "rsqrt") return unary(in(0), [](float a) { return 1.0f / std::sqrt(a); });
-  if (p == "sqrt") return unary(in(0), [](float a) { return std::sqrt(a); });
-  if (p == "tanh") return unary(in(0), [](float a) { return std::tanh(a); });
-  if (p == "logistic") return unary(in(0), [](float a) { return 1.0f / (1.0f + std::exp(-a)); });
+  if (p == "add") return binary_op(in(0), in(1), BinOp::Add);
+  if (p == "sub") return binary_op(in(0), in(1), BinOp::Sub);
+  if (p == "mul") return binary_op(in(0), in(1), BinOp::Mul);
+  if (p == "div") return binary_op(in(0), in(1), BinOp::Div);
+  if (p == "max") return binary_op(in(0), in(1), BinOp::Max);
+  if (p == "min") return binary_op(in(0), in(1), BinOp::Min);
+  if (p == "pow") return binary_op(in(0), in(1), BinOp::Pow);
+  if (p == "eq") return binary_op(in(0), in(1), BinOp::Eq);
+  if (p == "lt") return binary_op(in(0), in(1), BinOp::Lt);
+  if (p == "gt") return binary_op(in(0), in(1), BinOp::Gt);
+  if (p == "ge") return binary_op(in(0), in(1), BinOp::Ge);
+  if (p == "le") return binary_op(in(0), in(1), BinOp::Le);
+  if (p == "and") return binary_op(in(0), in(1), BinOp::And);
+  if (p == "or") return binary_op(in(0), in(1), BinOp::Or);
+  if (p == "exp") return unary_op(in(0), UnOp::Exp);
+  if (p == "log") return unary_op(in(0), UnOp::Log);
+  if (p == "neg") return unary_op(in(0), UnOp::Neg);
+  if (p == "abs") return unary_op(in(0), UnOp::Abs);
+  if (p == "sign") return unary_op(in(0), UnOp::Sign);
+  if (p == "floor") return unary_op(in(0), UnOp::Floor);
+  if (p == "rsqrt") return unary_op(in(0), UnOp::Rsqrt);
+  if (p == "sqrt") return unary_op(in(0), UnOp::Sqrt);
+  if (p == "tanh") return unary_op(in(0), UnOp::Tanh);
+  if (p == "logistic") return unary_op(in(0), UnOp::Logistic);
   if (p == "integer_pow") {
     float e = static_cast<float>(attr("y")[0]);
     return unary(in(0), [e](float a) { return std::pow(a, e); });
   }
-  if (p == "sin") return unary(in(0), [](float a) { return std::sin(a); });
-  if (p == "cos") return unary(in(0), [](float a) { return std::cos(a); });
-  if (p == "erf") return unary(in(0), [](float a) { return std::erf(a); });
-  if (p == "ceil") return unary(in(0), [](float a) { return std::ceil(a); });
-  if (p == "round") {  // XLA round_nearest_even
-    return unary(in(0), [](float a) { return std::nearbyint(a); });
-  }
-  if (p == "round_away") {  // XLA round_nearest_afz
-    return unary(in(0), [](float a) { return std::round(a); });
-  }
-  if (p == "expm1") return unary(in(0), [](float a) { return std::expm1(a); });
-  if (p == "log1p") return unary(in(0), [](float a) { return std::log1p(a); });
-  if (p == "not") return unary(in(0), [](float a) { return a != 0 ? 0.0f : 1.0f; });
-  if (p == "is_finite") return unary(in(0), [](float a) { return std::isfinite(a) ? 1.0f : 0.0f; });
-  if (p == "rem") return binary(in(0), in(1), [](float a, float b) { return std::fmod(a, b); });
-  if (p == "atan2") return binary(in(0), in(1), [](float a, float b) { return std::atan2(a, b); });
-  if (p == "ne") return binary(in(0), in(1), [](float a, float b) { return a != b ? 1.0f : 0.0f; });
-  if (p == "to_bf16") return unary(in(0), ptnative::f32_to_bf16_rn);
-  if (p == "to_int") return unary(in(0), [](float a) { return std::trunc(a); });
+  if (p == "sin") return unary_op(in(0), UnOp::Sin);
+  if (p == "cos") return unary_op(in(0), UnOp::Cos);
+  if (p == "erf") return unary_op(in(0), UnOp::Erf);
+  if (p == "ceil") return unary_op(in(0), UnOp::Ceil);
+  if (p == "round") return unary_op(in(0), UnOp::RoundEven);
+  if (p == "round_away") return unary_op(in(0), UnOp::RoundAway);
+  if (p == "expm1") return unary_op(in(0), UnOp::Expm1);
+  if (p == "log1p") return unary_op(in(0), UnOp::Log1p);
+  if (p == "not") return unary_op(in(0), UnOp::Not);
+  if (p == "is_finite") return unary_op(in(0), UnOp::IsFinite);
+  if (p == "rem") return binary_op(in(0), in(1), BinOp::Rem);
+  if (p == "atan2") return binary_op(in(0), in(1), BinOp::Atan2);
+  if (p == "ne") return binary_op(in(0), in(1), BinOp::Ne);
+  if (p == "to_bf16") return unary_op(in(0), UnOp::ToBf16);
+  if (p == "to_int") return unary_op(in(0), UnOp::Trunc);
   if (p == "clamp")  // lax.clamp(min, x, max)
-    return binary(binary(in(1), in(0), [](float a, float b) { return a > b ? a : b; }),
-                  in(2), [](float a, float b) { return a < b ? a : b; });
+    return binary_op(binary_op(in(1), in(0), BinOp::Max), in(2), BinOp::Min);
   if (p == "copy" || p == "convert_element_type" || p == "stop_gradient")
     return env.at(ins.ins[0]);
   if (p == "reshape") return reshape(in(0), attr("shape"));
@@ -273,10 +269,16 @@ static NDArray run_instr(const Instr& ins, const Env& env) {
     return reduce(in(0), attr("axes"), 1.0f,
                   [](float a, float b) { return (a != 0 && b != 0) ? 1.0f : 0.0f; });
   if (p == "dot_general")
-    return dot_general(in(0), in(1), attr("lc"), attr("rc"), attr("lb"), attr("rb"));
-  if (p == "conv")
+    return dot_general(in(0), in(1), attr("lc"), attr("rc"), attr("lb"),
+                       attr("rb"), pack);
+  if (p == "conv") {
+    // fuse-conv-epilogue pass: optional 3rd input is a residual addend,
+    // relu=1 applies max(., 0) — both run inside the conv's tile scatter
+    const NDArray* addend = ins.ins.size() > 2 ? &env.at(ins.ins[2]) : nullptr;
+    const bool relu = ins.attrs.count("relu") > 0;
     return conv2d_nhwc(in(0), in(1), attr("strides"), attr("pad_lo"), attr("pad_hi"),
-                       attr("groups")[0]);
+                       attr("groups")[0], pack, addend, relu);
+  }
   if (p == "reduce_window_max")
     return reduce_window_2d(in(0), attr("window"), attr("strides"), attr("pad_lo"),
                             attr("pad_hi"), true);
@@ -344,6 +346,11 @@ struct PTPredictor {
   std::unique_ptr<Program> prog;
   std::string error;
   std::vector<NDArray> last_outputs;
+  // packed constant weights, one entry per conv/dot_general instruction
+  // whose weight operand is a program const — filled lazily at first run
+  // so repeat calls skip the per-call panel pack (and rhs transpose).
+  // Not thread-safe: one PTPredictor serves one caller at a time.
+  std::map<const ptnative::Instr*, ptnative::WeightPack> weight_packs;
 };
 
 extern "C" {
@@ -379,12 +386,36 @@ int pt_predictor_run(PTPredictor* p, const float** inputs, int n_inputs) {
       arr.data.assign(inputs[i], inputs[i] + arr.numel());
       locals.emplace(p->prog->inputs[i].first, std::move(arr));
     }
+    auto pack_for = [&](const ptnative::Instr& ins)
+        -> const ptnative::WeightPack* {
+      const bool packable =
+          (ins.prim == "dot_general" ||
+           (ins.prim == "conv" && ins.attrs.at("groups")[0] == 1)) &&
+          ins.ins.size() > 1;
+      if (!packable) return nullptr;
+      const int wid = ins.ins[1];
+      // const weights only: a locals id (input / computed value) can change
+      // between or within calls, so its pack cannot be cached
+      if (locals.count(wid) || !p->prog->consts.count(wid)) return nullptr;
+      auto it = p->weight_packs.find(&ins);
+      if (it == p->weight_packs.end()) {
+        const NDArray& w = p->prog->consts.at(wid);
+        it = p->weight_packs
+                 .emplace(&ins,
+                          ins.prim == "conv"
+                              ? ptnative::prepack_conv_filter(w)
+                              : ptnative::prepack_dot_rhs(w, ins.attrs.at("rc"),
+                                                          ins.attrs.at("rb")))
+                 .first;
+      }
+      return &it->second;
+    };
     static const bool profile = std::getenv("PT_NATIVE_PROFILE") != nullptr;
     if (profile) {
       std::map<std::string, double> per_prim;
       for (const auto& ins : p->prog->instrs) {
         auto t0 = std::chrono::steady_clock::now();
-        locals[ins.out] = ptnative::run_instr(ins, env);
+        locals[ins.out] = ptnative::run_instr(ins, env, pack_for(ins));
         per_prim[ins.prim] +=
             std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
                 .count();
@@ -394,7 +425,7 @@ int pt_predictor_run(PTPredictor* p, const float** inputs, int n_inputs) {
                      kv.first.c_str(), kv.second * 1e3);
     } else {
       for (const auto& ins : p->prog->instrs) {
-        locals[ins.out] = ptnative::run_instr(ins, env);
+        locals[ins.out] = ptnative::run_instr(ins, env, pack_for(ins));
       }
     }
     p->last_outputs.clear();

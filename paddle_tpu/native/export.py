@@ -519,8 +519,20 @@ def _dce(jaxpr):
     return list(reversed(keep))
 
 
-def export_program(fn: Callable, example_inputs: Sequence, out_dir: str) -> None:
-    """Trace ``fn(*example_inputs)`` and write the native artifact."""
+def export_program(
+    fn: Callable,
+    example_inputs: Sequence,
+    out_dir: str,
+    dump_passes_to: str = None,
+) -> None:
+    """Trace ``fn(*example_inputs)`` and write the native artifact.
+
+    The emitted program runs through the generic pass pipeline
+    (``native.passes.default_pipeline``: copy propagation, CSE,
+    conv-epilogue fusion — conv/add/max chains become fused 3-input conv
+    instructions — then DCE; ``dump_passes_to`` writes the program after
+    every pass for pipeline debugging). Trace-time constant folding and
+    identity elimination already happened during emission."""
     os.makedirs(out_dir, exist_ok=True)
     closed = jax.make_jaxpr(fn)(*example_inputs)
     jaxpr = closed.jaxpr
@@ -543,33 +555,17 @@ def export_program(fn: Callable, example_inputs: Sequence, out_dir: str) -> None
         else:
             out_lines.append(f"output {em.use(em.vid(var))}")
 
+    from paddle_tpu.native import passes as native_passes
+
+    prog = native_passes.Program.parse(
+        "# paddle_tpu native program v2\n" + "\n".join(em.lines + out_lines),
+        weights=b"".join(em.weights),
+    )
+    prog = native_passes.PassManager().run(prog, dump_dir=dump_passes_to)
     with open(os.path.join(out_dir, "program.txt"), "w") as f:
-        f.write("# paddle_tpu native program v2\n")
-        f.write("\n".join(_line_dce(em.lines, out_lines) + out_lines) + "\n")
+        f.write(prog.serialize())
     with open(os.path.join(out_dir, "weights.bin"), "wb") as f:
         f.write(b"".join(em.weights))
-
-
-def _line_dce(lines: List[str], out_lines: List[str]) -> List[str]:
-    """Backward-reachability DCE over emitted lines: identity elimination
-    can orphan ops (e.g. the broadcast feeding an eliminated x*1) whose
-    results nothing reads — drop them (and consts only they read)."""
-    needed = {int(l.split()[1]) for l in out_lines}
-    keep_rev: List[str] = []
-    for line in reversed(lines):
-        parts = line.split()
-        if parts[0] == "op":
-            out_id = int(parts[2])
-            if out_id in needed:
-                keep_rev.append(line)
-                nin = int(parts[3])
-                needed.update(int(p) for p in parts[4 : 4 + nin])
-        elif parts[0] == "const":
-            if int(parts[1]) in needed:
-                keep_rev.append(line)
-        else:  # input lines always survive (the call ABI)
-            keep_rev.append(line)
-    return list(reversed(keep_rev))
 
 
 def export_train_step(

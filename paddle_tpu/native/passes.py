@@ -1,0 +1,370 @@
+"""Generic pass infrastructure over the native program IR.
+
+The reference's graph IR carries a pass registry and a pass manager
+(``paddle/fluid/framework/ir/graph.h``, ``pass.h`` REGISTER_PASS +
+``ApplyPasses``) that fusion/optimization passes plug into. On the TPU
+compute path that whole layer is XLA's job — but the repo owns one IR of
+its own: the linearized native serving program (``export.py`` →
+``program.txt`` → ``csrc/predictor.cc``). This module gives that IR the
+same architecture: a parsed :class:`Program`, a :class:`Pass` base with a
+registry, and a :class:`PassManager` that applies a pipeline and can dump
+the program between passes (the reference's debugging idiom for pass
+pipelines).
+
+Trace-time transforms (constant folding, algebraic identity elimination,
+jaxpr-level DCE) stay in ``export.py`` where the values are still live;
+the passes here are structural rewrites of the emitted program. Default
+pipeline: copy propagation, common-subexpression elimination, dead-code
+elimination.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, Dict, List, Optional, Sequence
+
+__all__ = [
+    "Program",
+    "Pass",
+    "PassManager",
+    "register_pass",
+    "get_pass",
+    "default_pipeline",
+    "CopyPropagation",
+    "CommonSubexpressionElimination",
+    "FuseConvEpilogue",
+    "DeadCodeElimination",
+]
+
+
+# ---- program IR ---------------------------------------------------------
+#
+# Line grammar (see export.py emitters / csrc/predictor.cc parser):
+#   input <id> <ndim> <dims...>
+#   const <id> <byte-offset> <ndim> <dims...> <dtype-tag>
+#   op <prim> <out-id> <n-ins> <in-ids...> <attrs|->
+#   output <id>
+
+
+@dataclasses.dataclass
+class Item:
+    """One program line, parsed just enough for structural rewrites."""
+
+    kind: str  # input | const | op | output
+    line: str
+    out: Optional[int] = None  # defined id (input/const/op)
+    ins: List[int] = dataclasses.field(default_factory=list)  # op/output uses
+    prim: str = ""  # op only
+    attrs: str = ""  # op only (opaque; compared verbatim)
+
+
+@dataclasses.dataclass
+class Program:
+    header: str
+    items: List[Item]
+    # weights.bin contents; lets value-sensitive passes (e.g. the zero
+    # check in fuse-conv-epilogue) inspect scalar constants
+    weights: bytes = b""
+
+    @staticmethod
+    def parse(text: str, weights: bytes = b"") -> "Program":
+        header = ""
+        items: List[Item] = []
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("#"):
+                header = line
+                continue
+            parts = line.split()
+            kind = parts[0]
+            if kind in ("input", "const"):
+                items.append(Item(kind, line, out=int(parts[1])))
+            elif kind == "op":
+                nin = int(parts[3])
+                items.append(Item(
+                    kind, line, out=int(parts[2]),
+                    ins=[int(p) for p in parts[4:4 + nin]],
+                    prim=parts[1], attrs=parts[4 + nin],
+                ))
+            elif kind == "output":
+                items.append(Item(kind, line, ins=[int(parts[1])]))
+            else:
+                raise ValueError(f"unknown program line: {line!r}")
+        return Program(header, items, weights)
+
+    def scalar_const_value(self, item: Item) -> Optional[float]:
+        """Value of a rank-0 f32 const, or None (non-scalar / no weights)."""
+        if item.kind != "const":
+            return None
+        parts = item.line.split()  # const <id> <offset> <ndim> <dims...> <dtag>
+        if int(parts[3]) != 0 or parts[-1] != "f32":
+            return None
+        off = int(parts[2])
+        if off + 4 > len(self.weights):
+            return None
+        import struct
+
+        return struct.unpack_from("<f", self.weights, off)[0]
+
+    def serialize(self) -> str:
+        lines = [self.header] if self.header else []
+        lines.extend(it.line for it in self.items)
+        return "\n".join(lines) + "\n"
+
+    def remap_uses(self, mapping: Dict[int, int]) -> None:
+        """Rewrite every USE (op inputs, outputs) through ``mapping``;
+        definitions keep their ids."""
+        if not mapping:
+            return
+        for it in self.items:
+            if not it.ins or not any(i in mapping for i in it.ins):
+                continue
+            it.ins = [mapping.get(i, i) for i in it.ins]
+            parts = it.line.split()
+            if it.kind == "op":
+                nin = len(it.ins)
+                it.line = " ".join(
+                    parts[:4] + [str(i) for i in it.ins] + parts[4 + nin:]
+                )
+            else:  # output
+                it.line = f"output {it.ins[0]}"
+
+    def op_count(self, prim: Optional[str] = None) -> int:
+        return sum(
+            1 for it in self.items
+            if it.kind == "op" and (prim is None or it.prim == prim)
+        )
+
+
+# ---- pass base + registry ----------------------------------------------
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register_pass(cls: type) -> type:
+    """Class decorator: register under ``cls.name`` (REGISTER_PASS parity)."""
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def get_pass(name: str) -> "Pass":
+    return _REGISTRY[name]()
+
+
+class Pass:
+    """A structural rewrite of the native program. Subclasses set ``name``
+    and implement :meth:`run` returning a (possibly new) Program."""
+
+    name: str = "pass"
+
+    def run(self, prog: Program) -> Program:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+@register_pass
+class CopyPropagation(Pass):
+    """``copy`` / ``convert_element_type`` / ``stop_gradient`` are identity
+    in this IR (the interpreter is f32 throughout; real dtype changes emit
+    ``to_bf16``/``to_int``): forward their input to every use and drop the
+    op. At runtime each surviving copy costs a full tensor clone into the
+    locals env, so this pass deletes real per-inference work, not just
+    lines."""
+
+    name = "copy-prop"
+
+    _IDENTITY = ("copy", "convert_element_type", "stop_gradient")
+
+    def run(self, prog: Program) -> Program:
+        remap: Dict[int, int] = {}
+        kept: List[Item] = []
+        for it in prog.items:
+            if it.kind == "op" and it.prim in self._IDENTITY and len(it.ins) == 1:
+                remap[it.out] = remap.get(it.ins[0], it.ins[0])
+                continue
+            kept.append(it)
+        out = Program(prog.header, kept, prog.weights)
+        out.remap_uses(remap)
+        return out
+
+
+@register_pass
+class CommonSubexpressionElimination(Pass):
+    """Ops with identical (prim, inputs, attrs) compute the same value —
+    every program op is pure and deterministic — so later duplicates alias
+    the first result. Downstream uses are remapped; the dup op line is
+    dropped (DCE would also catch it, but dropping here keeps the pass
+    self-contained)."""
+
+    name = "cse"
+
+    def run(self, prog: Program) -> Program:
+        seen: Dict[tuple, int] = {}
+        remap: Dict[int, int] = {}
+        kept: List[Item] = []
+        for it in prog.items:
+            if it.kind == "op":
+                ins = tuple(remap.get(i, i) for i in it.ins)
+                key = (it.prim, ins, it.attrs)
+                if key in seen:
+                    remap[it.out] = seen[key]
+                    continue
+                seen[key] = it.out
+            kept.append(it)
+        out = Program(prog.header, kept, prog.weights)
+        out.remap_uses(remap)
+        return out
+
+
+@register_pass
+class FuseConvEpilogue(Pass):
+    """Fuse ``conv -> add(addend) -> relu`` / ``conv -> relu`` chains into
+    the conv instruction (``relu=1`` attr; the addend becomes a third
+    input). The interpreter applies the epilogue inside the conv's row-tile
+    scatter while the output tile is cache-hot, deleting one or two full
+    activation sweeps per conv — the reference's conv+relu inference
+    fusions (``inference_transpiler.py``) re-expressed as a pass on this
+    IR. Fires only on single-use intermediates, groups=1 convs, addends
+    defined before the conv (execution order stays valid), and a
+    verified scalar-zero relu threshold.
+    """
+
+    name = "fuse-conv-epilogue"
+
+    def run(self, prog: Program) -> Program:
+        defs: Dict[int, int] = {}
+        uses: Dict[int, int] = {}
+        for idx, it in enumerate(prog.items):
+            if it.out is not None:
+                defs.setdefault(it.out, idx)
+            for i in it.ins:
+                uses[i] = uses.get(i, 0) + 1
+        zero_ids = {
+            it.out for it in prog.items if prog.scalar_const_value(it) == 0.0
+        }
+
+        def single_user(out_id, from_idx):
+            """The unique op consuming out_id, or None."""
+            if uses.get(out_id, 0) != 1:
+                return None
+            for j in range(from_idx + 1, len(prog.items)):
+                it = prog.items[j]
+                if it.kind == "op" and out_id in it.ins:
+                    return j
+                if it.kind == "output" and out_id in it.ins:
+                    return None
+            return None
+
+        drop: set = set()
+        remap: Dict[int, int] = {}
+        def groups_of(attrs: str) -> int:
+            for part in attrs.split(";"):
+                if part.startswith("groups="):
+                    return int(part.split("=", 1)[1].split(",")[0])
+            return 1
+
+        for idx, it in enumerate(prog.items):
+            if it.kind != "op" or it.prim != "conv" or groups_of(it.attrs) != 1:
+                continue
+            addend = None
+            tail = idx  # last fused item
+            j = single_user(it.out, idx)
+            if j is not None and prog.items[j].prim == "add":
+                add_it = prog.items[j]
+                other = [i for i in add_it.ins if i != it.out]
+                # same id twice (x + x) is not this pattern
+                if len(other) == 1 and defs.get(other[0], len(prog.items)) < idx:
+                    addend = other[0]
+                    tail = j
+            k = single_user(prog.items[tail].out, tail)
+            relu = (
+                k is not None
+                and prog.items[k].prim == "max"
+                and any(i in zero_ids for i in prog.items[k].ins)
+            )
+            if not relu and tail == idx:
+                continue  # nothing to fuse
+            if not relu and addend is not None:
+                # fuse the add alone: still deletes one sweep
+                k = None
+            new_ins = list(it.ins) + ([addend] if addend is not None else [])
+            attrs = it.attrs + (";has_addend=1" if addend is not None else "")
+            if relu:
+                attrs += ";relu=1"
+            it.ins = new_ins
+            it.attrs = attrs
+            it.line = (
+                f"op conv {it.out} {len(new_ins)} "
+                + " ".join(str(i) for i in new_ins) + " " + attrs
+            )
+            if addend is not None:
+                drop.add(tail)
+                remap[prog.items[tail].out] = it.out
+            if relu and k is not None:
+                drop.add(k)
+                remap[prog.items[k].out] = it.out
+        if not drop:
+            return prog
+        kept = [it for idx, it in enumerate(prog.items) if idx not in drop]
+        out = Program(prog.header, kept, prog.weights)
+        out.remap_uses(remap)
+        return out
+
+
+@register_pass
+class DeadCodeElimination(Pass):
+    """Backward reachability from the outputs: ops whose results nothing
+    reads are dropped, along with consts only they read (trace-time
+    identity elimination can orphan whole chains — e.g. the broadcast that
+    fed an eliminated x*1). Input lines always survive: they are the call
+    ABI."""
+
+    name = "dce"
+
+    def run(self, prog: Program) -> Program:
+        needed = set()
+        for it in prog.items:
+            if it.kind == "output":
+                needed.update(it.ins)
+        keep_rev: List[Item] = []
+        for it in reversed(prog.items):
+            if it.kind == "op":
+                if it.out in needed:
+                    keep_rev.append(it)
+                    needed.update(it.ins)
+            elif it.kind == "const":
+                if it.out in needed:
+                    keep_rev.append(it)
+            else:  # input / output
+                keep_rev.append(it)
+        return Program(prog.header, list(reversed(keep_rev)), prog.weights)
+
+
+def default_pipeline() -> List[Pass]:
+    return [
+        get_pass("copy-prop"),
+        get_pass("cse"),
+        get_pass("fuse-conv-epilogue"),
+        get_pass("dce"),
+    ]
+
+
+class PassManager:
+    """Apply a pass pipeline; optionally dump the program after each pass
+    (``<dump_dir>/pass_<NN>_<name>.txt``) for pipeline debugging."""
+
+    def __init__(self, passes: Optional[Sequence[Pass]] = None):
+        self.passes = list(passes) if passes is not None else default_pipeline()
+
+    def run(self, prog: Program, dump_dir: Optional[str] = None) -> Program:
+        if dump_dir:
+            os.makedirs(dump_dir, exist_ok=True)
+            with open(os.path.join(dump_dir, "pass_00_input.txt"), "w") as f:
+                f.write(prog.serialize())
+        for i, p in enumerate(self.passes, start=1):
+            prog = p.run(prog)
+            if dump_dir:
+                path = os.path.join(dump_dir, f"pass_{i:02d}_{p.name}.txt")
+                with open(path, "w") as f:
+                    f.write(prog.serialize())
+        return prog

@@ -1,0 +1,258 @@
+"""Generic pass infrastructure over the native program IR
+(``paddle_tpu/native/passes.py``) — the repo-owned analogue of the
+reference's ir::Pass registry + ApplyPasses pipeline
+(``paddle/fluid/framework/ir/pass.h``); the XLA compute path keeps its
+passes inside the compiler.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.native import passes as P
+
+PROG = """# paddle_tpu native program v2
+input 0 2 4 8
+const 1 0 1 8 f32
+op mul 2 2 0 1 -
+op mul 3 2 0 1 -
+op add 4 2 2 3 -
+op neg 5 1 3 -
+output 4
+"""
+
+
+def test_parse_serialize_roundtrip():
+    prog = P.Program.parse(PROG)
+    assert prog.serialize() == PROG
+    assert prog.op_count() == 4
+    assert prog.op_count("mul") == 2
+
+
+def test_cse_merges_identical_ops_and_remaps_uses():
+    prog = P.get_pass("cse").run(P.Program.parse(PROG))
+    assert prog.op_count("mul") == 1
+    add = next(it for it in prog.items if it.prim == "add")
+    assert add.ins == [2, 2]  # both uses remapped onto the surviving mul
+    assert "op add 4 2 2 2 -" in prog.serialize()
+
+
+def test_dce_drops_unreachable_chain():
+    prog = P.get_pass("dce").run(P.Program.parse(PROG))
+    # neg's result feeds nothing -> dropped; everything else is live
+    assert prog.op_count("neg") == 0
+    assert prog.op_count("mul") == 2
+
+
+def test_default_pipeline_composes():
+    prog = P.PassManager().run(P.Program.parse(PROG))
+    # cse merges the muls, dce then drops the orphaned neg (its input was
+    # remapped but its result is still unread)
+    assert prog.op_count() == 2
+    assert prog.op_count("mul") == 1 and prog.op_count("add") == 1
+    # outputs and inputs survive verbatim (call ABI)
+    assert "input 0 2 4 8" in prog.serialize()
+    assert "output 4" in prog.serialize()
+
+
+def test_registry_and_custom_pass():
+    @P.register_pass
+    class DropNeg(P.Pass):
+        name = "test_drop_neg"
+
+        def run(self, prog):
+            return P.Program(
+                prog.header,
+                [it for it in prog.items if it.prim != "neg"],
+            )
+
+    prog = P.PassManager([P.get_pass("test_drop_neg")]).run(P.Program.parse(PROG))
+    assert prog.op_count("neg") == 0
+    del P._REGISTRY["test_drop_neg"]
+
+
+def test_pass_dump_files(tmp_path):
+    dump = str(tmp_path / "dumps")
+    P.PassManager().run(P.Program.parse(PROG), dump_dir=dump)
+    names = sorted(os.listdir(dump))
+    assert names == [
+        "pass_00_input.txt", "pass_01_copy-prop.txt", "pass_02_cse.txt",
+        "pass_03_fuse-conv-epilogue.txt", "pass_04_dce.txt",
+    ]
+    first = open(os.path.join(dump, "pass_00_input.txt")).read()
+    assert first == PROG
+
+
+def test_copy_propagation_forwards_and_chains():
+    text = """# h
+input 0 2 4 8
+op copy 1 1 0 -
+op convert_element_type 2 1 1 -
+op neg 3 1 2 -
+op stop_gradient 4 1 3 -
+output 4
+"""
+    prog = P.get_pass("copy-prop").run(P.Program.parse(text))
+    # all three identities vanish; neg reads the input, output reads neg
+    assert prog.op_count() == 1
+    assert "op neg 3 1 0 -" in prog.serialize()
+    assert "output 3" in prog.serialize()
+
+
+def test_copy_propagation_keeps_to_bf16():
+    text = """# h
+input 0 2 4 8
+op to_bf16 1 1 0 -
+output 1
+"""
+    prog = P.get_pass("copy-prop").run(P.Program.parse(text))
+    assert prog.op_count("to_bf16") == 1  # real dtype change, not identity
+
+
+def test_cse_respects_attrs_and_prim():
+    text = """# h
+input 0 2 4 8
+op reduce_max 1 1 0 axis=1
+op reduce_max 2 1 0 axis=0
+op reduce_sum 3 1 0 axis=1
+op add 4 2 1 2 -
+op add 5 2 4 3 -
+output 5
+"""
+    prog = P.get_pass("cse").run(P.Program.parse(text))
+    # different attrs / prims must NOT merge
+    assert prog.op_count() == 5
+
+
+def _zero_scalar_weights():
+    import struct
+
+    return struct.pack("<f", 0.0) + struct.pack("<f", 1.5)
+
+
+def test_fuse_conv_epilogue_add_relu():
+    text = """# h
+input 0 4 2 8 8 3
+const 1 0 4 3 3 3 4 f32
+const 2 0 0  f32
+op conv 3 2 0 1 strides=1,1;pad_lo=1,1;pad_hi=1,1;groups=1
+op conv 4 2 0 1 strides=1,1;pad_lo=1,1;pad_hi=1,1;groups=1
+op add 5 2 4 3 -
+op max 6 2 5 2 -
+output 6
+"""
+    prog = P.get_pass("fuse-conv-epilogue").run(
+        P.Program.parse(text, weights=_zero_scalar_weights())
+    )
+    assert prog.op_count("add") == 0 and prog.op_count("max") == 0
+    fused = [it for it in prog.items if it.prim == "conv" and len(it.ins) == 3]
+    assert len(fused) == 1
+    assert fused[0].ins == [0, 1, 3]  # addend = the earlier conv's result
+    assert "relu=1" in fused[0].attrs and "has_addend=1" in fused[0].attrs
+    assert "output 4" in prog.serialize()
+
+
+def test_fuse_conv_epilogue_relu_only_and_nonzero_guard():
+    base = """# h
+input 0 4 2 8 8 3
+const 1 0 4 3 3 3 4 f32
+const 2 {off} 0  f32
+op conv 3 2 0 1 strides=1,1;pad_lo=1,1;pad_hi=1,1;groups=1
+op max 4 2 3 2 -
+output 4
+"""
+    w = _zero_scalar_weights()
+    fused = P.get_pass("fuse-conv-epilogue").run(
+        P.Program.parse(base.format(off=0), weights=w)
+    )
+    assert fused.op_count("max") == 0
+    assert any("relu=1" in it.attrs for it in fused.items if it.prim == "conv")
+    # max against 1.5 is NOT a relu — must not fuse
+    kept = P.get_pass("fuse-conv-epilogue").run(
+        P.Program.parse(base.format(off=4), weights=w)
+    )
+    assert kept.op_count("max") == 1
+
+
+def test_fuse_conv_epilogue_respects_multi_use():
+    # conv result used twice: fusing would change the second use
+    text = """# h
+input 0 4 2 8 8 3
+const 1 0 4 3 3 3 4 f32
+const 2 0 0  f32
+op conv 3 2 0 1 strides=1,1;pad_lo=1,1;pad_hi=1,1;groups=1
+op max 4 2 3 2 -
+op neg 5 1 3 -
+op add 6 2 4 5 -
+output 6
+"""
+    prog = P.get_pass("fuse-conv-epilogue").run(
+        P.Program.parse(text, weights=_zero_scalar_weights())
+    )
+    assert prog.op_count("max") == 1  # untouched
+
+
+def test_fuse_conv_epilogue_end_to_end_predictor(tmp_path):
+    """Residual conv block: the exported program carries the fused conv and
+    the predictor matches jax exactly on the add+relu epilogue."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from paddle_tpu.native import NativePredictor
+    from paddle_tpu.native.export import export_program
+
+    r = np.random.RandomState(0)
+    w1 = jnp.asarray(r.randn(3, 3, 4, 4).astype(np.float32) * 0.2)
+    w2 = jnp.asarray(r.randn(3, 3, 4, 4).astype(np.float32) * 0.2)
+
+    def block(x):
+        h = jax.lax.conv_general_dilated(
+            x, w1, (1, 1), ((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        h = jnp.maximum(h, 0.0)
+        h = jax.lax.conv_general_dilated(
+            h, w2, (1, 1), ((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return jnp.maximum(h + x, 0.0)  # residual add + relu
+
+    x = r.randn(2, 8, 8, 4).astype(np.float32)
+    out_dir = str(tmp_path / "m")
+    export_program(block, (x,), out_dir)
+
+    prog = P.Program.parse(open(os.path.join(out_dir, "program.txt")).read())
+    assert prog.op_count("max") == 0  # both relus fused into the convs
+    assert prog.op_count("add") == 0  # residual add fused too
+
+    got = NativePredictor(out_dir).run(x)[0]
+    np.testing.assert_allclose(got, np.asarray(block(jnp.asarray(x))),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_exported_program_goes_through_pipeline(tmp_path):
+    """End-to-end: a traced fn with a duplicated subexpression exports to a
+    program where CSE merged it, and the predictor still matches jax."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from paddle_tpu.native import NativePredictor
+    from paddle_tpu.native.export import export_program
+
+    def fn(x):
+        a = jnp.tanh(x) * 2.0
+        b = jnp.tanh(x) * 2.0  # identical subexpression
+        return a + b
+
+    x = np.random.RandomState(0).randn(2, 6).astype(np.float32)
+    out_dir = str(tmp_path / "m")
+    dump = str(tmp_path / "dumps")
+    export_program(fn, (x,), out_dir, dump_passes_to=dump)
+
+    text = open(os.path.join(out_dir, "program.txt")).read()
+    prog = P.Program.parse(text)
+    assert prog.op_count("tanh") == 1  # CSE collapsed the duplicate trace
+    assert os.path.exists(os.path.join(dump, "pass_02_cse.txt"))
+
+    pred = NativePredictor(out_dir)
+    got = pred.run(x)[0]
+    np.testing.assert_allclose(got, np.asarray(fn(jnp.asarray(x))),
+                               rtol=1e-5, atol=1e-6)

@@ -45,6 +45,10 @@ def main():
     rng = np.random.RandomState(0)
     x = rng.rand(args.bs, 224, 224, 3).astype(np.float32)
     variables = net.init(0, x)
+    # the documented serving recipe: fold BN into conv weights so the
+    # export-time identity elimination removes all BN arithmetic (the
+    # reference's inference_transpiler step precedes its MKL-DNN numbers)
+    variables = pt.transpiler.inference.fuse_batch_norm(variables)
 
     with tempfile.TemporaryDirectory() as td:
         save_native_model(net, variables, [x], td)
