@@ -192,6 +192,9 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
             lspec = models.get_model(
                 "transformer_lm", seq_len=2048, d_model=1024, d_inner=4096,
                 num_heads=16, n_layers=12, max_len=2048,
+                # one scanned body -> one Mosaic flash fwd+bwd compile
+                # instead of 12: tunnel windows are compile-time bound
+                scan_layers=True,
             )
             dt, flops = _bench_step(lspec, 4, warmup=1, iters=6)
             result["lm_large_tokens_per_sec"] = round(4 * 2048 / dt, 1)

@@ -149,6 +149,34 @@ def is_initializing() -> bool:
 
 
 @contextlib.contextmanager
+def overlay_frame(params: Dict[str, jax.Array], rng=None):
+    """Run the body under a FRESH apply-mode frame backed by ``params``.
+
+    The scan-over-layers mechanism (``models/transformer_lm.py``
+    ``_scan_lm_blocks``): the caller stacks the per-layer parameter arrays,
+    and inside ``lax.scan`` the block body traces once against
+    template-named entries of this overlay. Requires an enclosing frame
+    (inherits its train flag); state-creating layers (BN moving stats) are
+    not supported inside an overlay — the overlay's new_state is asserted
+    empty on exit."""
+    prev = _current_frame()
+    frame = _Frame("apply", params, {}, rng, prev.is_train)
+    _tls.frame = frame
+    try:
+        yield frame
+        # checked on CLEAN exit only: raising from a finally would replace
+        # an in-flight body exception with this secondary one
+        if frame.new_state:
+            raise EnforceError(
+                "overlay_frame body produced mutable state "
+                f"({sorted(frame.new_state)}); stateful layers cannot run "
+                "under scan-over-layers"
+            )
+    finally:
+        _tls.frame = prev
+
+
+@contextlib.contextmanager
 def name_scope(prefix: str):
     """Hierarchical name scope (fluid.name_scope parity, ``framework.py`` tail).
     Scope names are uniquified per frame so loops create block_0, block_1, ..."""

@@ -162,6 +162,65 @@ def _block_caller(cfg):
     return call
 
 
+def _scan_lm_blocks(x, cfg, seq_lens):
+    """Run the layer stack as ONE ``lax.scan`` over stacked per-layer params
+    instead of an unrolled Python loop — the canonical TPU pattern: the
+    block body appears ONCE in the traced program regardless of depth
+    (measured, 12-layer d_model=256 train step: 291 → 27 dot_generals in
+    the lowered HLO). That bounds the expensive per-instance TPU kernel
+    compilation (each unrolled layer is its own Mosaic flash fwd+bwd
+    compile; scanned pays one) and keeps program size flat as n_layers
+    grows. On CPU-XLA, where per-op compile is cheap, measured wall-clock
+    compile is neutral-to-slightly-slower (scan adds loop/grad machinery)
+    — the flag targets the TPU toolchain. Math is identical to the
+    unrolled loop; the dropout STREAM differs (per-layer keys are
+    pre-split rather than drawn from the frame sequence), so
+    seeded-dropout runs are not bit-comparable across the two modes —
+    loss statistics are unaffected.
+
+    Mechanics: the per-layer parameter arrays (identical names/shapes
+    across layers by construction) are stacked to [L, ...] pytrees; the
+    scan body re-enters ``lm_block`` under a fresh
+    :func:`framework.overlay_frame` that maps the template names
+    ``layer_tpl/...`` to the scanned slice. With ``cfg['remat']`` the body
+    runs under ``jax.checkpoint`` (scan-of-checkpoint: activation memory
+    O(one layer))."""
+    frame = pt.framework._current_frame()
+    L = cfg["n_layers"]
+    prefix = "/".join(frame.name_stack)
+    prefix = prefix + "/" if prefix else ""
+    tag0 = f"{prefix}layer_0/"
+    suffixes = sorted(k[len(tag0):] for k in frame.params if k.startswith(tag0))
+    pt.check(bool(suffixes), "scan_layers: no layer_0/* params in frame")
+    for i in range(L):
+        for s in suffixes:
+            pt.check(
+                f"{prefix}layer_{i}/{s}" in frame.params,
+                f"parameter '{prefix}layer_{i}/{s}' not found in provided "
+                f"params; scan_layers expects cfg['n_layers']={L} identical "
+                "layers — model structure must match between init and apply",
+            )
+    stacked = {
+        s: jnp.stack([frame.params[f"{prefix}layer_{i}/{s}"] for i in range(L)])
+        for s in suffixes
+    }
+    xs = {"p": stacked}
+    if frame.rng is not None:
+        xs["k"] = jax.random.split(pt.framework.next_rng_key(), L)
+
+    def body(x, sl):
+        overlay = {f"layer_tpl/{s}": v for s, v in sl["p"].items()}
+        with pt.framework.overlay_frame(overlay, rng=sl.get("k")):
+            y = lm_block(x, cfg, "layer_tpl", seq_lens)
+        return y, None
+
+    call = body
+    if cfg.get("remat") and pt.framework.is_training():
+        call = jax.checkpoint(body)
+    x, _ = jax.lax.scan(call, x, xs)
+    return x
+
+
 def lm_forward(ids, labels, seq_lens=None, *, cfg):
     """Next-token LM training forward: returns (loss, token_count, logits).
 
@@ -177,9 +236,14 @@ def lm_forward(ids, labels, seq_lens=None, *, cfg):
         cfg["residual_dropout"], name="emb",
         add_position_encoding=cfg.get("pos_encoding", "sinusoid") != "rope",
     )
-    block = _block_caller(cfg)
-    for i in range(cfg["n_layers"]):
-        x = block(x, name=f"layer_{i}", kv_len=seq_lens)
+    if cfg.get("scan_layers") and not pt.framework.is_initializing():
+        # init stays unrolled (trace-time param creation needs the real
+        # per-layer names); apply scans — compile time O(1) in n_layers
+        x = _scan_lm_blocks(x, cfg, seq_lens)
+    else:
+        block = _block_caller(cfg)
+        for i in range(cfg["n_layers"]):
+            x = block(x, name=f"layer_{i}", kv_len=seq_lens)
     x = layers.layer_norm(x, begin_norm_axis=x.ndim - 1)
     with name_scope("project"):
         logits = _proj(x, cfg["vocab"], shard_out=True, name="logits", bias=False)
@@ -402,6 +466,10 @@ BASE_CFG = dict(
     relu_dropout=0.0,
     residual_dropout=0.0,
     remat=False,
+    # run the layer stack as one lax.scan over stacked params: compile time
+    # O(1) in n_layers (see _scan_lm_blocks); dropout stream differs from
+    # the unrolled loop, math is otherwise identical
+    scan_layers=False,
 )
 
 
