@@ -334,7 +334,9 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
         tbs, tseq = (4, 64) if tiny else (32, 256)
         titers = 3 if tiny else 10
         try:
-            tspec = models.get_model("transformer", seq_len=tseq)
+            # scan_layers: one body compile per stack (see lm_large note)
+            tspec = models.get_model("transformer", seq_len=tseq,
+                                     scan_layers=not tiny)
             dt, flops = _bench_step(tspec, tbs, warmup=1, iters=titers)
             result["transformer_tokens_per_sec"] = round(tbs * tseq / dt, 1)
             if peak and flops:

@@ -176,6 +176,60 @@ def overlay_frame(params: Dict[str, jax.Array], rng=None):
         _tls.frame = prev
 
 
+def scan_layer_stack(x, n_layers: int, name_of, template: str, body,
+                     remat: bool = False):
+    """Run ``n_layers`` identical layers as ONE ``lax.scan`` over stacked
+    per-layer params (the canonical TPU depth pattern: the body appears
+    once in the traced program, so per-instance kernel compilation and
+    program size stay O(1) in depth).
+
+    ``name_of(i)`` returns the unrolled layer scope name (``"layer_3"``);
+    params under ``<scope>/<name_of(0)>/...`` must exist for every layer
+    with identical suffix sets/shapes. ``body(x, scope_name) -> x`` must be
+    layer-index-agnostic; it re-traces once under an :func:`overlay_frame`
+    that maps ``<template>/...`` to the scanned parameter slice.
+    Loop-invariant tensors ride as closure constants. With ``remat`` the
+    body runs under ``jax.checkpoint`` (activation memory O(one layer)).
+    Dropout draws per-layer pre-split keys, so the stream differs from the
+    unrolled loop's frame sequence (loss statistics unaffected).
+    """
+    frame = _current_frame()
+    prefix = "/".join(frame.name_stack)
+    prefix = prefix + "/" if prefix else ""
+    tag0 = f"{prefix}{name_of(0)}/"
+    suffixes = sorted(k[len(tag0):] for k in frame.params if k.startswith(tag0))
+    if not suffixes:
+        raise EnforceError(f"scan_layer_stack: no {tag0}* params in frame")
+    for i in range(n_layers):
+        for s in suffixes:
+            if f"{prefix}{name_of(i)}/{s}" not in frame.params:
+                raise EnforceError(
+                    f"parameter '{prefix}{name_of(i)}/{s}' not found in "
+                    f"provided params; scan expects {n_layers} identical "
+                    "layers — model structure must match between init and "
+                    "apply"
+                )
+    stacked = {
+        s: jnp.stack(
+            [frame.params[f"{prefix}{name_of(i)}/{s}"] for i in range(n_layers)]
+        )
+        for s in suffixes
+    }
+    xs = {"p": stacked}
+    if frame.rng is not None:
+        xs["k"] = jax.random.split(next_rng_key(), n_layers)
+
+    def scan_body(carry, sl):
+        overlay = {f"{template}/{s}": v for s, v in sl["p"].items()}
+        with overlay_frame(overlay, rng=sl.get("k")):
+            y = body(carry, template)
+        return y, None
+
+    call = jax.checkpoint(scan_body) if remat else scan_body
+    x, _ = jax.lax.scan(call, x, xs)
+    return x
+
+
 @contextlib.contextmanager
 def name_scope(prefix: str):
     """Hierarchical name scope (fluid.name_scope parity, ``framework.py`` tail).

@@ -234,6 +234,14 @@ def encode(src_ids, src_pad, cfg):
         src_ids, cfg["src_vocab"], cfg["d_model"], cfg["max_len"],
         cfg["residual_dropout"], name="src_emb",
     )
+    if cfg.get("scan_layers") and not pt.framework.is_initializing():
+        # one lax.scan body over stacked params (framework.scan_layer_stack:
+        # compile cost and program size O(1) in n_layers); init stays
+        # unrolled for trace-time param creation
+        return pt.framework.scan_layer_stack(
+            x, cfg["n_layers"], lambda i: f"enc_layer_{i}", "enc_layer_tpl",
+            lambda h, name: encoder_layer(h, self_mask, cfg, name, kv_len=src_len),
+        )
     for i in range(cfg["n_layers"]):
         x = encoder_layer(x, self_mask, cfg, name=f"enc_layer_{i}", kv_len=src_len)
     return x
@@ -258,12 +266,25 @@ def decode(trg_ids, trg_pad, enc_out, src_pad, cfg, caches=None, pos_offset=0):
         cfg["residual_dropout"], name="trg_emb",
         pos_offset=pos_offset if caches is not None else 0,
     )
-    for i in range(cfg["n_layers"]):
-        cache = caches[i] if caches is not None else None
-        x = decoder_layer(
-            x, enc_out, self_mask, cross_mask, cfg, name=f"dec_layer_{i}",
-            cache=cache, self_causal=structural, cross_kv_len=cross_len,
+    if (
+        cfg.get("scan_layers")
+        and caches is None  # cached decode keeps its per-layer loop
+        and not pt.framework.is_initializing()
+    ):
+        x = pt.framework.scan_layer_stack(
+            x, cfg["n_layers"], lambda i: f"dec_layer_{i}", "dec_layer_tpl",
+            lambda h, name: decoder_layer(
+                h, enc_out, self_mask, cross_mask, cfg, name,
+                self_causal=structural, cross_kv_len=cross_len,
+            ),
         )
+    else:
+        for i in range(cfg["n_layers"]):
+            cache = caches[i] if caches is not None else None
+            x = decoder_layer(
+                x, enc_out, self_mask, cross_mask, cfg, name=f"dec_layer_{i}",
+                cache=cache, self_causal=structural, cross_kv_len=cross_len,
+            )
     with name_scope("project"):
         logits = _proj(x, cfg["trg_vocab"], shard_out=True, name="logits", bias=False)
     return logits
@@ -300,6 +321,9 @@ BASE_CFG = dict(
     relu_dropout=0.1,
     residual_dropout=0.1,
     label_smooth_eps=0.1,
+    # run encoder/decoder stacks as one lax.scan body each over stacked
+    # params (framework.scan_layer_stack); cached decode stays unrolled
+    scan_layers=False,
 )
 
 

@@ -178,47 +178,21 @@ def _scan_lm_blocks(x, cfg, seq_lens):
     seeded-dropout runs are not bit-comparable across the two modes —
     loss statistics are unaffected.
 
-    Mechanics: the per-layer parameter arrays (identical names/shapes
-    across layers by construction) are stacked to [L, ...] pytrees; the
-    scan body re-enters ``lm_block`` under a fresh
-    :func:`framework.overlay_frame` that maps the template names
-    ``layer_tpl/...`` to the scanned slice. With ``cfg['remat']`` the body
-    runs under ``jax.checkpoint`` (scan-of-checkpoint: activation memory
-    O(one layer))."""
-    frame = pt.framework._current_frame()
-    L = cfg["n_layers"]
-    prefix = "/".join(frame.name_stack)
-    prefix = prefix + "/" if prefix else ""
-    tag0 = f"{prefix}layer_0/"
-    suffixes = sorted(k[len(tag0):] for k in frame.params if k.startswith(tag0))
-    pt.check(bool(suffixes), "scan_layers: no layer_0/* params in frame")
-    for i in range(L):
-        for s in suffixes:
-            pt.check(
-                f"{prefix}layer_{i}/{s}" in frame.params,
-                f"parameter '{prefix}layer_{i}/{s}' not found in provided "
-                f"params; scan_layers expects cfg['n_layers']={L} identical "
-                "layers — model structure must match between init and apply",
-            )
-    stacked = {
-        s: jnp.stack([frame.params[f"{prefix}layer_{i}/{s}"] for i in range(L)])
-        for s in suffixes
-    }
-    xs = {"p": stacked}
-    if frame.rng is not None:
-        xs["k"] = jax.random.split(pt.framework.next_rng_key(), L)
-
-    def body(x, sl):
-        overlay = {f"layer_tpl/{s}": v for s, v in sl["p"].items()}
-        with pt.framework.overlay_frame(overlay, rng=sl.get("k")):
-            y = lm_block(x, cfg, "layer_tpl", seq_lens)
-        return y, None
-
-    call = body
-    if cfg.get("remat") and pt.framework.is_training():
-        call = jax.checkpoint(body)
-    x, _ = jax.lax.scan(call, x, xs)
-    return x
+    Mechanics: :func:`framework.scan_layer_stack` — per-layer parameter
+    arrays (identical names/shapes across layers by construction) stack to
+    [L, ...] pytrees; the scan body re-enters ``lm_block`` under a fresh
+    :func:`framework.overlay_frame` mapping ``layer_tpl/...`` to the
+    scanned slice. With ``cfg['remat']`` the body runs under
+    ``jax.checkpoint`` (scan-of-checkpoint: activation memory O(one
+    layer))."""
+    return pt.framework.scan_layer_stack(
+        x,
+        cfg["n_layers"],
+        lambda i: f"layer_{i}",
+        "layer_tpl",
+        lambda h, name: lm_block(h, cfg, name, seq_lens),
+        remat=bool(cfg.get("remat")) and pt.framework.is_training(),
+    )
 
 
 def lm_forward(ids, labels, seq_lens=None, *, cfg):
