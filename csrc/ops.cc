@@ -330,21 +330,36 @@ NDArray broadcast_in_dim(const NDArray& x, const std::vector<int64_t>& out_shape
 // closures with captures (integer_pow) and external callers.
 template <class F>
 static NDArray binary_impl(const NDArray& a, const NDArray& b, F f) {
+  // threaded over row chunks for big tensors (full-activation elementwise
+  // chains on multicore serving hosts); grain keeps small ops call-cheap
+  constexpr int64_t kGrain = 1 << 16;
   // fast path: identical shapes
   if (a.shape == b.shape) {
     NDArray out(a.shape);
-    for (size_t i = 0; i < a.data.size(); ++i) out.data[i] = f(a.data[i], b.data[i]);
+    parallel_for(static_cast<int64_t>(a.data.size()), kGrain,
+                 [&](int64_t lo, int64_t hi) {
+                   for (int64_t i = lo; i < hi; ++i)
+                     out.data[i] = f(a.data[i], b.data[i]);
+                 });
     return out;
   }
   // lax binary eqns broadcast size-1 dims at equal rank (plus rank-0 scalars)
   if (b.numel() == 1) {
     NDArray out(a.shape);
-    for (size_t i = 0; i < a.data.size(); ++i) out.data[i] = f(a.data[i], b.data[0]);
+    parallel_for(static_cast<int64_t>(a.data.size()), kGrain,
+                 [&](int64_t lo, int64_t hi) {
+                   for (int64_t i = lo; i < hi; ++i)
+                     out.data[i] = f(a.data[i], b.data[0]);
+                 });
     return out;
   }
   if (a.numel() == 1) {
     NDArray out(b.shape);
-    for (size_t i = 0; i < b.data.size(); ++i) out.data[i] = f(a.data[0], b.data[i]);
+    parallel_for(static_cast<int64_t>(b.data.size()), kGrain,
+                 [&](int64_t lo, int64_t hi) {
+                   for (int64_t i = lo; i < hi; ++i)
+                     out.data[i] = f(a.data[0], b.data[i]);
+                 });
     return out;
   }
   check(a.shape.size() == b.shape.size(), "binary op rank mismatch");
@@ -431,7 +446,10 @@ NDArray binary_op(const NDArray& a, const NDArray& b, BinOp op) {
 template <class F>
 static NDArray unary_impl(const NDArray& x, F f) {
   NDArray out(x.shape);
-  for (size_t i = 0; i < x.data.size(); ++i) out.data[i] = f(x.data[i]);
+  parallel_for(static_cast<int64_t>(x.data.size()), 1 << 16,
+               [&](int64_t lo, int64_t hi) {
+                 for (int64_t i = lo; i < hi; ++i) out.data[i] = f(x.data[i]);
+               });
   return out;
 }
 
