@@ -176,6 +176,34 @@ def overlay_frame(params: Dict[str, jax.Array], rng=None):
         _tls.frame = prev
 
 
+def gather_layer_params(n_layers: int, name_of):
+    """Collect + stack the per-layer parameter arrays of ``n_layers``
+    structurally-identical layers into {suffix: [L, ...]} (the shared
+    front half of scan-over-layers and pipeline stacking). Validates that
+    every layer has the full suffix set, with a structured error."""
+    frame = _current_frame()
+    prefix = "/".join(frame.name_stack)
+    prefix = prefix + "/" if prefix else ""
+    tag0 = f"{prefix}{name_of(0)}/"
+    suffixes = sorted(k[len(tag0):] for k in frame.params if k.startswith(tag0))
+    if not suffixes:
+        raise EnforceError(f"no {tag0}* params in frame")
+    for i in range(n_layers):
+        for s in suffixes:
+            if f"{prefix}{name_of(i)}/{s}" not in frame.params:
+                raise EnforceError(
+                    f"parameter '{prefix}{name_of(i)}/{s}' not found in "
+                    f"provided params; expected {n_layers} identical layers "
+                    "— model structure must match between init and apply"
+                )
+    return {
+        s: jnp.stack(
+            [frame.params[f"{prefix}{name_of(i)}/{s}"] for i in range(n_layers)]
+        )
+        for s in suffixes
+    }
+
+
 def scan_layer_stack(x, n_layers: int, name_of, template: str, body,
                      remat: bool = False):
     """Run ``n_layers`` identical layers as ONE ``lax.scan`` over stacked
@@ -194,28 +222,7 @@ def scan_layer_stack(x, n_layers: int, name_of, template: str, body,
     unrolled loop's frame sequence (loss statistics unaffected).
     """
     frame = _current_frame()
-    prefix = "/".join(frame.name_stack)
-    prefix = prefix + "/" if prefix else ""
-    tag0 = f"{prefix}{name_of(0)}/"
-    suffixes = sorted(k[len(tag0):] for k in frame.params if k.startswith(tag0))
-    if not suffixes:
-        raise EnforceError(f"scan_layer_stack: no {tag0}* params in frame")
-    for i in range(n_layers):
-        for s in suffixes:
-            if f"{prefix}{name_of(i)}/{s}" not in frame.params:
-                raise EnforceError(
-                    f"parameter '{prefix}{name_of(i)}/{s}' not found in "
-                    f"provided params; scan expects {n_layers} identical "
-                    "layers — model structure must match between init and "
-                    "apply"
-                )
-    stacked = {
-        s: jnp.stack(
-            [frame.params[f"{prefix}{name_of(i)}/{s}"] for i in range(n_layers)]
-        )
-        for s in suffixes
-    }
-    xs = {"p": stacked}
+    xs = {"p": gather_layer_params(n_layers, name_of)}
     if frame.rng is not None:
         xs["k"] = jax.random.split(next_rng_key(), n_layers)
 

@@ -195,6 +195,58 @@ def _scan_lm_blocks(x, cfg, seq_lens):
     )
 
 
+def _pipeline_lm_blocks(x, cfg):
+    """Run the layer stack pipeline-parallel over cfg['pipe_mesh']'s
+    ``pipe`` axis: layers split into n_stages contiguous groups, each pipe
+    device owns one group's (stacked) params, and microbatch activations
+    flow stage-to-stage through :func:`parallel.pipeline_apply` (GPipe
+    schedule by ``ppermute``+``scan``; ``cfg['remat']`` gives the 1F1B
+    memory profile). Inside a stage the group runs as a ``lax.scan`` over
+    its layers — the same overlay mechanics as
+    :func:`framework.scan_layer_stack`. Embedding/projection compute stays
+    replicated across pipe ranks (their params are small next to the
+    stack). v1 scope: dense batches (``seq_lens`` unsupported) and
+    deterministic layers (dropout must be 0 — the pipeline body takes no
+    rng stream); both are enforced at dispatch in :func:`lm_forward`.
+    """
+    from paddle_tpu.parallel.pipeline import pipeline_apply, split_microbatches
+
+    mesh = cfg["pipe_mesh"]
+    n_stages = mesh.shape["pipe"]
+    L = cfg["n_layers"]
+    pt.check(
+        L % n_stages == 0,
+        f"pipe parallelism needs n_layers ({L}) divisible by the pipe axis "
+        f"({n_stages})",
+    )
+    lps = L // n_stages
+    # [S, L/S, ...] per suffix: leading dim shards over the pipe axis
+    stacked = {
+        s: v.reshape((n_stages, lps) + v.shape[1:])
+        for s, v in pt.framework.gather_layer_params(
+            L, lambda i: f"layer_{i}"
+        ).items()
+    }
+
+    def stage_fn(stage_params, h):
+        def layer_body(carry, sl):
+            overlay = {f"layer_tpl/{s}": v for s, v in sl.items()}
+            with pt.framework.overlay_frame(overlay):
+                return lm_block(carry, cfg, "layer_tpl", None), None
+
+        h, _ = jax.lax.scan(layer_body, h, stage_params)
+        return h
+
+    n_micro = int(cfg.get("pipe_n_micro") or 2 * n_stages)
+    mbs = split_microbatches(x, n_micro)
+    out = pipeline_apply(
+        stage_fn, stacked, mbs, mesh,
+        # remat matters only for the backward; in eval it is a pure slowdown
+        remat=bool(cfg.get("remat")) and pt.framework.is_training(),
+    )
+    return out.reshape(x.shape)
+
+
 def lm_forward(ids, labels, seq_lens=None, *, cfg):
     """Next-token LM training forward: returns (loss, token_count, logits).
 
@@ -210,7 +262,23 @@ def lm_forward(ids, labels, seq_lens=None, *, cfg):
         cfg["residual_dropout"], name="emb",
         add_position_encoding=cfg.get("pos_encoding", "sinusoid") != "rope",
     )
-    if cfg.get("scan_layers") and not pt.framework.is_initializing():
+    if cfg.get("pipe_mesh") is not None and not pt.framework.is_initializing():
+        pt.check(
+            cfg.get("ring_mesh") is None and cfg.get("ulysses_mesh") is None,
+            "pipe_mesh: sequence parallelism (ring_mesh/ulysses_mesh) does "
+            "not compose with the pipelined path (v1 scope)",
+        )
+        pt.check(seq_lens is None,
+                 "pipe_mesh: ragged seq_lens unsupported in the pipelined "
+                 "path (v1 scope)")
+        pt.check(
+            not (cfg["attn_dropout"] or cfg["relu_dropout"]
+                 or cfg["residual_dropout"]),
+            "pipe_mesh: dropout must be 0 (the pipeline body is "
+            "deterministic; no rng stream threads through the schedule)",
+        )
+        x = _pipeline_lm_blocks(x, cfg)
+    elif cfg.get("scan_layers") and not pt.framework.is_initializing():
         # init stays unrolled (trace-time param creation needs the real
         # per-layer names); apply scans — compile time O(1) in n_layers
         x = _scan_lm_blocks(x, cfg, seq_lens)
@@ -463,6 +531,9 @@ def get_model(
         cfg["ring_mesh"] = ring_mesh
     if ulysses_mesh is not None:
         cfg["ulysses_mesh"] = ulysses_mesh
+    if overrides.get("pipe_mesh") is not None:
+        cfg["pipe_mesh"] = overrides["pipe_mesh"]
+        cfg["pipe_n_micro"] = overrides.get("pipe_n_micro")
 
     model = pt.build(functools.partial(lm_forward, cfg=cfg), name="transformer_lm")
 
