@@ -205,7 +205,7 @@ def gather_layer_params(n_layers: int, name_of):
 
 
 def scan_layer_stack(x, n_layers: int, name_of, template: str, body,
-                     remat: bool = False):
+                     remat: bool = False, with_aux: bool = False):
     """Run ``n_layers`` identical layers as ONE ``lax.scan`` over stacked
     per-layer params (the canonical TPU depth pattern: the body appears
     once in the traced program, so per-instance kernel compilation and
@@ -220,6 +220,9 @@ def scan_layer_stack(x, n_layers: int, name_of, template: str, body,
     body runs under ``jax.checkpoint`` (activation memory O(one layer)).
     Dropout draws per-layer pre-split keys, so the stream differs from the
     unrolled loop's frame sequence (loss statistics unaffected).
+
+    ``with_aux``: body returns ``(x, aux)`` (e.g. MoE router load-balance
+    loss); the call then returns ``(x, summed_aux)``.
     """
     frame = _current_frame()
     xs = {"p": gather_layer_params(n_layers, name_of)}
@@ -229,11 +232,15 @@ def scan_layer_stack(x, n_layers: int, name_of, template: str, body,
     def scan_body(carry, sl):
         overlay = {f"{template}/{s}": v for s, v in sl["p"].items()}
         with overlay_frame(overlay, rng=sl.get("k")):
-            y = body(carry, template)
-        return y, None
+            out = body(carry, template)
+        if with_aux:
+            return out[0], out[1]
+        return out, None
 
     call = jax.checkpoint(scan_body) if remat else scan_body
-    x, _ = jax.lax.scan(call, x, xs)
+    x, ys = jax.lax.scan(call, x, xs)
+    if with_aux:
+        return x, jnp.sum(ys)
     return x
 
 

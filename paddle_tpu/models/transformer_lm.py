@@ -113,6 +113,10 @@ def _with_rope(core):
 
 
 def lm_block(x, cfg, name, kv_len=None):
+    """One decoder block: attention + FFN (dense or mixture-of-experts).
+    Returns ``(x, aux_loss)`` — aux is the router load-balance loss when
+    ``cfg['moe_experts']`` selects an expert-parallel MoE FFN
+    (``parallel/moe.py``), else 0."""
     ring_mesh = cfg.get("ring_mesh")
     ulysses_mesh = cfg.get("ulysses_mesh")
     window = cfg.get("attention_window")
@@ -132,19 +136,30 @@ def lm_block(x, cfg, name, kv_len=None):
             window=cfg.get("attention_window"), kv_len=kv_len,
         )
         x = _post_process(x, attn, cfg["residual_dropout"])
-        ffn = positionwise_ffn(
-            x, cfg["d_inner"], cfg["d_model"], cfg["relu_dropout"],
-            activation=cfg.get("ffn_activation", "relu"),
-        )
-        return _post_process(x, ffn, cfg["residual_dropout"])
+        if cfg.get("moe_experts"):
+            from paddle_tpu.parallel.moe import moe_ffn
+
+            mo = moe_ffn(
+                x, num_experts=cfg["moe_experts"], d_ff=cfg["d_inner"],
+                capacity_factor=cfg.get("moe_capacity_factor", 1.25),
+                router=cfg.get("moe_router", "top1"), name="moe_ffn",
+            )
+            ffn, aux = mo.output, mo.aux_loss
+        else:
+            ffn = positionwise_ffn(
+                x, cfg["d_inner"], cfg["d_model"], cfg["relu_dropout"],
+                activation=cfg.get("ffn_activation", "relu"),
+            )
+            aux = jnp.float32(0.0)
+        return _post_process(x, ffn, cfg["residual_dropout"]), aux
 
 
 def _block_caller(cfg):
-    """Returns ``call(x, name) -> x``; with cfg['remat'] each layer runs
-    under jax.checkpoint — activations recompute in backward, so training
-    memory scales with ONE layer's activations instead of n_layers (the
-    standard long-context trade; transpiler/memory.py holds the
-    named-policy variants). cfg/name are closed over (static); the
+    """Returns ``call(x, name) -> (x, aux)``; with cfg['remat'] each layer
+    runs under jax.checkpoint — activations recompute in backward, so
+    training memory scales with ONE layer's activations instead of
+    n_layers (the standard long-context trade; transpiler/memory.py holds
+    the named-policy variants). cfg/name are closed over (static); the
     framework's trace-time param creation fires inside the checkpointed
     region, which is safe — creation is name-keyed and idempotent across
     the fwd/bwd re-traces."""
@@ -192,6 +207,7 @@ def _scan_lm_blocks(x, cfg, seq_lens):
         "layer_tpl",
         lambda h, name: lm_block(h, cfg, name, seq_lens),
         remat=bool(cfg.get("remat")) and pt.framework.is_training(),
+        with_aux=True,
     )
 
 
@@ -232,7 +248,10 @@ def _pipeline_lm_blocks(x, cfg):
         def layer_body(carry, sl):
             overlay = {f"layer_tpl/{s}": v for s, v in sl.items()}
             with pt.framework.overlay_frame(overlay):
-                return lm_block(carry, cfg, "layer_tpl", None), None
+                # pipe stages carry activations only; MoE (whose aux loss
+                # would be dropped here) is guarded off in lm_forward
+                y, _ = lm_block(carry, cfg, "layer_tpl", None)
+            return y, None
 
         h, _ = jax.lax.scan(layer_body, h, stage_params)
         return h
@@ -262,6 +281,25 @@ def lm_forward(ids, labels, seq_lens=None, *, cfg):
         cfg["residual_dropout"], name="emb",
         add_position_encoding=cfg.get("pos_encoding", "sinusoid") != "rope",
     )
+    if cfg.get("moe_experts"):
+        pt.check(
+            cfg.get("ffn_activation", "relu") == "relu",
+            "moe_experts: expert FFNs are two-layer ReLU; "
+            f"ffn_activation={cfg.get('ffn_activation')!r} is not supported "
+            "in the MoE path (v1 scope)",
+        )
+        pt.check(
+            not cfg["relu_dropout"],
+            "moe_experts: expert FFNs have no dropout; set relu_dropout=0 "
+            "(v1 scope)",
+        )
+        pt.check(
+            seq_lens is None,
+            "moe_experts: ragged seq_lens unsupported with MoE routing — "
+            "pad tokens would consume expert capacity and skew the router "
+            "load-balance statistics (v1 scope)",
+        )
+    aux_total = jnp.float32(0.0)
     if cfg.get("pipe_mesh") is not None and not pt.framework.is_initializing():
         pt.check(
             cfg.get("ring_mesh") is None and cfg.get("ulysses_mesh") is None,
@@ -277,27 +315,34 @@ def lm_forward(ids, labels, seq_lens=None, *, cfg):
             "pipe_mesh: dropout must be 0 (the pipeline body is "
             "deterministic; no rng stream threads through the schedule)",
         )
+        pt.check(not cfg.get("moe_experts"),
+                 "pipe_mesh: MoE FFNs unsupported in the pipelined path "
+                 "(the stage schedule carries activations only, so the "
+                 "router aux loss would be dropped)")
         x = _pipeline_lm_blocks(x, cfg)
     elif cfg.get("scan_layers") and not pt.framework.is_initializing():
         # init stays unrolled (trace-time param creation needs the real
         # per-layer names); apply scans — compile time O(1) in n_layers
-        x = _scan_lm_blocks(x, cfg, seq_lens)
+        x, aux_total = _scan_lm_blocks(x, cfg, seq_lens)
     else:
         block = _block_caller(cfg)
         for i in range(cfg["n_layers"]):
-            x = block(x, name=f"layer_{i}", kv_len=seq_lens)
+            x, aux = block(x, name=f"layer_{i}", kv_len=seq_lens)
+            aux_total = aux_total + aux
     x = layers.layer_norm(x, begin_norm_axis=x.ndim - 1)
     with name_scope("project"):
         logits = _proj(x, cfg["vocab"], shard_out=True, name="logits", bias=False)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    # MoE router load-balance term (0 for dense-FFN configs)
+    aux_term = jnp.float32(cfg.get("moe_aux_weight", 0.01)) * aux_total
     if seq_lens is not None:
         valid = (jnp.arange(labels.shape[1])[None, :] < seq_lens[:, None] - 1)
         valid = valid.astype(jnp.float32)
         n_tok = jnp.maximum(jnp.sum(valid), 1.0)
-        return jnp.sum(nll * valid) / n_tok, n_tok, logits
+        return jnp.sum(nll * valid) / n_tok + aux_term, n_tok, logits
     n_tok = float(np.prod(labels.shape))
-    return jnp.mean(nll), n_tok, logits
+    return jnp.mean(nll) + aux_term, n_tok, logits
 
 
 def generate(
@@ -347,6 +392,11 @@ def generate(
         temperature == 0.0 or rng is not None,
         "generate: sampling (temperature > 0) needs an explicit rng key — "
         "a silent fixed default would return identical 'samples' every call",
+    )
+    enforce(
+        not cfg.get("moe_experts"),
+        "generate: MoE FFNs are not supported in the cached decoders yet — "
+        "decode with lm_forward teacher-forcing, or use a dense-FFN config",
     )
     rope = cfg.get("pos_encoding", "sinusoid") == "rope"
     swiglu = cfg.get("ffn_activation", "relu") == "swiglu"
@@ -512,6 +562,13 @@ BASE_CFG = dict(
     # O(1) in n_layers (see _scan_lm_blocks); dropout stream differs from
     # the unrolled loop, math is otherwise identical
     scan_layers=False,
+    # mixture-of-experts FFN (parallel/moe.py): 0 = dense. Expert weights
+    # shard over the 'expert' mesh axis; the router aux (load-balance) loss
+    # joins the training loss with moe_aux_weight
+    moe_experts=0,
+    moe_router="top1",  # or "top2" (GShard pair dispatch)
+    moe_capacity_factor=1.25,
+    moe_aux_weight=0.01,
 )
 
 
@@ -580,6 +637,11 @@ def generate_beam(
     params = variables.params if hasattr(variables, "params") else variables
     B, Tp = prompt.shape
     enforce(Tp >= 1, "generate_beam needs a non-empty prompt")
+    enforce(
+        not cfg.get("moe_experts"),
+        "generate_beam: MoE FFNs are not supported in the cached decoders "
+        "yet — use a dense-FFN config",
+    )
     T_max = Tp + max_new_tokens
     D, H, L = cfg["d_model"], cfg["num_heads"], cfg["n_layers"]
     dh = D // H
