@@ -120,6 +120,29 @@ def test_moe_expert_parallel_train_step():
     assert np.isfinite(float(out.loss))
 
 
+def test_moe_composes_with_ring_attention():
+    """MoE FFN (expert axis) + ring attention (seq axis) in one LM step on
+    a joint seq x expert x data mesh — EP and CP are orthogonal levers."""
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel import DataParallel
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh = make_mesh(seq=2, expert=2, data=2)
+    spec = models.get_model("transformer_lm", ring_mesh=mesh, **MOE_KW)
+    rng = np.random.RandomState(0)
+    batch = spec.synth_batch(4, rng)
+    trainer = DataParallel(
+        spec.model, spec.optimizer(), mesh=mesh,
+        batch_specs=[P("data", "seq"), P("data", "seq")], donate=False,
+    )
+    v, o = trainer.init(0, *batch)
+    out = trainer.step(v, o, *trainer.put_batch(*batch))
+    assert np.isfinite(float(out.loss))
+
+
 def test_moe_top2_router_trains():
     spec = _spec(moe_router="top2")
     rng = np.random.RandomState(0)
