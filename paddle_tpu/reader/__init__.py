@@ -42,6 +42,7 @@ __all__ = [
     "compose",
     "buffered",
     "firstn",
+    "shard",
     "xmap_readers",
     "multiprocess_reader",
     "batch",
@@ -143,6 +144,37 @@ def firstn(reader: Reader, n: int) -> Reader:
         return itertools.islice(reader(), n)
 
     return reader_n
+
+
+def shard(reader: Reader, num_shards: int, index: int) -> Reader:
+    """The per-process sample slice for multi-host data parallelism: shard
+    ``index`` yields every ``num_shards``-th sample, and only COMPLETE
+    rounds are emitted so every shard sees exactly the same number of
+    samples — a straggler shard would desync the collectives at epoch end.
+    Pair with ``parallel.mesh.initialize_distributed`` (reference analogue:
+    trainer_id-strided dispatch; file-level variant:
+    ``dataset.common.cluster_files_reader``)."""
+    from paddle_tpu.core.enforce import enforce
+
+    enforce(num_shards >= 1, f"num_shards must be >= 1, got {num_shards}")
+    enforce(
+        0 <= index < num_shards,
+        f"shard index {index} out of range for {num_shards} shards",
+    )
+
+    def sharded():
+        # O(1) retained samples: only the index-th of each round is stashed
+        pos = 0
+        mine = None
+        for sample in reader():
+            if pos == index:
+                mine = sample
+            pos += 1
+            if pos == num_shards:
+                yield mine
+                pos, mine = 0, None
+
+    return sharded
 
 
 def xmap_readers(mapper: Callable, reader: Reader, process_num: int, buffer_size: int, order: bool = False) -> Reader:

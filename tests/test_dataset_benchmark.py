@@ -218,3 +218,24 @@ def test_wmt14_contract():
     assert sd[0] == "<s>" and td[1] == "<e>"
     # gen split exists (wmt14.py:149)
     assert len(list(dataset.wmt14.gen(100)())) > 0
+
+
+def test_reader_shard_equal_counts_and_partition():
+    """reader.shard: complete-rounds-only emission — every shard sees the
+    same count, shards partition the kept prefix, order preserved."""
+    from paddle_tpu import reader as rdr
+
+    src = lambda: iter(range(23))  # 23 = 5 full rounds of 4 + remainder 3
+    shards = [list(rdr.shard(src, 4, i)()) for i in range(4)]
+    assert all(len(s) == 5 for s in shards)
+    assert shards[0] == [0, 4, 8, 12, 16]
+    assert shards[3] == [3, 7, 11, 15, 19]
+    assert sorted(sum(shards, [])) == list(range(20))  # remainder dropped
+
+    # single shard is identity
+    assert list(rdr.shard(src, 1, 0)()) == list(range(23))
+
+    import pytest
+
+    with pytest.raises(Exception):
+        rdr.shard(src, 4, 4)
