@@ -108,6 +108,36 @@ def test_scan_dropout_runs_finite():
     assert np.isfinite(gnorm) and gnorm > 0
 
 
+def test_scan_composes_with_flash_route():
+    """The bench lm_large config runs scan_layers WITH the flash flag on
+    chip — pin the composition here: flash-routed attention inside the
+    scanned body (interpret-mode kernels off-TPU) matches the unrolled
+    flash-routed stack, gradients included."""
+    from paddle_tpu.core.config import flags, set_flags
+
+    prev = flags().use_flash_attention
+    set_flags(use_flash_attention=True)
+    try:
+        a = models.get_model("transformer_lm", seq_len=16, vocab=128,
+                             d_model=32, d_inner=64, num_heads=4, n_layers=2,
+                             max_len=32, scan_layers=False)
+        b = models.get_model("transformer_lm", seq_len=16, vocab=128,
+                             d_model=32, d_inner=64, num_heads=4, n_layers=2,
+                             max_len=32, scan_layers=True)
+        rng = np.random.RandomState(0)
+        batch = a.synth_batch(2, rng)
+        va = a.model.init(0, *batch)
+        vb = b.model.init(0, *batch)
+        la, ga = _loss_and_grads(a, va, batch)
+        lb, gb = _loss_and_grads(b, vb, batch)
+        np.testing.assert_allclose(la, lb, rtol=1e-5, atol=1e-6)
+        for k in ga.params:
+            np.testing.assert_allclose(ga.params[k], gb.params[k],
+                                       rtol=2e-4, atol=1e-5, err_msg=k)
+    finally:
+        set_flags(use_flash_attention=prev)
+
+
 def _nmt_pair(**cfg):
     kw = dict(seq_len=12, src_vocab=64, trg_vocab=64, d_model=32, d_inner=64,
               num_heads=4, n_layers=3, max_len=32, attn_dropout=0.0,
