@@ -116,7 +116,9 @@ def test_scan_composes_with_flash_route():
     from paddle_tpu.core.config import flags, set_flags
 
     prev = flags().use_flash_attention
-    set_flags(use_flash_attention=True)
+    prev_bf16 = flags().use_bf16_compute
+    # the exact bench flag set: bf16 MXU compute + flash routing
+    set_flags(use_flash_attention=True, use_bf16_compute=True)
     try:
         a = models.get_model("transformer_lm", seq_len=16, vocab=128,
                              d_model=32, d_inner=64, num_heads=4, n_layers=2,
@@ -130,12 +132,12 @@ def test_scan_composes_with_flash_route():
         vb = b.model.init(0, *batch)
         la, ga = _loss_and_grads(a, va, batch)
         lb, gb = _loss_and_grads(b, vb, batch)
-        np.testing.assert_allclose(la, lb, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(la, lb, rtol=1e-4, atol=1e-5)
         for k in ga.params:
             np.testing.assert_allclose(ga.params[k], gb.params[k],
-                                       rtol=2e-4, atol=1e-5, err_msg=k)
+                                       rtol=5e-3, atol=1e-4, err_msg=k)
     finally:
-        set_flags(use_flash_attention=prev)
+        set_flags(use_flash_attention=prev, use_bf16_compute=prev_bf16)
 
 
 def _nmt_pair(**cfg):
