@@ -139,10 +139,18 @@ def lm_block(x, cfg, name, kv_len=None):
         if cfg.get("moe_experts"):
             from paddle_tpu.parallel.moe import moe_ffn
 
+            # ragged batches: padding tokens are masked out of routing so
+            # they consume no expert capacity and don't skew the balance
+            token_mask = None
+            if kv_len is not None:
+                token_mask = (
+                    jnp.arange(x.shape[-2])[None, :] < kv_len[:, None]
+                )
             mo = moe_ffn(
                 x, num_experts=cfg["moe_experts"], d_ff=cfg["d_inner"],
                 capacity_factor=cfg.get("moe_capacity_factor", 1.25),
                 router=cfg.get("moe_router", "top1"), name="moe_ffn",
+                token_mask=token_mask,
             )
             ffn, aux = mo.output, mo.aux_loss
         else:
@@ -292,12 +300,6 @@ def lm_forward(ids, labels, seq_lens=None, *, cfg):
             not cfg["relu_dropout"],
             "moe_experts: expert FFNs have no dropout; set relu_dropout=0 "
             "(v1 scope)",
-        )
-        pt.check(
-            seq_lens is None,
-            "moe_experts: ragged seq_lens unsupported with MoE routing — "
-            "pad tokens would consume expert capacity and skew the router "
-            "load-balance statistics (v1 scope)",
         )
     aux_total = jnp.float32(0.0)
     if cfg.get("pipe_mesh") is not None and not pt.framework.is_initializing():

@@ -33,22 +33,30 @@ class MoEOutput(NamedTuple):
 
 
 def switch_gate(
-    logits: jax.Array, capacity: int
+    logits: jax.Array, capacity: int,
+    token_mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Top-1 (Switch) routing. ``logits``: [N, E]. Returns
     ``(dispatch [N, E, C] bool, combine [N, E, C] float, aux_loss)``.
 
     Position within each expert's buffer is the token's rank among tokens
-    routed to that expert; ranks >= capacity are dropped.
+    routed to that expert; ranks >= capacity are dropped. ``token_mask``
+    ([N], 1 = real token): masked (padding) tokens are excluded from
+    routing entirely — they consume no expert capacity and do not enter
+    the load-balance statistics.
     """
     N, E = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     expert_idx = jnp.argmax(probs, axis=-1)  # [N]
     expert_mask = jax.nn.one_hot(expert_idx, E, dtype=probs.dtype)  # [N, E]
-
+    # an all-ones mask reproduces the dense jnp.mean statistics exactly
+    tm = (jnp.ones((N,), probs.dtype) if token_mask is None
+          else token_mask.astype(probs.dtype))
+    expert_mask = expert_mask * tm[:, None]
+    n_real = jnp.maximum(jnp.sum(tm), 1.0)
+    density = jnp.sum(expert_mask, axis=0) / n_real
+    density_proxy = jnp.sum(probs * tm[:, None], axis=0) / n_real
     # load-balancing aux loss (Switch eq. 4): E * sum_e f_e * P_e
-    density = jnp.mean(expert_mask, axis=0)  # fraction routed per expert
-    density_proxy = jnp.mean(probs, axis=0)
     aux_loss = E * jnp.sum(density * density_proxy)
 
     # position of each token in its expert's buffer — integer cumsum:
@@ -69,14 +77,15 @@ def switch_gate(
 
 
 def top2_gate(
-    logits: jax.Array, capacity: int
+    logits: jax.Array, capacity: int,
+    token_mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Top-2 (GShard) routing: each token goes to its two highest-prob
     experts, gate weights renormalized over the pair; second-choice tokens
     queue AFTER all first choices in each expert's buffer (GShard's
     priority rule), so overflow drops second choices first. Same
-    ``(dispatch [N,E,C], combine [N,E,C], aux_loss)`` contract as
-    :func:`switch_gate`."""
+    ``(dispatch [N,E,C], combine [N,E,C], aux_loss)`` and ``token_mask``
+    contract as :func:`switch_gate`."""
     N, E = logits.shape
     enforce(E >= 2, f"top2_gate needs >= 2 experts, got {E}")
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
@@ -90,10 +99,15 @@ def top2_gate(
     # capacity slot there; drop the second route entirely in that case
     has2 = (jnp.max(probs2, axis=-1) > 0).astype(probs.dtype)
     mask2 = jax.nn.one_hot(idx2, E, dtype=probs.dtype) * has2[:, None]
-
+    # an all-ones mask reproduces the dense jnp.mean statistics exactly
+    tm = (jnp.ones((N,), probs.dtype) if token_mask is None
+          else token_mask.astype(probs.dtype))
+    mask1 = mask1 * tm[:, None]
+    mask2 = mask2 * tm[:, None]
+    n_real = jnp.maximum(jnp.sum(tm), 1.0)
     # aux loss uses FIRST-choice density (GShard eq. for l_aux)
-    density = jnp.mean(mask1, axis=0)
-    density_proxy = jnp.mean(probs, axis=0)
+    density = jnp.sum(mask1, axis=0) / n_real
+    density_proxy = jnp.sum(probs * tm[:, None], axis=0) / n_real
     aux_loss = E * jnp.sum(density * density_proxy)
 
     # renormalized pair gates
@@ -138,6 +152,7 @@ def moe_ffn(
     act=jax.nn.relu,
     name: Optional[str] = None,
     router: str = "top1",
+    token_mask: Optional[jax.Array] = None,
 ) -> MoEOutput:
     """Expert-parallel FFN layer: ``x`` [B, T, D] (or [N, D]) through
     ``num_experts`` independent two-layer FFNs selected by a router —
@@ -146,14 +161,22 @@ def moe_ffn(
     Per-expert weights are created as [E, D, d_ff] / [E, d_ff, D] with
     sharding ('expert', None, None) — under a mesh with an ``expert`` axis
     the dispatch einsums compile to all_to_all over ICI.
+
+    ``token_mask`` (same leading shape as ``x`` minus the feature dim,
+    1 = real token): ragged batches — padding tokens are excluded from
+    routing (no expert capacity consumed, no load-balance contribution)
+    and their output rows are zero.
     """
     enforce(router in _ROUTERS, f"unknown router {router!r}; known: {sorted(_ROUTERS)}")
     squeeze = x.ndim == 2
     if squeeze:
         x = x[None]
+        if token_mask is not None and token_mask.ndim == 1:
+            token_mask = token_mask[None]
     B, T, D = x.shape
     N = B * T
     tokens = x.reshape(N, D)
+    flat_mask = None if token_mask is None else token_mask.reshape(N)
     gate_fn, routes = _ROUTERS[router]
     capacity = max(1, int(math.ceil(routes * N / num_experts * capacity_factor)))
 
@@ -177,7 +200,9 @@ def moe_ffn(
         )
 
     logits = jnp.matmul(tokens, wg, preferred_element_type=jnp.float32)
-    dispatch, combine, aux = gate_fn(logits.astype(jnp.float32), capacity)
+    dispatch, combine, aux = gate_fn(
+        logits.astype(jnp.float32), capacity, token_mask=flat_mask
+    )
 
     # dispatch: [N, E, C] × [N, D] → expert inputs [E, C, D] (all_to_all #1)
     expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), tokens)

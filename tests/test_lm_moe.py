@@ -181,12 +181,30 @@ def test_moe_unsupported_combinations_rejected():
     b2 = s2.synth_batch(2, rng)
     with pytest.raises(Exception, match="relu_dropout"):
         s2.model.init(jax.random.PRNGKey(0), *b2)
-    # ragged seq_lens
-    s3 = _spec()
-    b3 = s3.synth_batch(2, rng)
-    v3 = s3.model.init(0, *b3)
-    with pytest.raises(Exception, match="seq_lens"):
-        s3.model.apply(v3, *b3, np.array([8, 16], np.int32))
+
+
+def test_moe_ragged_padding_invariance():
+    """With seq_lens, pad-region token ids must be fully invisible: MoE
+    routing masks pads (no expert capacity consumed, no balance-stat
+    contribution), attention masks pad keys, and the loss averages real
+    targets — so scribbling different garbage into the pad region leaves
+    the loss bit-identical. Checked for both routers and under scan."""
+    for router in ("top1", "top2"):
+        for scan in (False, True):
+            spec = _spec(moe_router=router, scan_layers=scan)
+            rng = np.random.RandomState(0)
+            ids, labels = spec.synth_batch(2, rng)
+            seq_lens = np.array([9, 16], np.int32)
+            ids2 = ids.copy()
+            ids2[0, 9:] = (ids2[0, 9:] + 7) % 127 + 1  # different pad garbage
+            v = spec.model.init(0, ids, labels)
+            (l1, *_), _ = spec.model.apply(v, ids, labels, seq_lens)
+            (l2, *_), _ = spec.model.apply(v, ids2, labels, seq_lens)
+            np.testing.assert_allclose(
+                float(l1), float(l2), rtol=0, atol=0,
+                err_msg=f"router={router} scan={scan}",
+            )
+            assert np.isfinite(float(l1))
 
 
 def test_moe_pipeline_rejected_with_clear_error():

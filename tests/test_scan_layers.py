@@ -108,17 +108,21 @@ def test_scan_dropout_runs_finite():
     assert np.isfinite(gnorm) and gnorm > 0
 
 
-def test_scan_composes_with_flash_route():
+import pytest
+
+
+@pytest.mark.parametrize("bf16", [False, True])
+def test_scan_composes_with_flash_route(bf16):
     """The bench lm_large config runs scan_layers WITH the flash flag on
     chip — pin the composition here: flash-routed attention inside the
     scanned body (interpret-mode kernels off-TPU) matches the unrolled
-    flash-routed stack, gradients included."""
+    flash-routed stack, gradients included. bf16=True is the exact bench
+    flag set (looser tolerances); bf16=False keeps the tight-f32 check."""
     from paddle_tpu.core.config import flags, set_flags
 
     prev = flags().use_flash_attention
     prev_bf16 = flags().use_bf16_compute
-    # the exact bench flag set: bf16 MXU compute + flash routing
-    set_flags(use_flash_attention=True, use_bf16_compute=True)
+    set_flags(use_flash_attention=True, use_bf16_compute=bf16)
     try:
         a = models.get_model("transformer_lm", seq_len=16, vocab=128,
                              d_model=32, d_inner=64, num_heads=4, n_layers=2,
@@ -132,10 +136,11 @@ def test_scan_composes_with_flash_route():
         vb = b.model.init(0, *batch)
         la, ga = _loss_and_grads(a, va, batch)
         lb, gb = _loss_and_grads(b, vb, batch)
-        np.testing.assert_allclose(la, lb, rtol=1e-4, atol=1e-5)
+        rtol, atol = (5e-3, 1e-4) if bf16 else (2e-4, 1e-5)
+        np.testing.assert_allclose(la, lb, rtol=max(rtol, 1e-4), atol=atol)
         for k in ga.params:
             np.testing.assert_allclose(ga.params[k], gb.params[k],
-                                       rtol=5e-3, atol=1e-4, err_msg=k)
+                                       rtol=rtol, atol=atol, err_msg=k)
     finally:
         set_flags(use_flash_attention=prev, use_bf16_compute=prev_bf16)
 
