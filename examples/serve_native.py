@@ -11,8 +11,9 @@ The pipeline (reference: ``save_inference_model`` + ``inference/api``):
      them with the register-blocked GEMM microkernel (runtime AVX2/AVX-512
      dispatch), cached packed weights, and fused conv epilogues.
 
-Measured on one core of this container: ResNet-50 bs16 = 7.0 img/s —
-130% of the reference's MKL-DNN per-core anchor (IntelOptimizedPaddle.md).
+Measured on one core of this container: ResNet-50 bs16 = 5.5 img/s
+kernel-only and 7.1 img/s with this BN-fold recipe — 102% / 132% of the
+reference's MKL-DNN per-core anchor (IntelOptimizedPaddle.md).
 
     python examples/serve_native.py
 """

@@ -366,10 +366,21 @@ def as_reader(channel: Channel) -> Callable[[], Iterable]:
     """Adapt a channel into a reader factory: each call returns an iterable
     draining the channel until it closes. Composes with
     ``reader.stack_batch`` and ``reader.DevicePrefetcher`` so a goroutine
-    producer can feed the device input pipeline."""
+    producer can feed the device input pipeline.
+
+    If the producer recorded a failure (``channel.error``, set by
+    :func:`from_reader`), it re-raises AFTER the drain — the same
+    ExceptionHolder-style propagation as the rest of the reader stack, so
+    a dying producer cannot silently truncate an epoch."""
 
     def _reader():
-        return iter(channel)
+        def gen():
+            for value in channel:
+                yield value
+            if channel.error is not None:
+                raise channel.error
+
+        return gen()
 
     return _reader
 

@@ -302,6 +302,9 @@ def lm_forward(ids, labels, seq_lens=None, *, cfg):
             "(v1 scope)",
         )
     aux_total = jnp.float32(0.0)
+    # dispatch precedence: pipe_mesh subsumes scan_layers (each pipe stage
+    # already runs its layer group as a lax.scan — see _pipeline_lm_blocks),
+    # so setting both is harmless and scan_layers adds nothing under pipe
     if cfg.get("pipe_mesh") is not None and not pt.framework.is_initializing():
         pt.check(
             cfg.get("ring_mesh") is None and cfg.get("ulysses_mesh") is None,
@@ -336,8 +339,14 @@ def lm_forward(ids, labels, seq_lens=None, *, cfg):
         logits = _proj(x, cfg["vocab"], shard_out=True, name="logits", bias=False)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    # MoE router load-balance term (0 for dense-FFN configs)
-    aux_term = jnp.float32(cfg.get("moe_aux_weight", 0.01)) * aux_total
+    # MoE router load-balance term (0 for dense-FFN configs) — a TRAINING
+    # regularizer only: eval loss must stay the pure NLL so perplexity and
+    # dense-baseline comparisons are unbiased
+    aux_term = (
+        jnp.float32(cfg.get("moe_aux_weight", 0.01)) * aux_total
+        if pt.framework.is_training()
+        else jnp.float32(0.0)
+    )
     if seq_lens is not None:
         valid = (jnp.arange(labels.shape[1])[None, :] < seq_lens[:, None] - 1)
         valid = valid.astype(jnp.float32)

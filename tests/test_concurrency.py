@@ -261,6 +261,23 @@ def test_from_reader_records_producer_error():
     assert isinstance(ch.error, ValueError)
 
 
+def test_as_reader_reraises_producer_error():
+    """A dying producer must FAIL the consuming pipeline, not silently
+    truncate the epoch (ExceptionHolder-style propagation, like the rest
+    of the reader stack)."""
+    def bad_source():
+        yield 1
+        yield 2
+        raise ValueError("boom")
+
+    ch = cc.from_reader(bad_source, capacity=4)
+    it = cc.as_reader(ch)()
+    assert next(it) == 1
+    assert next(it) == 2
+    with pytest.raises(ValueError, match="boom"):
+        next(it)
+
+
 def test_from_reader_consumer_closes_early():
     produced = []
 

@@ -52,13 +52,20 @@ def test_moe_aux_loss_reaches_total_and_gate_gets_grads():
     batch = spec.synth_batch(4, rng)
     v = spec.model.init(0, *batch)
 
-    # aux weight changes the total loss -> the aux term is really wired in
-    (l0, *_), _ = spec.model.apply(v, *batch)
+    # aux weight changes the TRAINING loss -> the aux term is really wired in
+    (l0, *_), _ = spec.model.apply(v, *batch, is_train=True)
     cfg1 = dict(spec.extra["cfg"])
     cfg1["moe_aux_weight"] = 1.0
     model1 = pt.build(functools.partial(transformer_lm.lm_forward, cfg=cfg1))
-    (l1, *_), _ = model1.apply(v, *batch)
+    (l1, *_), _ = model1.apply(v, *batch, is_train=True)
     assert float(l1) > float(l0)  # the balance aux is ~1 at init, scaled up
+
+    # eval loss is the PURE NLL: the aux regularizer must not bias
+    # perplexity or dense-baseline comparisons
+    (le, *_), _ = spec.model.apply(v, *batch, is_train=False)
+    (le1, *_), _ = model1.apply(v, *batch, is_train=False)
+    np.testing.assert_allclose(float(le), float(le1), rtol=0, atol=0)
+    assert float(le) < float(l0)  # train total includes the aux term
 
     # gate weights receive gradients
     def loss_fn(vv):

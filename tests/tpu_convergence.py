@@ -43,7 +43,7 @@ if "--cpu-mesh" in sys.argv:
     _PROGRESS = [time.monotonic()]
 else:
     # armed BEFORE the jax import in main(): backend init can hang too
-    _PROGRESS = _stall_watchdog.install("CONVERGENCE", "PT_CONV_STALL_S", 360)
+    _PROGRESS = _stall_watchdog.install("CONVERGENCE", "PT_CONV_STALL_S", 600)
 
 
 def _tick():

@@ -23,7 +23,7 @@ sys.path.insert(0, _REPO)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import _stall_watchdog  # noqa: E402
 
-_PROGRESS = _stall_watchdog.install("FLASH_TUNE", "PT_TUNE_STALL_S", 300)
+_PROGRESS = _stall_watchdog.install("FLASH_TUNE", "PT_TUNE_STALL_S", 480)
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402

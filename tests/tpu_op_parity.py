@@ -24,7 +24,7 @@ _T0 = time.monotonic()
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import _stall_watchdog  # noqa: E402
 
-_PROGRESS = _stall_watchdog.install("OP_PARITY", "PT_OPPARITY_STALL_S", 300)
+_PROGRESS = _stall_watchdog.install("OP_PARITY", "PT_OPPARITY_STALL_S", 480)
 
 
 def _write(out: dict) -> None:

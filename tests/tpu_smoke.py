@@ -43,7 +43,7 @@ _T0 = time.monotonic()
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import _stall_watchdog  # noqa: E402
 
-_LAST_PROGRESS = _stall_watchdog.install("SMOKE", "PT_SMOKE_STALL_S", 300)
+_LAST_PROGRESS = _stall_watchdog.install("SMOKE", "PT_SMOKE_STALL_S", 480)
 
 
 def _left() -> float:

@@ -27,6 +27,10 @@ def main():
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--depth", type=int, default=50)
     ap.add_argument("--threads", type=int, default=0, help="0 = all cores")
+    ap.add_argument("--no-bn-fold", action="store_true",
+                    help="skip fuse_batch_norm (the r4-early 1.64 img/s "
+                         "baseline config; default applies the documented "
+                         "serving recipe)")
     args = ap.parse_args()
     if args.threads:
         os.environ["PT_NATIVE_THREADS"] = str(args.threads)
@@ -45,10 +49,11 @@ def main():
     rng = np.random.RandomState(0)
     x = rng.rand(args.bs, 224, 224, 3).astype(np.float32)
     variables = net.init(0, x)
-    # the documented serving recipe: fold BN into conv weights so the
-    # export-time identity elimination removes all BN arithmetic (the
-    # reference's inference_transpiler step precedes its MKL-DNN numbers)
-    variables = pt.transpiler.inference.fuse_batch_norm(variables)
+    if not args.no_bn_fold:
+        # the documented serving recipe: fold BN into conv weights so the
+        # export-time identity elimination removes all BN arithmetic (the
+        # reference's inference_transpiler step precedes its MKL-DNN numbers)
+        variables = pt.transpiler.inference.fuse_batch_norm(variables)
 
     with tempfile.TemporaryDirectory() as td:
         save_native_model(net, variables, [x], td)
