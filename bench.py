@@ -271,11 +271,23 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
                 )
                 Tp, N, bss = 16, 8, (1, 2)
             else:
-                dspec = models.get_model("transformer_lm", seq_len=512)
+                # scan_layers: the decode jit compiles one scanned layer
+                # body instead of L unrolled ones per (bs, mnt) variant
+                dspec = models.get_model("transformer_lm", seq_len=512,
+                                         scan_layers=True)
                 Tp, N, bss = 128, 64, (1, 8, 32)
             dcfg = dspec.extra["cfg"]
             drng = np.random.RandomState(0)
             dvars = dspec.model.init(0, *dspec.synth_batch(1, drng))
+            # artifacts stay self-describing: the decode config changed to
+            # scan_layers in r4 — numbers are not comparable across the flag
+            result["decode_scan_layers"] = bool(dcfg.get("scan_layers"))
+            # stack once outside jit (closed over as a constant): per-call
+            # re-stacking would copy the full parameter set per decode
+            dstacked = (
+                transformer_lm.stack_decode_params(dvars, dcfg)
+                if dcfg.get("scan_layers") else None
+            )
 
             def time_gen(bs, mnt, **gen_kw):
                 prompt = jnp.asarray(
@@ -283,7 +295,7 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
                 )
                 fn = jax.jit(functools.partial(
                     transformer_lm.generate, max_new_tokens=mnt, cfg=dcfg,
-                    **gen_kw,
+                    stacked_params=dstacked, **gen_kw,
                 ))
                 o = fn(dvars, prompt)
                 int(jax.device_get(o[0, -1]))

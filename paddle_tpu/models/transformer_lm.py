@@ -35,7 +35,8 @@ from paddle_tpu.models.transformer import (
     prepare_embedding,
 )
 
-__all__ = ["get_model", "lm_forward", "generate", "generate_beam", "BASE_CFG"]
+__all__ = ["get_model", "lm_forward", "generate", "generate_beam",
+           "stack_decode_params", "BASE_CFG"]
 
 
 def _ring_core(ring_mesh, window=None):
@@ -356,6 +357,23 @@ def lm_forward(ids, labels, seq_lens=None, *, cfg):
     return jnp.mean(nll) + aux_term, n_tok, logits
 
 
+def stack_decode_params(variables_or_params, cfg: dict) -> dict:
+    """Stack the per-layer parameter arrays for ``scan_layers`` decode:
+    {suffix: [L, ...]}. Call ONCE outside the jitted decode (or let jit
+    close over the result) so the stack is not re-copied per call; pass to
+    :func:`generate` as ``stacked_params``."""
+    params = (variables_or_params.params
+              if hasattr(variables_or_params, "params") else variables_or_params)
+    L = cfg["n_layers"]
+    sfx = sorted(
+        {k[len("layer_0/"):] for k in params if k.startswith("layer_0/")}
+    )
+    return {
+        s: jnp.stack([params[f"layer_{i}/{s}"] for i in range(L)])
+        for s in sfx
+    }
+
+
 def generate(
     variables,
     prompt: jax.Array,
@@ -366,6 +384,7 @@ def generate(
     top_k: int | None = None,
     top_p: float | None = None,
     cache_dtype=None,
+    stacked_params: dict | None = None,
 ) -> jax.Array:
     """Autoregressive decode with a static k/v cache — prefill once over the
     prompt, then one ``lax.scan`` step per new token (single compile, no
@@ -419,7 +438,23 @@ def generate(
         rope_cos, rope_sin = rope_tables(dh, max(cfg["max_len"], T_max))
     scale = 1.0 / np.sqrt(dh)
 
+    # scan-over-layers decode (cfg['scan_layers']): layer params stack to
+    # [L, ...] by suffix and the per-token layer loop runs as a lax.scan;
+    # inside the scan body the block's name-based lookups resolve through
+    # ``scan_view`` via the reserved 'layer_SCAN/' prefix (the decode-side
+    # analogue of framework.scan_layer_stack — compile cost O(1) in depth)
+    scan_layers = bool(cfg.get("scan_layers"))
+    scan_view: dict = {}
+    if scan_layers:
+        # prefer a caller-prestacked tree (stack_decode_params, built once
+        # OUTSIDE jit / closed over by it) — stacking here would copy the
+        # full parameter set on every jitted decode call
+        stacked = (stacked_params if stacked_params is not None
+                   else stack_decode_params(params, cfg))
+
     def p(name):
+        if name.startswith("layer_SCAN/"):
+            return scan_view[name[len("layer_SCAN/"):]]
         return params[name]
 
     def ln(x, pfx):
@@ -501,20 +536,58 @@ def generate(
     vc0 = jnp.zeros((L, B, H_kv, T_max, dh), cdt)
     caches = {"k": kc0, "v": vc0}
 
-    def prefill_attend(q, k, v, i):
-        caches["k"] = caches["k"].at[i, :, :, :Tp].set(k.astype(cdt))
-        caches["v"] = caches["v"].at[i, :, :, :Tp].set(v.astype(cdt))
-        # sdpa routes long prompts through the flash kernel when the flag is
-        # on (no [Tp, Tp] materialization) and composes the identical
-        # causal+window einsum math otherwise — same path as the training
-        # forward, so decode-vs-forward stays exact
-        from paddle_tpu.ops.attention import scaled_dot_product_attention
+    # sdpa routes long prompts through the flash kernel when the flag is
+    # on (no [Tp, Tp] materialization) and composes the identical
+    # causal+window einsum math otherwise — same path as the training
+    # forward, so decode-vs-forward stays exact
+    from paddle_tpu.ops.attention import scaled_dot_product_attention
 
-        return scaled_dot_product_attention(q, k, v, causal=True, window=window)
+    def run_layer_scan(x0, kc, vc, pos0, make_attend):
+        """The shared layer-scan body for scan_layers prefill AND decode:
+        repopulate the scan_view overlay from the stacked slice, run the
+        block with an attend built for this layer index, carry caches."""
+        def body(carry, sl):
+            y, kc, vc = carry
+            scan_view.clear()
+            scan_view.update(sl["p"])
+            li = sl["i"]
 
-    x = embed(prompt, 0)
-    for i in range(L):
-        x = block(x, i, prefill_attend, pos0=0)
+            def attend(q, k, v, _i):
+                nonlocal kc, vc
+                ctx, kc, vc = make_attend(q, k, v, li, kc, vc)
+                return ctx
+
+            y = block(y, "SCAN", attend, pos0=pos0)
+            return (y, kc, vc), None
+
+        return jax.lax.scan(
+            body, (x0, kc, vc), {"p": stacked, "i": jnp.arange(L)}
+        )[0]
+
+    if scan_layers:
+        def prefill_write(q, k, v, li, kc, vc):
+            kc = kc.at[li, :, :, :Tp].set(k.astype(cdt))
+            vc = vc.at[li, :, :, :Tp].set(v.astype(cdt))
+            ctx = scaled_dot_product_attention(
+                q, k, v, causal=True, window=window
+            )
+            return ctx, kc, vc
+
+        x, kc_f, vc_f = run_layer_scan(
+            embed(prompt, 0), kc0, vc0, 0, prefill_write
+        )
+        caches = {"k": kc_f, "v": vc_f}
+    else:
+        def prefill_attend(q, k, v, i):
+            caches["k"] = caches["k"].at[i, :, :, :Tp].set(k.astype(cdt))
+            caches["v"] = caches["v"].at[i, :, :, :Tp].set(v.astype(cdt))
+            return scaled_dot_product_attention(
+                q, k, v, causal=True, window=window
+            )
+
+        x = embed(prompt, 0)
+        for i in range(L):
+            x = block(x, i, prefill_attend, pos0=0)
     first_key, scan_rng = (
         jax.random.split(rng) if rng is not None else (None, None)
     )
@@ -526,18 +599,33 @@ def generate(
         t = Tp + s  # position of this token
         xt = embed(tok[:, None], t)  # [B, 1, D] — pos0 is traced; ok for slice
 
-        def attend(q, k, v, i):
-            nonlocal kc, vc
-            kc = jax.lax.dynamic_update_slice(kc, k[None].astype(cdt), (i, 0, 0, t, 0))
-            vc = jax.lax.dynamic_update_slice(vc, v[None].astype(cdt), (i, 0, 0, t, 0))
-            s_ = jnp.einsum("bkgqd,bktd->bkgqt", grouped(q), kc[i]) * scale
+        def cached_attend(q, k, v, li, kc, vc):
+            """One token's attention against layer ``li``'s cache rows
+            (li may be traced under the layer scan); returns the updated
+            caches alongside the context."""
+            kc = jax.lax.dynamic_update_slice(kc, k[None].astype(cdt), (li, 0, 0, t, 0))
+            vc = jax.lax.dynamic_update_slice(vc, v[None].astype(cdt), (li, 0, 0, t, 0))
+            kci = jax.lax.dynamic_index_in_dim(kc, li, 0, keepdims=False)
+            vci = jax.lax.dynamic_index_in_dim(vc, li, 0, keepdims=False)
+            s_ = jnp.einsum("bkgqd,bktd->bkgqt", grouped(q), kci) * scale
             live = _live_mask(T_max, t, window)
             s_ = jnp.where(live[None, None, None, None, :], s_, -1e9)
-            return ungrouped(jnp.einsum("bkgqt,bktd->bkgqd", jax.nn.softmax(s_, -1), vc[i]))
+            ctx = ungrouped(
+                jnp.einsum("bkgqt,bktd->bkgqd", jax.nn.softmax(s_, -1), vci)
+            )
+            return ctx, kc, vc
 
-        y = xt
-        for i in range(L):
-            y = block(y, i, attend, pos0=t)
+        if scan_layers:
+            y, kc, vc = run_layer_scan(xt, kc, vc, t, cached_attend)
+        else:
+            def attend_i(q, k, v, i):
+                nonlocal kc, vc
+                ctx, kc, vc = cached_attend(q, k, v, i, kc, vc)
+                return ctx
+
+            y = xt
+            for i in range(L):
+                y = block(y, i, attend_i, pos0=t)
         if key is not None:
             key, sub = jax.random.split(key)
         else:

@@ -185,8 +185,8 @@ def test_nmt_scan_eval_logits_match():
 
 
 def test_scan_decode_parity():
-    """generate() (its own cache loop, unaffected by the flag) decodes the
-    same tokens from scan-mode and unrolled-mode params."""
+    """generate() honors scan_layers (prefill AND per-token layer loops run
+    as lax.scan): decoded tokens match the unrolled decode exactly."""
     a, b, va, vb, batch = _pair()
     prompt = jnp.asarray(
         np.random.RandomState(3).randint(1, 128, size=(2, 5)).astype(np.int32)
@@ -195,4 +195,19 @@ def test_scan_decode_parity():
     cfg_b = b.extra["cfg"]
     ta = transformer_lm.generate(va, prompt, max_new_tokens=6, cfg=cfg_a)
     tb = transformer_lm.generate(vb, prompt, max_new_tokens=6, cfg=cfg_b)
+    np.testing.assert_array_equal(np.asarray(ta), np.asarray(tb))
+
+
+def test_scan_decode_parity_modern_stack():
+    """Scanned decode through rope x GQA x swiglu x sliding-window — the
+    full cached-decode feature matrix under the layer scan."""
+    a, b, va, vb, batch = _pair(pos_encoding="rope", num_kv_heads=2,
+                                ffn_activation="swiglu", attention_window=8)
+    prompt = jnp.asarray(
+        np.random.RandomState(5).randint(1, 128, size=(2, 7)).astype(np.int32)
+    )
+    ta = transformer_lm.generate(va, prompt, max_new_tokens=5,
+                                 cfg=a.extra["cfg"])
+    tb = transformer_lm.generate(vb, prompt, max_new_tokens=5,
+                                 cfg=b.extra["cfg"])
     np.testing.assert_array_equal(np.asarray(ta), np.asarray(tb))
