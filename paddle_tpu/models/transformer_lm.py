@@ -728,6 +728,10 @@ def generate_beam(
     token is prefilled into the cache, each row's last prompt token seeds
     its beams, and every scan step attends against cache[0..t]. Same decode
     math as ``generate`` (same param names/ops); GQA cache layout included.
+    The layer loop stays unrolled here (``cfg['scan_layers']`` affects
+    training and :func:`generate` only): beam caches put the layer axis at
+    dim 1 to keep beam tiling on dim 0, and beam decode is not a benched
+    hot path — the exact-match tests pin it against ``generate`` instead.
     """
     from paddle_tpu.core.enforce import enforce
     from paddle_tpu.models.transformer import sinusoid_position_encoding
