@@ -195,6 +195,7 @@ _FOLD_UNARY = {
     "floor": np.floor,
     "ceil": np.ceil,
     "sign": np.sign,
+    "square": np.square,
 }
 _FOLD_BINARY = {
     "add": np.add,
@@ -330,6 +331,8 @@ def _emit_eqn(em: _Emitter, eqn) -> None:
 
     if prim == "add_any":  # grad accumulation (lax.add_any) == add
         em.op("add", out, ins)
+    elif prim == "square":  # x*x — no dedicated interpreter op needed
+        em.op("mul", out, [ins[0], ins[0]])
     elif prim in _BINARY:
         em.op(prim, out, ins)
     elif prim in _UNARY:

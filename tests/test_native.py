@@ -403,3 +403,54 @@ def test_export_folded_bn_has_no_bn_arithmetic(tmp_path, rng):
     pred = NativePredictor(out_dir)
     ref, _ = model.apply(variables, jnp.asarray(x), is_train=False)
     np.testing.assert_allclose(pred.run(x)[0], np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_native_predictor_transformer_nmt(tmp_path):
+    """The NMT transformer eval forward (multi-head attention, layer norm,
+    label-smoothed CE) through the native predictor — the serving path
+    covers the attention model families, not just convnets."""
+    from paddle_tpu import models
+
+    spec = models.get_model(
+        "transformer", seq_len=12, src_vocab=64, trg_vocab=64, d_model=32,
+        d_inner=64, num_heads=4, n_layers=2, max_len=32,
+        attn_dropout=0.0, relu_dropout=0.0, residual_dropout=0.0,
+    )
+    nprng = np.random.RandomState(3)
+    batch = spec.synth_batch(2, nprng)
+    v = spec.model.init(0, *batch)
+    out_dir = str(tmp_path / "nmt")
+    save_native_model(spec.model, v, list(batch), out_dir)
+    outs = NativePredictor(out_dir).run(*[np.asarray(b) for b in batch])
+    (ref_loss, ref_ntok, ref_logits), _ = spec.model.apply(v, *batch, is_train=False)
+    np.testing.assert_allclose(float(outs[0]), float(ref_loss), rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(outs[2], np.asarray(ref_logits), rtol=2e-3, atol=2e-4)
+
+
+def test_native_predictor_transformer_lm(tmp_path):
+    """The causal LM serving path (ids -> next-token logits) through the
+    native predictor; the training-only loss ops (batched-gather
+    take_along_axis) DCE away because they don't reach the exported
+    output."""
+    from paddle_tpu import models
+
+    spec = models.get_model(
+        "transformer_lm", seq_len=12, vocab=64, d_model=32, d_inner=64,
+        num_heads=4, n_layers=2, max_len=32,
+    )
+    nprng = np.random.RandomState(4)
+    ids, labels = spec.synth_batch(2, nprng)
+    v = spec.model.init(0, ids, labels)
+
+    def logits_fn(ids_in):
+        (_, _, logits), _ = spec.model.apply(v, ids_in, labels, is_train=False)
+        return logits
+
+    out_dir = str(tmp_path / "lm")
+    export_program(logits_fn, [ids], out_dir)
+    (native_logits,) = NativePredictor(out_dir).run(np.asarray(ids))
+    ref_logits = np.asarray(logits_fn(jnp.asarray(ids)))
+    np.testing.assert_allclose(native_logits, ref_logits, rtol=2e-3, atol=2e-4)
+    np.testing.assert_array_equal(
+        native_logits[:, -1].argmax(-1), ref_logits[:, -1].argmax(-1)
+    )
