@@ -3,6 +3,11 @@ int8 weight-only quantization (~4x smaller artifact).
 
 Run: python examples/serve_quantized.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
 import numpy as np
 

@@ -5,6 +5,11 @@ decode with the cached generate().
 
 Run: python examples/train_lm_flash.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
 import numpy as np
 

@@ -6,6 +6,11 @@ Run: python examples/train_lm_modern.py            (single chip / CPU)
      XLA_FLAGS=--xla_force_host_platform_device_count=8 \
          JAX_PLATFORMS=cpu python examples/train_lm_modern.py   (8-dev mesh)
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
 import numpy as np
 

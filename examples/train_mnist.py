@@ -3,6 +3,11 @@ build -> init -> minimize -> Executor-style loop -> save for serving.
 
 Run: python examples/train_mnist.py  (CPU or TPU; ~30s on CPU)
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
 import numpy as np
 
