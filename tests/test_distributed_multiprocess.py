@@ -72,8 +72,15 @@ ostate = opt.create_state(v.params)
 
 xsh = NamedSharding(mesh, P("data", None))
 ysh = NamedSharding(mesh, P("data"))
-lx = gx[pid * 4:(pid + 1) * 4]
-ly = gy[pid * 4:(pid + 1) * 4]
+# multi-host input pipeline: every process reads the SAME stream and takes
+# its round-robin slice (reader.shard — complete rounds only, so counts
+# match across processes). Loss/grads are row-order invariant, so the
+# baseline comparison stays bit-exact.
+from paddle_tpu import reader as rdr
+rows = list(rdr.shard(lambda: iter(zip(gx, gy)), nproc, pid)())
+lx = np.stack([r[0] for r in rows])
+ly = np.stack([r[1] for r in rows])
+assert lx.shape == (4, 3), lx.shape
 gxa = jax.make_array_from_process_local_data(xsh, lx, (8, 3))
 gya = jax.make_array_from_process_local_data(ysh, ly, (8,))
 
