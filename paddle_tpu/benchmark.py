@@ -31,6 +31,9 @@ BENCHMARK_MODELS = [
     "mnist",
     "stacked_dynamic_lstm",
     "transformer",
+    # decoder-only LM: the long-context flagship (not in the reference's
+    # benchmark set — its list ends at the NMT transformer)
+    "transformer_lm",
 ]
 
 
@@ -64,6 +67,13 @@ def parse_args(argv=None):
                         help="'collective'/'nccl2': initialize multi-host distributed mesh")
     parser.add_argument("--no_random", action="store_true")
     parser.add_argument("--json", action="store_true", help="print one JSON line per pass")
+    parser.add_argument("--scan_layers", action="store_true",
+                        help="transformer/transformer_lm: compile the layer "
+                             "stack as one lax.scan body (O(1)-in-depth "
+                             "compile; see models.transformer_lm)")
+    parser.add_argument("--moe_experts", type=int, default=0,
+                        help="transformer_lm: expert-parallel MoE FFN with "
+                             "this many experts (0 = dense)")
     return parser.parse_args(argv)
 
 
@@ -145,6 +155,12 @@ def run_benchmark(args) -> dict:
             model_cfg.update(image_size=32, class_dim=10)
         elif args.data_set == "flowers":
             model_cfg.update(image_size=224, class_dim=102)
+    if getattr(args, "scan_layers", False) and args.model in (
+        "transformer", "transformer_lm"
+    ):
+        model_cfg["scan_layers"] = True
+    if getattr(args, "moe_experts", 0) and args.model == "transformer_lm":
+        model_cfg["moe_experts"] = args.moe_experts
     spec = models.get_model(args.model, **model_cfg)
     rng = np.random.RandomState(0 if args.no_random else None)
     batch = _make_batch(args, spec, rng)

@@ -239,3 +239,40 @@ def test_reader_shard_equal_counts_and_partition():
 
     with pytest.raises(Exception):
         rdr.shard(src, 4, 4)
+
+
+def test_benchmark_cli_scan_and_moe_flags(monkeypatch):
+    """--scan_layers / --moe_experts reach get_model for the transformer
+    families (plumbing check; default-size configs are TPU-scale, so the
+    full pass is exercised on-chip, not here)."""
+    import paddle_tpu.benchmark as B
+    from paddle_tpu import models
+
+    captured = {}
+
+    class _Abort(Exception):
+        pass
+
+    def fake_get_model(name, **cfg):
+        captured[name] = cfg
+        raise _Abort
+
+    monkeypatch.setattr(models, "get_model", fake_get_model)
+    args = B.parse_args([
+        "--model", "transformer_lm", "--device", "CPU",
+        "--scan_layers", "--moe_experts", "4",
+    ])
+    try:
+        B.run_benchmark(args)
+    except _Abort:
+        pass
+    cfg = captured["transformer_lm"]
+    assert cfg["scan_layers"] is True and cfg["moe_experts"] == 4
+
+    args2 = B.parse_args(["--model", "resnet", "--device", "CPU",
+                          "--scan_layers"])
+    try:
+        B.run_benchmark(args2)
+    except _Abort:
+        pass
+    assert "scan_layers" not in captured["resnet"]  # image models: no-op
