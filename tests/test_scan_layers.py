@@ -198,6 +198,24 @@ def test_scan_decode_parity():
     np.testing.assert_array_equal(np.asarray(ta), np.asarray(tb))
 
 
+def test_scan_decode_bf16_cache_and_prestacked():
+    """The exact bench decode path: scanned decode with an explicitly
+    prestacked param tree (stack_decode_params, built outside jit) and the
+    bf16 KV cache — tokens match the unrolled decode with the same cache
+    dtype."""
+    a, b, va, vb, batch = _pair()
+    prompt = jnp.asarray(
+        np.random.RandomState(11).randint(1, 128, size=(2, 6)).astype(np.int32)
+    )
+    stacked = transformer_lm.stack_decode_params(vb, b.extra["cfg"])
+    ta = transformer_lm.generate(va, prompt, max_new_tokens=5,
+                                 cfg=a.extra["cfg"], cache_dtype=jnp.bfloat16)
+    tb = transformer_lm.generate(vb, prompt, max_new_tokens=5,
+                                 cfg=b.extra["cfg"], cache_dtype=jnp.bfloat16,
+                                 stacked_params=stacked)
+    np.testing.assert_array_equal(np.asarray(ta), np.asarray(tb))
+
+
 def test_scan_decode_parity_modern_stack():
     """Scanned decode through rope x GQA x swiglu x sliding-window — the
     full cached-decode feature matrix under the layer scan."""
