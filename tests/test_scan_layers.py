@@ -229,3 +229,43 @@ def test_scan_decode_parity_modern_stack():
     tb = transformer_lm.generate(vb, prompt, max_new_tokens=5,
                                  cfg=b.extra["cfg"])
     np.testing.assert_array_equal(np.asarray(ta), np.asarray(tb))
+
+
+def test_bench_lm_large_config_traces():
+    """bench.py's lm_large section (scan_layers + the MFU-representative
+    d_model=1024 / 12-layer / T=2048 config) only executes on a chip —
+    trace its full train step abstractly here (jax.eval_shape: no compile)
+    so a config/shape bug can't wait for a scarce tunnel window to
+    surface. Runs with the bench's flag set (bf16 + flash routing)."""
+    import jax
+
+    from paddle_tpu.core.config import flags, set_flags
+
+    prev_f = flags().use_flash_attention
+    prev_b = flags().use_bf16_compute
+    set_flags(use_flash_attention=True, use_bf16_compute=True)
+    try:
+        spec = models.get_model(
+            "transformer_lm", seq_len=2048, d_model=1024, d_inner=4096,
+            num_heads=16, n_layers=12, max_len=2048, scan_layers=True,
+        )
+        rng = np.random.RandomState(0)
+        batch = spec.synth_batch(2, rng)
+        v = jax.eval_shape(lambda: spec.model.init(0, *batch))
+        # init must be traced for real to get params; eval_shape of init is
+        # enough for the step's structure since shapes are all that matter
+        import jax.numpy as jnp_
+
+        v_real = jax.tree_util.tree_map(
+            lambda s: jnp_.zeros(s.shape, s.dtype), v
+        )
+        opt = spec.optimizer()
+        o = opt.create_state(v_real.params)
+        out = jax.eval_shape(
+            opt.minimize(spec.model), v_real, o, *batch,
+            rng=jax.random.PRNGKey(0),
+        )
+        assert out.loss.shape == ()
+        assert set(out.variables.params) == set(v_real.params)
+    finally:
+        set_flags(use_flash_attention=prev_f, use_bf16_compute=prev_b)
