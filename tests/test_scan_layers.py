@@ -269,3 +269,52 @@ def test_bench_lm_large_config_traces():
         assert set(out.variables.params) == set(v_real.params)
     finally:
         set_flags(use_flash_attention=prev_f, use_bf16_compute=prev_b)
+
+
+def test_bench_decode_and_transformer_configs_trace():
+    """The bench decode section (seq-512 LM, scanned, prestacked params,
+    Tp=128 prompt) and transformer section (default NMT, scanned) also run
+    only on-chip — abstract-trace both so their configs can't break
+    unnoticed."""
+    import functools
+
+    import jax
+
+    from paddle_tpu.core.config import flags, set_flags
+
+    prev_f = flags().use_flash_attention
+    prev_b = flags().use_bf16_compute
+    set_flags(use_flash_attention=True, use_bf16_compute=True)
+    try:
+        # decode section
+        dspec = models.get_model("transformer_lm", seq_len=512,
+                                 scan_layers=True)
+        dcfg = dspec.extra["cfg"]
+        rng = np.random.RandomState(0)
+        v = jax.eval_shape(lambda: dspec.model.init(0, *dspec.synth_batch(1, rng)))
+        v_real = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), v
+        )
+        stacked = transformer_lm.stack_decode_params(v_real, dcfg)
+        prompt_shape = jax.ShapeDtypeStruct((8, 128), np.int32)
+        out = jax.eval_shape(
+            functools.partial(transformer_lm.generate, max_new_tokens=65,
+                              cfg=dcfg, stacked_params=stacked),
+            v_real, prompt_shape,
+        )
+        assert out.shape == (8, 65)
+
+        # transformer section
+        tspec = models.get_model("transformer", seq_len=256, scan_layers=True)
+        tb = tspec.synth_batch(4, rng)
+        tv = jax.eval_shape(lambda: tspec.model.init(0, *tb))
+        tv_real = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), tv
+        )
+        topt = tspec.optimizer()
+        to = topt.create_state(tv_real.params)
+        tout = jax.eval_shape(topt.minimize(tspec.model), tv_real, to, *tb,
+                              rng=jax.random.PRNGKey(0))
+        assert tout.loss.shape == ()
+    finally:
+        set_flags(use_flash_attention=prev_f, use_bf16_compute=prev_b)
