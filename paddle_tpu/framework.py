@@ -176,21 +176,20 @@ def overlay_frame(params: Dict[str, jax.Array], rng=None):
         _tls.frame = prev
 
 
-def gather_layer_params(n_layers: int, name_of):
-    """Collect + stack the per-layer parameter arrays of ``n_layers``
-    structurally-identical layers into {suffix: [L, ...]} (the shared
-    front half of scan-over-layers and pipeline stacking). Validates that
-    every layer has the full suffix set, with a structured error."""
-    frame = _current_frame()
-    prefix = "/".join(frame.name_stack)
-    prefix = prefix + "/" if prefix else ""
+def stack_layer_params(params: Dict[str, jax.Array], n_layers: int, name_of,
+                       prefix: str = ""):
+    """Frame-independent core of layer stacking: collect the per-layer
+    parameter arrays of ``n_layers`` structurally-identical layers from a
+    flat ``params`` dict into {suffix: [L, ...]}, validating that every
+    layer has layer 0's full suffix set (structured error instead of a
+    bare KeyError on a cfg/checkpoint layer-count mismatch)."""
     tag0 = f"{prefix}{name_of(0)}/"
-    suffixes = sorted(k[len(tag0):] for k in frame.params if k.startswith(tag0))
+    suffixes = sorted(k[len(tag0):] for k in params if k.startswith(tag0))
     if not suffixes:
-        raise EnforceError(f"no {tag0}* params in frame")
+        raise EnforceError(f"no {tag0}* params found")
     for i in range(n_layers):
         for s in suffixes:
-            if f"{prefix}{name_of(i)}/{s}" not in frame.params:
+            if f"{prefix}{name_of(i)}/{s}" not in params:
                 raise EnforceError(
                     f"parameter '{prefix}{name_of(i)}/{s}' not found in "
                     f"provided params; expected {n_layers} identical layers "
@@ -198,10 +197,19 @@ def gather_layer_params(n_layers: int, name_of):
                 )
     return {
         s: jnp.stack(
-            [frame.params[f"{prefix}{name_of(i)}/{s}"] for i in range(n_layers)]
+            [params[f"{prefix}{name_of(i)}/{s}"] for i in range(n_layers)]
         )
         for s in suffixes
     }
+
+
+def gather_layer_params(n_layers: int, name_of):
+    """Stack the current frame's per-layer params (the shared front half of
+    scan-over-layers and pipeline stacking) — see :func:`stack_layer_params`."""
+    frame = _current_frame()
+    prefix = "/".join(frame.name_stack)
+    prefix = prefix + "/" if prefix else ""
+    return stack_layer_params(frame.params, n_layers, name_of, prefix)
 
 
 def scan_layer_stack(x, n_layers: int, name_of, template: str, body,

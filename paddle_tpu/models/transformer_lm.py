@@ -364,14 +364,9 @@ def stack_decode_params(variables_or_params, cfg: dict) -> dict:
     :func:`generate` as ``stacked_params``."""
     params = (variables_or_params.params
               if hasattr(variables_or_params, "params") else variables_or_params)
-    L = cfg["n_layers"]
-    sfx = sorted(
-        {k[len("layer_0/"):] for k in params if k.startswith("layer_0/")}
+    return pt.framework.stack_layer_params(
+        params, cfg["n_layers"], lambda i: f"layer_{i}"
     )
-    return {
-        s: jnp.stack([params[f"layer_{i}/{s}"] for i in range(L)])
-        for s in sfx
-    }
 
 
 def generate(

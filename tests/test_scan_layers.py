@@ -251,22 +251,17 @@ def test_bench_lm_large_config_traces():
         )
         rng = np.random.RandomState(0)
         batch = spec.synth_batch(2, rng)
+        # fully abstract: ShapeDtypeStructs end to end — no 2.6GB of
+        # concrete zeros for a 217M-param model's variables + Adam slots
         v = jax.eval_shape(lambda: spec.model.init(0, *batch))
-        # init must be traced for real to get params; eval_shape of init is
-        # enough for the step's structure since shapes are all that matter
-        import jax.numpy as jnp_
-
-        v_real = jax.tree_util.tree_map(
-            lambda s: jnp_.zeros(s.shape, s.dtype), v
-        )
         opt = spec.optimizer()
-        o = opt.create_state(v_real.params)
+        o = jax.eval_shape(opt.create_state, v.params)
         out = jax.eval_shape(
-            opt.minimize(spec.model), v_real, o, *batch,
+            opt.minimize(spec.model), v, o, *batch,
             rng=jax.random.PRNGKey(0),
         )
         assert out.loss.shape == ()
-        assert set(out.variables.params) == set(v_real.params)
+        assert set(out.variables.params) == set(v.params)
     finally:
         set_flags(use_flash_attention=prev_f, use_bf16_compute=prev_b)
 
@@ -292,15 +287,14 @@ def test_bench_decode_and_transformer_configs_trace():
         dcfg = dspec.extra["cfg"]
         rng = np.random.RandomState(0)
         v = jax.eval_shape(lambda: dspec.model.init(0, *dspec.synth_batch(1, rng)))
-        v_real = jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, s.dtype), v
+        stacked = jax.eval_shape(
+            lambda p: transformer_lm.stack_decode_params(p, dcfg), v
         )
-        stacked = transformer_lm.stack_decode_params(v_real, dcfg)
         prompt_shape = jax.ShapeDtypeStruct((8, 128), np.int32)
         out = jax.eval_shape(
             functools.partial(transformer_lm.generate, max_new_tokens=65,
                               cfg=dcfg, stacked_params=stacked),
-            v_real, prompt_shape,
+            v, prompt_shape,
         )
         assert out.shape == (8, 65)
 
@@ -308,12 +302,9 @@ def test_bench_decode_and_transformer_configs_trace():
         tspec = models.get_model("transformer", seq_len=256, scan_layers=True)
         tb = tspec.synth_batch(4, rng)
         tv = jax.eval_shape(lambda: tspec.model.init(0, *tb))
-        tv_real = jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, s.dtype), tv
-        )
         topt = tspec.optimizer()
-        to = topt.create_state(tv_real.params)
-        tout = jax.eval_shape(topt.minimize(tspec.model), tv_real, to, *tb,
+        to = jax.eval_shape(topt.create_state, tv.params)
+        tout = jax.eval_shape(topt.minimize(tspec.model), tv, to, *tb,
                               rng=jax.random.PRNGKey(0))
         assert tout.loss.shape == ()
     finally:
