@@ -109,3 +109,20 @@ def test_lm_pipeline_guards():
     v3 = spec3.model.init(0, *batch)
     with pytest.raises(Exception, match="divisible"):
         spec3.model.apply(v3, *batch)
+
+
+def test_lm_pipeline_subsumes_scan_layers():
+    """pipe_mesh + scan_layers=True is documented as harmless (stages
+    already scan their layer group): it must run and match the plain
+    forward like the scan_layers=False pipelined path does."""
+    mesh = _pipe_mesh(2)
+    a = models.get_model("transformer_lm", **LM_KW)
+    b = models.get_model("transformer_lm", pipe_mesh=mesh, pipe_n_micro=2,
+                         scan_layers=True, **LM_KW)
+    rng = np.random.RandomState(2)
+    batch = a.synth_batch(4, rng)
+    va = a.model.init(0, *batch)
+    vb = b.model.init(0, *batch)
+    (la, *_), _ = a.model.apply(va, *batch)
+    (lb, *_), _ = b.model.apply(vb, *batch)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-5, atol=1e-6)
