@@ -28,6 +28,17 @@ import sys
 import time
 
 BASELINE_IMG_PER_SEC = 84.08  # ResNet-50 train bs256, 2S Xeon 6148 (in-tree)
+
+# The MFU-representative LM config (the 512-wide default underfills the MXU).
+# Single-sourced: quickshot and the donation/HBM test measure THIS config —
+# retune it here and every artifact stays comparable.
+LM_LARGE_KWARGS = dict(
+    seq_len=2048, d_model=1024, d_inner=4096, num_heads=16, n_layers=12,
+    max_len=2048,
+    # one scanned body -> one Mosaic flash fwd+bwd compile instead of 12:
+    # tunnel windows are compile-time bound
+    scan_layers=True,
+)
 # North-star anchor (BENCH_NOTES.md): 0.8x of one V100's share of an 8xV100
 # fluid ResNet-50 run ~= 240-265 img/s/chip; midpoint used for self-grading.
 V100_TARGET_IMG_PER_SEC = 252.0
@@ -62,8 +73,29 @@ def _cost_flops(compiled) -> float:
         return 0.0
 
 
+def _mem_stats(compiled):
+    """Peak-HBM + donation stats from the compiled executable
+    (VERDICT r4 #2; reference logs memory per iteration under
+    FLAGS_benchmark, ``paddle/fluid/framework/executor.cc:399-401``).
+    ``alias_size_in_bytes`` > 0 proves argument donation took effect —
+    without it a train step holds params + opt state twice."""
+    try:
+        ma = compiled.memory_analysis()
+        if isinstance(ma, (list, tuple)):
+            ma = ma[0]
+        return {
+            "peak_hbm_bytes": int(ma.peak_memory_in_bytes),
+            "argument_size_bytes": int(ma.argument_size_in_bytes),
+            "temp_size_bytes": int(ma.temp_size_in_bytes),
+            "donated_alias_bytes": int(ma.alias_size_in_bytes),
+        }
+    except Exception:
+        return None
+
+
 def _bench_step(spec, batch_size: int, warmup: int, iters: int, rng_seed: int = 0):
-    """Compile + time one model's train step; returns (sec/step, flops/step)."""
+    """Compile + time one model's train step; returns
+    (sec/step, flops/step, mem_stats_dict_or_None)."""
     import jax
     import numpy as np
 
@@ -79,6 +111,7 @@ def _bench_step(spec, batch_size: int, warmup: int, iters: int, rng_seed: int = 
     lowered = step.lower(variables, opt_state, *dev_batch, rng=key)
     compiled = lowered.compile()
     flops = _cost_flops(compiled)
+    mem = _mem_stats(compiled)
 
     v, o = variables, opt_state
     out = None
@@ -97,7 +130,7 @@ def _bench_step(spec, batch_size: int, warmup: int, iters: int, rng_seed: int = 
         v, o = out.variables, out.opt_state
     float(jax.device_get(out.loss))
     dt = (time.perf_counter() - t0) / iters
-    return dt, flops
+    return dt, flops, mem
 
 
 def child_main(tiny: bool, force_cpu: bool = False) -> None:
@@ -155,12 +188,15 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
                 result["notes"].append(f"resnet_bs{bs}_skipped_budget")
                 continue
             try:
-                dt, flops = _bench_step(spec, bs, warmup=1, iters=iters)
+                dt, flops, mem = _bench_step(spec, bs, warmup=1, iters=iters)
             except Exception as e:  # OOM at large bs ends the sweep
                 result["notes"].append(f"resnet_bs{bs}_failed: {type(e).__name__}"[:120])
                 break
             ips = bs / dt
             result[f"resnet_imgs_per_sec_bs{bs}"] = round(ips, 2)
+            if mem:
+                result[f"resnet_peak_hbm_bytes_bs{bs}"] = mem["peak_hbm_bytes"]
+                result[f"resnet_donated_alias_bytes_bs{bs}"] = mem["donated_alias_bytes"]
             if best is None or ips > best[0]:
                 best = (ips, bs, dt, flops)
                 result["value"] = round(ips, 2)
@@ -189,17 +225,14 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
     # order: the LM MFU story should survive a tunnel drop mid-run. ---
     if dev.platform != "cpu" and not tiny and time.monotonic() < deadline:
         try:
-            lspec = models.get_model(
-                "transformer_lm", seq_len=2048, d_model=1024, d_inner=4096,
-                num_heads=16, n_layers=12, max_len=2048,
-                # one scanned body -> one Mosaic flash fwd+bwd compile
-                # instead of 12: tunnel windows are compile-time bound
-                scan_layers=True,
-            )
-            dt, flops = _bench_step(lspec, 4, warmup=1, iters=6)
+            lspec = models.get_model("transformer_lm", **LM_LARGE_KWARGS)
+            dt, flops, mem = _bench_step(lspec, 4, warmup=1, iters=6)
             result["lm_large_tokens_per_sec"] = round(4 * 2048 / dt, 1)
             if peak and flops:
                 result["lm_large_mfu"] = round(flops / dt / peak, 4)
+            if mem:
+                result["lm_large_peak_hbm_bytes"] = mem["peak_hbm_bytes"]
+                result["lm_large_donated_alias_bytes"] = mem["donated_alias_bytes"]
             print(f"lm_large: {result['lm_large_tokens_per_sec']} tok/s", file=sys.stderr)
         except Exception as e:
             result["notes"].append(f"lm_large_failed: {type(e).__name__}: {e}"[:300])
@@ -349,7 +382,9 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
             # scan_layers: one body compile per stack (see lm_large note)
             tspec = models.get_model("transformer", seq_len=tseq,
                                      scan_layers=not tiny)
-            dt, flops = _bench_step(tspec, tbs, warmup=1, iters=titers)
+            dt, flops, mem = _bench_step(tspec, tbs, warmup=1, iters=titers)
+            if mem:
+                result["transformer_peak_hbm_bytes"] = mem["peak_hbm_bytes"]
             result["transformer_tokens_per_sec"] = round(tbs * tseq / dt, 1)
             if peak and flops:
                 result["transformer_mfu"] = round(flops / dt / peak, 4)
@@ -365,7 +400,9 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
         lbs, lseq = (2, 128) if tiny else (8, 1024)
         try:
             lspec = models.get_model("transformer_lm", seq_len=lseq)
-            dt, flops = _bench_step(lspec, lbs, warmup=1, iters=3 if tiny else 10)
+            dt, flops, mem = _bench_step(lspec, lbs, warmup=1, iters=3 if tiny else 10)
+            if mem:
+                result["lm_peak_hbm_bytes"] = mem["peak_hbm_bytes"]
             result["lm_tokens_per_sec"] = round(lbs * lseq / dt, 1)
             if peak and flops:
                 result["lm_mfu"] = round(flops / dt / peak, 4)

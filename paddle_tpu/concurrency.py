@@ -257,6 +257,7 @@ class Select:
         self._cases = []  # (kind, channel, payload, callback)
         self._default: Optional[Callable[[], Any]] = None
         self.result = None
+        self._ran = False
 
     def send(self, channel: Channel, value, callback: Optional[Callable] = None) -> "Select":
         self._cases.append(("send", channel, value, callback))
@@ -299,12 +300,20 @@ class Select:
             return False, None
 
     def run(self, timeout: Optional[float] = None):
+        if self._ran:
+            raise RuntimeError(
+                "Select.run() called twice (an explicit run() inside a "
+                "with-block already consumed the select)"
+            )
         if not self._cases and self._default is None:
             raise ValueError("select with no cases")
         deadline = None if timeout is None else time.monotonic() + timeout
         park_s = 1e-3
 
         def _fire(kind, callback, res):
+            # consumed only when a case actually fires — a TimeoutError
+            # leaves the Select retryable (nothing was taken from a channel)
+            self._ran = True
             if callback is not None:
                 if kind == "recv":
                     v, ok = res
@@ -321,6 +330,7 @@ class Select:
                 if fired:
                     return _fire(kind, callback, res)
             if self._default is not None:
+                self._ran = True
                 self.result = self._default()
                 return self.result
             if deadline is not None and time.monotonic() >= deadline:
@@ -344,7 +354,9 @@ class Select:
         return self
 
     def __exit__(self, exc_type, exc_val, exc_tb) -> bool:
-        if exc_type is None:
+        # an explicit run() inside the block already consumed the select —
+        # running again would silently swallow an extra channel value
+        if exc_type is None and not self._ran:
             self.run()
         return False
 

@@ -183,18 +183,50 @@ def stack_layer_params(params: Dict[str, jax.Array], n_layers: int, name_of,
     flat ``params`` dict into {suffix: [L, ...]}, validating that every
     layer has layer 0's full suffix set (structured error instead of a
     bare KeyError on a cfg/checkpoint layer-count mismatch)."""
-    tag0 = f"{prefix}{name_of(0)}/"
-    suffixes = sorted(k[len(tag0):] for k in params if k.startswith(tag0))
-    if not suffixes:
-        raise EnforceError(f"no {tag0}* params found")
-    for i in range(n_layers):
-        for s in suffixes:
-            if f"{prefix}{name_of(i)}/{s}" not in params:
-                raise EnforceError(
-                    f"parameter '{prefix}{name_of(i)}/{s}' not found in "
-                    f"provided params; expected {n_layers} identical layers "
-                    "— model structure must match between init and apply"
-                )
+    # single pass over params: bucket every key's suffix set under its
+    # layer-name head (O(len(params)), not O(n_layers * len(params)))
+    names = [name_of(i) for i in range(n_layers)]
+    name_set = set(names)
+    multi_seg = [n for n in names if "/" in n]  # rare: scoped layer names
+    plen = len(prefix)
+    per_layer: Dict[str, set] = {}
+    for k in params:
+        if prefix and not k.startswith(prefix):
+            continue
+        head, sep, suf = k[plen:].partition("/")
+        if sep and head in name_set:
+            per_layer.setdefault(head, set()).add(suf)
+        elif sep and multi_seg:
+            # fall back for name_of values containing '/' (e.g.
+            # 'blocks/layer_0'): match the longest known name prefix
+            rest = k[plen:]
+            for nm in multi_seg:
+                if rest.startswith(nm + "/"):
+                    per_layer.setdefault(nm, set()).add(rest[len(nm) + 1:])
+                    break
+    base = per_layer.get(names[0], set())
+    if not base:
+        raise EnforceError(f"no {prefix}{names[0]}/* params found")
+    suffixes = sorted(base)
+    for i, nm in enumerate(names):
+        got = per_layer.get(nm, set())
+        missing = sorted(base - got)
+        if missing:
+            raise EnforceError(
+                f"parameter '{prefix}{nm}/{missing[0]}' not found in "
+                f"provided params; expected {n_layers} identical layers "
+                "— model structure must match between init and apply"
+            )
+        # ...and the reverse: a layer carrying suffixes layer 0 lacks (e.g.
+        # a MoE checkpoint restored under a dense cfg) must be reported, not
+        # silently ignored
+        extra = sorted(got - base)
+        if extra:
+            raise EnforceError(
+                f"layer {i} has parameter suffixes not present in layer 0: "
+                f"{extra}; all {n_layers} layers must be structurally "
+                "identical to stack"
+            )
     return {
         s: jnp.stack(
             [params[f"{prefix}{name_of(i)}/{s}"] for i in range(n_layers)]

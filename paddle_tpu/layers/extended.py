@@ -406,9 +406,12 @@ def is_empty(x: jax.Array) -> bool:
 
 def autoincreased_step_counter(counter_name: str = "@STEP_COUNTER@", begin: int = 1, step: int = 1) -> jax.Array:
     """Reference ``layers/nn.py`` autoincreased_step_counter: a persistent
-    int64 counter bumped every apply (used by LR schedules)."""
+    int64 counter bumped every apply (used by LR schedules). int64 only when
+    x64 is on — int32 is the TPU-native width and silently requesting a
+    truncated int64 just warns every trace."""
+    dtype = "int64" if jax.config.jax_enable_x64 else "int32"
     cur = create_state(
-        counter_name, (), "int64", init=lambda s, d: jnp.asarray(begin - step, d)
+        counter_name, (), dtype, init=lambda s, d: jnp.asarray(begin - step, d)
     )
     new = cur + step
     update_state(counter_name, new)

@@ -164,16 +164,19 @@ class Pass:
 
 @register_pass
 class CopyPropagation(Pass):
-    """``copy`` / ``convert_element_type`` / ``stop_gradient`` are identity
-    in this IR (the interpreter is f32 throughout; real dtype changes emit
-    ``to_bf16``/``to_int``): forward their input to every use and drop the
-    op. At runtime each surviving copy costs a full tensor clone into the
-    locals env, so this pass deletes real per-inference work, not just
-    lines."""
+    """``copy`` / ``stop_gradient`` are identity in this IR: forward their
+    input to every use and drop the op. At runtime each surviving copy costs
+    a full tensor clone into the locals env, so this pass deletes real
+    per-inference work, not just lines.
+
+    ``convert_element_type`` is deliberately NOT here: the emitter lowers
+    that jaxpr prim to ``to_bf16``/``to_int``/``copy`` before any pass runs
+    (``export.py``), so treating a raw occurrence as identity would silently
+    drop a real dtype change if a future emitter path ever leaked one."""
 
     name = "copy-prop"
 
-    _IDENTITY = ("copy", "convert_element_type", "stop_gradient")
+    _IDENTITY = ("copy", "stop_gradient")
 
     def run(self, prog: Program) -> Program:
         remap: Dict[int, int] = {}

@@ -228,11 +228,54 @@ def pool2d(
 
 
 def adaptive_pool2d(x, output_size: _IntOrPair, pool_type: str = "avg"):
+    """Reference ``pool_op.cc`` adaptive mode: output bin i spans
+    [floor(i*H/oh), ceil((i+1)*H/oh)). Divisible sizes lower to a plain
+    strided pool; non-divisible sizes use static exact fallbacks (shapes are
+    trace-time constants on TPU, so the bin edges are Python ints):
+
+    - avg: per-axis bin-membership matrices contracted on the MXU
+      (``einsum``), each row pre-scaled by 1/bin_size — exact mean.
+    - max: clamped-gather of each bin padded to the longest bin by
+      repeating an in-bin element (duplicates never change a max).
+    """
     oh, ow = _pair(output_size)
     h, w = x.shape[1], x.shape[2]
     if h % oh == 0 and w % ow == 0:
         return pool2d(x, (h // oh, w // ow), pool_type, (h // oh, w // ow))
-    raise NotImplementedError("adaptive_pool2d requires divisible sizes on TPU (static shapes)")
+
+    import numpy as _np
+
+    def edges(in_size, out_size):
+        return [
+            ((i * in_size) // out_size, -(-((i + 1) * in_size) // out_size))
+            for i in range(out_size)
+        ]
+
+    eh_, ew_ = edges(h, oh), edges(w, ow)
+    if pool_type == "avg":
+        def weight(in_size, bins):
+            m = _np.zeros((len(bins), in_size), _np.float32)
+            for i, (s, e) in enumerate(bins):
+                m[i, s:e] = 1.0 / (e - s)
+            return jnp.asarray(m)
+
+        xf = x.astype(jnp.float32)
+        out = jnp.einsum("ih,bhwc->biwc", weight(h, eh_), xf)
+        out = jnp.einsum("jw,biwc->bijc", weight(w, ew_), out)
+        return out.astype(x.dtype)
+    if pool_type == "max":
+        def gather_max(arr, axis, bins):
+            longest = max(e - s for s, e in bins)
+            idx = _np.asarray(
+                [[min(s + l, e - 1) for l in range(longest)] for s, e in bins],
+                _np.int32,
+            )
+            g = jnp.take(arr, jnp.asarray(idx), axis=axis)  # bin dim + pad dim
+            return g.max(axis=axis + 1)
+
+        out = gather_max(x, 1, eh_)
+        return gather_max(out, 2, ew_)
+    raise ValueError(f"pool_type must be 'max' or 'avg', got {pool_type!r}")
 
 
 # -- normalization ----------------------------------------------------------

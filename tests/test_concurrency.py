@@ -291,3 +291,39 @@ def test_from_reader_consumer_closes_early():
     ch.close()
     time.sleep(0.2)  # give the pump a beat to notice and exit
     assert len(produced) < 1000  # producer stopped early, not exhausted
+
+
+def test_select_explicit_run_in_with_block_runs_once():
+    """ADVICE r4: an explicit run() inside the with-block must not be
+    silently re-run on exit (that consumed an extra channel value)."""
+    ch = cc.Channel(capacity=2)
+    ch.send(1)
+    ch.send(2)
+    got = []
+    with cc.Select() as s:
+        s.recv(ch, lambda v, ok: got.append(v))
+        s.run()
+    assert got == [1]
+    assert ch.recv() == (2, True)  # second value untouched
+
+    s2 = cc.Select().recv(ch)
+    ch.send(3)
+    s2.run(timeout=5)
+    import pytest
+    with pytest.raises(RuntimeError, match="twice"):
+        s2.run()
+
+
+def test_select_timeout_leaves_select_retryable():
+    """code-review r5: a TimeoutError consumes nothing, so the Select must
+    stay runnable — only an actually-fired case poisons re-run."""
+    import pytest
+
+    ch = cc.Channel(capacity=1)
+    s = cc.Select().recv(ch, lambda v, ok: v)
+    with pytest.raises(TimeoutError):
+        s.run(timeout=0.05)
+    ch.send(42)
+    assert s.run(timeout=5) == 42
+    with pytest.raises(RuntimeError, match="twice"):
+        s.run()

@@ -87,7 +87,7 @@ def test_copy_propagation_forwards_and_chains():
     text = """# h
 input 0 2 4 8
 op copy 1 1 0 -
-op convert_element_type 2 1 1 -
+op copy 2 1 1 -
 op neg 3 1 2 -
 op stop_gradient 4 1 3 -
 output 4
@@ -97,6 +97,20 @@ output 4
     assert prog.op_count() == 1
     assert "op neg 3 1 0 -" in prog.serialize()
     assert "output 3" in prog.serialize()
+
+
+def test_copy_propagation_preserves_convert_element_type():
+    """ADVICE r4: the emitter lowers convert_element_type to
+    to_bf16/to_int/copy before passes run, so a raw occurrence must be
+    treated as a REAL op — dropping it would silently skip a dtype change."""
+    text = """# h
+input 0 2 4 8
+op convert_element_type 1 1 0 -
+output 1
+"""
+    prog = P.get_pass("copy-prop").run(P.Program.parse(text))
+    assert prog.op_count() == 1
+    assert "convert_element_type" in prog.serialize()
 
 
 def test_copy_propagation_keeps_to_bf16():

@@ -333,3 +333,31 @@ def test_rope_lm_trains(rng):
     from paddle_tpu.models.transformer_lm import generate
     out = generate(v, jnp.ones((1, 4), jnp.int32), 2, spec.extra["cfg"])
     assert out.shape == (1, 2)
+
+
+def test_adaptive_pool2d_non_divisible_matches_torch(rng):
+    """Non-divisible adaptive pooling (VERDICT r4 #9): the static fallbacks
+    (MXU einsum avg / clamped-gather max) must match torch's
+    adaptive_{avg,max}_pool2d bin-edge semantics exactly — the same
+    floor/ceil bins as the reference's pool_op.cc adaptive mode."""
+    import torch
+
+    from paddle_tpu.ops import nn as pnn
+
+    x = rng.randn(2, 7, 10, 3).astype(np.float32)  # 7->3, 10->4: non-divisible
+    tx = torch.from_numpy(x.transpose(0, 3, 1, 2))  # NHWC -> NCHW
+    for pool_type, tfn in (
+        ("avg", torch.nn.functional.adaptive_avg_pool2d),
+        ("max", torch.nn.functional.adaptive_max_pool2d),
+    ):
+        got = np.asarray(pnn.adaptive_pool2d(jnp.asarray(x), (3, 4), pool_type))
+        want = tfn(tx, (3, 4)).numpy().transpose(0, 2, 3, 1)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    # divisible path still lowers to the plain strided pool
+    xd = rng.randn(1, 8, 8, 2).astype(np.float32)
+    got = np.asarray(pnn.adaptive_pool2d(jnp.asarray(xd), 4, "avg"))
+    want = torch.nn.functional.adaptive_avg_pool2d(
+        torch.from_numpy(xd.transpose(0, 3, 1, 2)), 4
+    ).numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

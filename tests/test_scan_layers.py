@@ -309,3 +309,89 @@ def test_bench_decode_and_transformer_configs_trace():
         assert tout.loss.shape == ()
     finally:
         set_flags(use_flash_attention=prev_f, use_bf16_compute=prev_b)
+
+
+def test_stack_layer_params_rejects_extra_suffixes():
+    """ADVICE r4: a layer with suffixes layer 0 lacks (MoE checkpoint under
+    a dense cfg) must raise the structured error, not be silently dropped."""
+    import jax.numpy as jnp
+    import pytest
+
+    from paddle_tpu.core.enforce import EnforceError
+    from paddle_tpu.framework import stack_layer_params
+
+    name_of = lambda i: f"layer_{i}"
+    params = {
+        "layer_0/w": jnp.ones((2,)),
+        "layer_1/w": jnp.ones((2,)),
+        "layer_1/expert_0/w": jnp.ones((2,)),  # extra vs layer 0
+    }
+    with pytest.raises(EnforceError, match="not present in layer 0"):
+        stack_layer_params(params, 2, name_of)
+
+
+def _beam_scan_vs_unrolled(cfg_overrides, beam_size=2, mnt=4):
+    """Exact-match harness: generate_beam with scan_layers=True must equal
+    the unrolled beam decode token-for-token and score-for-score (same
+    params, same prompt). VERDICT r4 #6."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu import models
+    from paddle_tpu.models import transformer_lm
+
+    base = dict(seq_len=16, vocab=97, d_model=32, d_inner=48, num_heads=4,
+                n_layers=3, max_len=64)
+    base.update(cfg_overrides)
+    spec = models.get_model("transformer_lm", **base)
+    cfg = dict(spec.extra["cfg"])
+    rng = np.random.RandomState(7)
+    v = spec.model.init(0, *spec.synth_batch(2, rng))
+    prompt = jnp.asarray(rng.randint(1, cfg["vocab"], size=(2, 5)).astype(np.int32))
+
+    cfg_unrolled = dict(cfg, scan_layers=False)
+    seqs_u, scores_u = transformer_lm.generate_beam(
+        v, prompt, mnt, cfg_unrolled, beam_size=beam_size
+    )
+    cfg_scan = dict(cfg, scan_layers=True)
+    stacked = transformer_lm.stack_decode_params(v, cfg_scan)
+    seqs_s, scores_s = transformer_lm.generate_beam(
+        v, prompt, mnt, cfg_scan, beam_size=beam_size, stacked_params=stacked
+    )
+    np.testing.assert_array_equal(np.asarray(seqs_u), np.asarray(seqs_s))
+    np.testing.assert_allclose(
+        np.asarray(scores_u), np.asarray(scores_s), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_beam_scan_matches_unrolled_base():
+    _beam_scan_vs_unrolled({})
+
+
+def test_beam_scan_matches_unrolled_swiglu_window_gqa():
+    """The configs the verdict singled out: SwiGLU FFN + sliding window,
+    plus GQA so the cache holds fewer kv heads than query heads."""
+    _beam_scan_vs_unrolled(
+        dict(ffn_activation="swiglu", attention_window=4, num_kv_heads=2),
+        beam_size=3,
+    )
+
+
+def test_beam_scan_matches_unrolled_rope():
+    _beam_scan_vs_unrolled(dict(pos_encoding="rope"))
+
+
+def test_stack_layer_params_multi_segment_names():
+    """code-review r5: name_of values containing '/' (scoped layer names)
+    must still bucket correctly in the single-pass rewrite."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework import stack_layer_params
+
+    params = {
+        "blocks/layer_0/w": jnp.zeros((2,)),
+        "blocks/layer_1/w": jnp.ones((2,)),
+        "other/x": jnp.ones((1,)),
+    }
+    stacked = stack_layer_params(params, 2, lambda i: f"blocks/layer_{i}")
+    assert set(stacked) == {"w"} and stacked["w"].shape == (2, 2)

@@ -57,6 +57,12 @@ run_step() {
 }
 
 harvest() {
+  # 0. quickshot: resnet img/s + lm_large MFU, FIRST (~2 min warm) — the two
+  # numbers the north star needs must survive even a window that dies right
+  # after the probe (VERDICT r4 #1)
+  run_step quickshot 700 BENCH_QUICK_TPU.json '"complete": true' \
+    "TPU window: quickshot resnet img/s + lm_large MFU" \
+    BENCH_QUICK_TPU.json -- python tools/tpu_quickshot.py || return 1
   # 1. smoke: numerics + steady-state throughput per family (~5-10 min)
   PT_SMOKE_BUDGET_S=600 run_step smoke 700 SMOKE_TPU.json '"complete": true' \
     "TPU window: smoke numerics + steady-state family throughput" \
@@ -82,9 +88,9 @@ harvest() {
     "TPU window: flash kernel block autotune + GQA/window A/B" \
     FLASH_TUNE_TPU.json -- python tests/tpu_flash_tune.py || return 1
   # 4. convergence to accuracy target
-  PT_CONV_BUDGET_S=1200 run_step convergence 1300 CONVERGENCE_r04.json '"ok": true' \
-    "TPU window: MNIST-to-97% + cifar resnet loss curve on chip" \
-    CONVERGENCE_r04.json -- python tests/tpu_convergence.py || return 1
+  PT_CONV_BUDGET_S=1200 run_step convergence 1300 CONVERGENCE_r05.json '"ok": true' \
+    "TPU window: real-digits-to-97% (+ linear-probe floor) + cifar resnet loss curve on chip" \
+    CONVERGENCE_r05.json -- python tests/tpu_convergence.py || return 1
   # 5. op parity catalog on chip
   run_step opparity 900 OP_PARITY_TPU.json '"complete": true' \
     "TPU window: op catalog TPU-vs-CPU parity" \
