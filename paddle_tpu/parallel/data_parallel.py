@@ -139,6 +139,49 @@ class DataParallel:
         self._validate_batch(batch, shards)
         return tuple(jax.device_put(b, s) for b, s in zip(batch, shards))
 
+    def pad_batch(self, *batch, to: Optional[int] = None):
+        """Pad a (possibly ragged) batch's leading dim up to ``to`` — or the
+        next data-axis multiple — by repeating the final row; returns
+        ``(padded_batch, valid_mask)`` with a float32 [B_padded] mask that is
+        1 for real rows, 0 for padding.
+
+        The TPU-shaped replacement for the reference's data_balance op
+        (``details/data_balance_op_handle.cc:154``, inserted at
+        ``multi_devices_graph_pass.cc:553-557``), which rebalanced uneven
+        per-device splits so every sample trains/evals exactly once: static
+        shapes forbid ragged shards, so pad + mask instead and thread the
+        mask into the metric (``Trainer.evaluate``). Padding repeats a real
+        row (never zeros) so the padded forward stays numerically tame.
+
+        Passing ``to`` = the regular batch size keeps the final batch the
+        same shape as every other batch — no extra eval_step compile."""
+        import numpy as np
+
+        n = int(jax.numpy.shape(batch[0])[0])
+        for b in batch[1:]:
+            enforce(
+                int(jax.numpy.shape(b)[0]) == n,
+                "pad_batch: all batch args must share the leading dim",
+            )
+        mult = self.mesh.shape[self.batch_axis]
+        target = to if to is not None else -(-n // mult) * mult
+        enforce(
+            target >= n and target % mult == 0,
+            f"pad_batch: target {target} must be >= batch size {n} and "
+            f"divisible by the data-axis size {mult}",
+        )
+        mask = np.zeros((target,), np.float32)
+        mask[:n] = 1.0
+        if target == n:
+            return batch, mask
+        padded = tuple(
+            np.concatenate(
+                [np.asarray(b), np.repeat(np.asarray(b)[-1:], target - n, axis=0)]
+            )
+            for b in batch
+        )
+        return padded, mask
+
     def _state_shardings(self, variables: Variables, opt_state: OptState):
         """Sharding pytrees matching (variables, opt_state): params/slots per
         their annotated specs, everything else replicated. With

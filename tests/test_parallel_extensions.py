@@ -895,3 +895,128 @@ def test_transformer_lm_ragged_windowed_ring_matches_plain(rng):
         variables, ids, labels, seq_lens, is_train=False
     )
     np.testing.assert_allclose(float(l_plain), float(l_ring), rtol=1e-4)
+
+
+# --------------------------------------------------- uneven final batch (r5)
+def test_pad_batch_mask_and_repeat():
+    """VERDICT r4 #4: pad_batch pads a ragged batch to the shard multiple by
+    repeating the last real row, with a validity mask covering exactly the
+    real rows."""
+    from paddle_tpu.core.enforce import EnforceError
+    from paddle_tpu.parallel.data_parallel import DataParallel
+    from paddle_tpu.optimizer import SGD
+
+    r = np.random.RandomState(0)
+    model = pt.build(lambda x, y: pt.layers.mean(x), name="pad_net")
+    dp = DataParallel(model, SGD(1e-2), mesh=make_mesh(data=8))
+
+    x = r.rand(13, 4).astype(np.float32)
+    y = r.randint(0, 5, size=(13, 1)).astype(np.int64)
+    (px, py), mask = dp.pad_batch(x, y)
+    assert px.shape == (16, 4) and py.shape == (16, 1)
+    assert mask.tolist() == [1.0] * 13 + [0.0] * 3
+    np.testing.assert_array_equal(px[13:], np.repeat(x[-1:], 3, axis=0))
+
+    # to= pins the target (e.g. the regular batch size: single compile)
+    (px, _), mask = dp.pad_batch(x, y, to=24)
+    assert px.shape == (24, 4) and mask.sum() == 13
+
+    # already-divisible batches pass through untouched
+    (qx, _), mask = dp.pad_batch(x[:8], y[:8])
+    assert qx is x[:8] or qx.shape == (8, 4)
+    assert mask.sum() == 8
+
+    with pytest.raises(EnforceError, match="divisible"):
+        dp.pad_batch(x, y, to=15)
+
+
+def test_trainer_evaluate_exact_over_ragged_test_set(rng):
+    """Accuracy over EXACTLY N=52 samples with N % (devices*bs) != 0 on the
+    8-device mesh: the evaluate() mask path must agree bit-for-bit with a
+    direct unsharded computation over all 52 rows (reference guarantee:
+    every sample evals once, data_balance_op_handle.cc:154)."""
+    from paddle_tpu.trainer import Trainer
+
+    D, C, N, BS = 8, 3, 52, 16  # 52 = 3*16 + ragged 4
+
+    def net(x, y):
+        logits = pt.layers.fc(x, C, name="clf")
+        loss = pt.layers.mean(pt.layers.softmax_with_cross_entropy(logits, y))
+        return loss, logits
+
+    xs = rng.randn(N, D).astype(np.float32)
+    ys = rng.randint(0, C, size=(N, 1)).astype(np.int64)
+
+    def reader():  # test-set reader: ragged 4-row final batch
+        for i in range(0, N, BS):
+            yield xs[i:i + BS], ys[i:i + BS]
+
+    def train_reader():  # train path still requires divisible batches
+        yield xs[:BS], ys[:BS]
+
+    tr = Trainer(
+        lambda: pt.build(net, name="eval_net"),
+        lambda: pt.optimizer.SGD(1e-2),
+        parallel=True,
+        parallel_kwargs=dict(mesh=make_mesh(data=8)),
+    )
+    tr.train(num_epochs=1, reader=train_reader)
+
+    def accuracy(out, x, y):
+        logits = out[1]
+        return (np.asarray(jnp.argmax(logits, -1)) == np.asarray(y)[:, 0])
+
+    acc = tr.evaluate(reader, accuracy)
+
+    # direct, unsharded, all 52 rows at once
+    out, _ = tr.model.apply(tr.variables, jnp.asarray(xs), jnp.asarray(ys),
+                            is_train=False)
+    want = float((np.asarray(jnp.argmax(out[1], -1)) == ys[:, 0]).mean())
+    assert acc == pytest.approx(want, abs=1e-9)
+    # ...and it is an exact-N average: 52 counted, not 48 or 64
+    assert abs(acc * 52 - round(acc * 52)) < 1e-6
+
+
+def test_evaluate_rejects_column_metric_and_handles_ragged_first(rng):
+    """code-review r5: a [B,1] metric would broadcast to [B,B] — must raise;
+    and a ragged batch FIRST in the stream must not crash the latched-target
+    path."""
+    from paddle_tpu.core.enforce import EnforceError
+    from paddle_tpu.trainer import Trainer
+
+    def net(x, y):
+        logits = pt.layers.fc(x, 3, name="clf")
+        return pt.layers.mean(
+            pt.layers.softmax_with_cross_entropy(logits, y)
+        ), logits
+
+    xs = rng.randn(20, 4).astype(np.float32)
+    ys = rng.randint(0, 3, size=(20, 1)).astype(np.int64)
+
+    def ragged_first_reader():  # 4-row batch BEFORE the 16-row batch
+        yield xs[:4], ys[:4]
+        yield xs[4:20], ys[4:20]
+
+    tr = Trainer(
+        lambda: pt.build(net, name="eval_net2"),
+        lambda: pt.optimizer.SGD(1e-2),
+        parallel=True,
+        parallel_kwargs=dict(mesh=make_mesh(data=8)),
+    )
+    tr.train(num_epochs=1, reader=lambda: iter([(xs[:16], ys[:16])]))
+
+    with pytest.raises(EnforceError, match="one value per row"):
+        tr.evaluate(
+            ragged_first_reader,
+            lambda out, x, y: (np.asarray(jnp.argmax(out[1], -1, keepdims=True))
+                               == np.asarray(y)),  # [B,1] column: must raise
+        )
+
+    acc = tr.evaluate(
+        ragged_first_reader,
+        lambda out, x, y: (np.asarray(jnp.argmax(out[1], -1)) == np.asarray(y)[:, 0]),
+    )
+    out, _ = tr.model.apply(tr.variables, jnp.asarray(xs), jnp.asarray(ys),
+                            is_train=False)
+    want = float((np.asarray(jnp.argmax(out[1], -1)) == ys[:, 0]).mean())
+    assert acc == pytest.approx(want, abs=1e-9)
