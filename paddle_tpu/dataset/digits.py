@@ -59,7 +59,8 @@ def _materialize() -> None:
         # atomic: an interrupted direct write would leave a truncated npz
         # that cached_npz treats as valid forever
         final = common.data_home("digits", f"{split}.npz")
-        tmp = final + ".tmp.npz"
+        tmp = f"{final}.tmp.{os.getpid()}.npz"  # unique per process: two
+        # concurrent materializers must not clobber each other's tmp file
         np.savez(tmp, images=images[np.asarray(sel)], labels=labels[np.asarray(sel)])
         os.replace(tmp, final)
 

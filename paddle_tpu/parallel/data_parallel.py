@@ -163,7 +163,21 @@ class DataParallel:
                 int(jax.numpy.shape(b)[0]) == n,
                 "pad_batch: all batch args must share the leading dim",
             )
-        mult = self.mesh.shape[self.batch_axis]
+        # the multiple each arg's leading dim actually needs comes from its
+        # REAL sharding (batch_specs may shard dim 0 over several axes, e.g.
+        # P(('data','seq'))) — take the LCM across args, not the data-axis
+        # size alone
+        import math
+
+        mult = 1
+        for s in self._batch_shardings(batch):
+            axes = s.spec[0] if len(s.spec) else None
+            if axes is None:
+                continue
+            size = 1
+            for a in (axes if isinstance(axes, tuple) else (axes,)):
+                size *= self.mesh.shape[a]
+            mult = math.lcm(mult, size)
         target = to if to is not None else -(-n // mult) * mult
         enforce(
             target >= n and target % mult == 0,

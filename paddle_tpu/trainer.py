@@ -412,14 +412,15 @@ class Trainer:
         for batch in reader():
             n = int(np.shape(batch[0])[0])
             if self.parallel:
-                if target is None and pad_to_first:
-                    mult = self._dp.mesh.shape[self._dp.batch_axis]
-                    target = -(-n // mult) * mult
                 # a batch LARGER than the latched first-batch size (ragged
                 # batch first in the stream) pads to its own multiple
                 # instead of tripping pad_batch's target >= n enforce
                 to = target if (target is not None and n <= target) else None
                 padded, mask = self._dp.pad_batch(*batch, to=to)
+                if target is None and pad_to_first:
+                    # latch from what pad_batch actually produced — the
+                    # multiple-selection rule lives in pad_batch alone
+                    target = mask.shape[0]
                 out = self._dp.eval_step(self.variables, *padded)
             else:
                 padded, mask = batch, np.ones((n,), np.float32)

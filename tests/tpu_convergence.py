@@ -31,7 +31,7 @@ sys.path.insert(0, _REPO)
 
 BUDGET_S = float(os.environ.get("PT_CONV_BUDGET_S", "900"))
 _T0 = time.monotonic()
-ART = os.path.join(_REPO, "CONVERGENCE_r04.json")
+ART = os.path.join(_REPO, "CONVERGENCE_r05.json")
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import _stall_watchdog  # noqa: E402
@@ -84,29 +84,115 @@ def main() -> int:
     from paddle_tpu.dataset import common as ds_common
 
     dev = jax.devices()[0]
+
+    # ---- data resolution (VERDICT r4 #3: no trivially-separable blobs) ----
+    # 1. cached real MNIST npz, if someone staged one;
+    # 2. REAL bundled UCI handwritten digits (sklearn), upsampled to 28x28;
+    # 3. synthetic XOR-pattern classes — a task with ZERO class-mean signal,
+    #    so a linear probe sits near chance while the convnet can solve it.
+    from paddle_tpu.dataset import digits as ds_digits
+
+    if ds_common.cached_npz("mnist", "train"):
+        data_source = "cached_real_mnist"
+        train_reader, test_reader = dataset.mnist.train(), dataset.mnist.test()
+    elif ds_digits.available():
+        data_source = "real_uci_digits_upsampled"
+        train_reader = ds_digits.train_as_mnist()
+        test_reader = ds_digits.test_as_mnist()
+    else:
+        data_source = "synthetic_xor"
+
+        def _xor_reader(split: str, n: int):
+            # label = 2*pair + (s1*s2 > 0): within a pair both classes share
+            # E[x] = 0 (signs are +-1 uniform), so pixels carry no linear
+            # class-mean signal — disjoint generators per split
+            pats = np.random.RandomState(11).randn(5, 2, 784).astype(np.float32)
+
+            def reader():
+                r = np.random.RandomState(ds_common.synthetic_seed("xor", split))
+                for _ in range(n):
+                    p = r.randint(5)
+                    s1, s2 = r.choice([-1.0, 1.0], 2)
+                    img = s1 * pats[p, 0] + s2 * pats[p, 1] + r.randn(784).astype(np.float32) * 0.3
+                    yield np.tanh(img).astype(np.float32), int(2 * p + (s1 * s2 > 0))
+
+            return reader
+
+        train_reader, test_reader = _xor_reader("train", 4096), _xor_reader("test", 1024)
+
     out = {
         "artifact": "convergence",
-        "round": 4,
+        "round": 5,
         "platform": dev.platform,
         "device_kind": dev.device_kind,
         "cpu_mesh": cpu_mesh,
-        "data_source": "cached_real" if ds_common.cached_npz("mnist", "train") else "synthetic_blobs",
+        "data_source": data_source,
         "mnist": {},
         "resnet_cifar": {},
     }
     _write(out)
 
-    # ---- MNIST to >= 97% test accuracy ----
-    bs, eval_every, max_steps, target = 64, 100, 4000, 0.97
+    # ---- linear-probe floor: multinomial logistic regression on raw
+    # pixels over the SAME train/test split — the non-trivial baseline the
+    # model's accuracy must beat for the artifact to mean anything ----
+    try:
+        from itertools import islice
+
+        from sklearn.linear_model import LogisticRegression
+
+        # subsampled + budget-guarded: the probe is a baseline, not the
+        # artifact — it must never eat the chip window (cached_real_mnist
+        # would otherwise fit lbfgs on 60k x 784 for minutes)
+        if _left() < BUDGET_S * 0.7:
+            raise RuntimeError("skipped: budget")
+        PROBE_N = 5000
+        tr = list(islice(train_reader(), PROBE_N))
+        te = list(islice(test_reader(), PROBE_N))
+        Xtr = np.stack([t[0] for t in tr]).reshape(len(tr), -1)
+        ytr = np.asarray([t[1] for t in tr])
+        Xte = np.stack([t[0] for t in te]).reshape(len(te), -1)
+        yte = np.asarray([t[1] for t in te])
+        probe = LogisticRegression(max_iter=300).fit(Xtr, ytr)
+        linear_floor = float((probe.predict(Xte) == yte).mean())
+    except Exception as e:  # noqa: BLE001
+        linear_floor = None
+        out["linear_probe_error"] = f"{type(e).__name__}: {e}"[:200]
+    out["linear_probe_floor"] = linear_floor
+    _write(out)
+    print(f"data={data_source} linear_probe_floor={linear_floor}", file=sys.stderr)
+
+    # ---- MNIST-shaped task to >= 97% test accuracy ----
+    bs, eval_every, max_steps, target = 64, 100, 6000, 0.97
     spec = models.get_model("mnist")
-    train_r = reader.stack_batch(dataset.mnist.train(), bs)
+
+    def _augment(im_batch, r):
+        """Random +-2px shifts (train only): the standard small-sample
+        regularizer — with 1437 real digit scans (vs MNIST's 60k) the
+        un-augmented convnet plateaus ~94% on the unseen-writer test split."""
+        im = im_batch.reshape(-1, 28, 28)
+        out = np.empty_like(im)
+        for j in range(im.shape[0]):
+            dy, dx = r.randint(-2, 3, 2)
+            out[j] = np.roll(np.roll(im[j], dy, 0), dx, 1)
+        return out.reshape(im_batch.shape)
+
+    aug_rng = np.random.RandomState(123)
+    train_r = reader.stack_batch(train_reader, bs)
     test_batches = [
         (im.reshape(-1, 28, 28, 1), lb.astype(np.int32))
-        for im, lb in reader.stack_batch(dataset.mnist.test(), 256, drop_last=False)()
+        for im, lb in reader.stack_batch(test_reader, 256, drop_last=False)()
     ]
 
     first = next(iter(train_r()))
     ex_batch = (first[0].reshape(-1, 28, 28, 1), first[1].astype(np.int32))
+
+    # eval is ALWAYS single-device over the exact test set (the final
+    # ragged batch — e.g. digits' 359 = 256 + 103 — is not divisible by the
+    # mesh, and a mean-accuracy output can't be mask-corrected; the masked
+    # distributed eval path is covered by Trainer.evaluate's own tests)
+    acc_of = jax.jit(
+        lambda v, im, lb: spec.model.apply(v, im, lb, is_train=False)[0][1]
+    )
 
     if cpu_mesh:
         from paddle_tpu.parallel import DataParallel
@@ -115,20 +201,18 @@ def main() -> int:
         dp = DataParallel(spec.model, spec.optimizer(), mesh=make_mesh({"data": 8}))
         v, o = dp.init(0, *ex_batch)
         step = lambda v, o, im, lb: dp.step(v, o, im, lb)
-        acc_of = lambda v, im, lb: dp.eval_step(v, im, lb)[1]
     else:
         v = spec.model.init(0, *ex_batch)
         opt = spec.optimizer()
         o = opt.create_state(v.params)
         step = jax.jit(opt.minimize(spec.model))
-        acc_of = jax.jit(
-            lambda v, im, lb: spec.model.apply(v, im, lb, is_train=False)[0][1]
-        )
 
     def test_acc(v):
+        # replicated mesh params -> host once, then plain single-device jit
+        vh = jax.device_get(v) if cpu_mesh else v
         correct = total = 0.0
         for im, lb in test_batches:
-            a = float(jax.device_get(acc_of(v, im, lb)))
+            a = float(jax.device_get(acc_of(vh, im, lb)))
             correct += a * len(lb)
             total += len(lb)
         return correct / total
@@ -143,7 +227,8 @@ def main() -> int:
         except StopIteration:
             it = iter(train_r())
             im, lb = next(it)
-        res = step(v, o, im.reshape(-1, 28, 28, 1), lb.astype(np.int32))
+        res = step(v, o, _augment(im, aug_rng).reshape(-1, 28, 28, 1),
+                   lb.astype(np.int32))
         v, o = res.variables, res.opt_state
         if s % 25 == 0:
             curve.append([s, round(float(jax.device_get(res.loss)), 4)])
@@ -169,7 +254,16 @@ def main() -> int:
         if _left() < 120:
             out["mnist"]["aborted"] = "budget"
             break
-    out["mnist"]["pass"] = reached is not None
+    # pass = target reached AND the model beats the linear-probe floor —
+    # accuracy that a linear model matches proves nothing about the trainer
+    best_acc = max((a for _, a in accs), default=0.0)
+    out["mnist"]["best_test_acc"] = best_acc
+    out["mnist"]["exceeds_linear_floor"] = (
+        None if linear_floor is None else bool(best_acc > linear_floor)
+    )
+    out["mnist"]["pass"] = reached is not None and (
+        linear_floor is None or best_acc > linear_floor
+    )
     _write(out)
 
     # ---- cifar ResNet: ~200-step loss curve ----
