@@ -15,6 +15,7 @@ ready-queue scheduler, no NCCL group guard.
 
 from __future__ import annotations
 
+import math
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
@@ -114,6 +115,15 @@ class DataParallel:
             for b in batch
         )
 
+    def _spec_dim_size(self, axes) -> int:
+        """Total mesh extent a spec entry shards one dim over (1 if None)."""
+        if axes is None:
+            return 1
+        size = 1
+        for a in (axes if isinstance(axes, tuple) else (axes,)):
+            size *= self.mesh.shape[a]
+        return size
+
     def _validate_batch(self, batch, shards):
         """Friendly divisibility check of each arg dim against the mesh-axis
         sizes its spec shards it over (beats XLA's uneven-sharding error)."""
@@ -122,9 +132,7 @@ class DataParallel:
             for dim, axes in enumerate(s.spec[: len(shape)]):
                 if axes is None:
                     continue
-                size = 1
-                for a in (axes if isinstance(axes, tuple) else (axes,)):
-                    size *= self.mesh.shape[a]
+                size = self._spec_dim_size(axes)
                 enforce(
                     shape[dim] % size == 0,
                     f"batch arg dim {dim} of size {shape[dim]} not divisible by "
@@ -167,22 +175,16 @@ class DataParallel:
         # REAL sharding (batch_specs may shard dim 0 over several axes, e.g.
         # P(('data','seq'))) — take the LCM across args, not the data-axis
         # size alone
-        import math
-
         mult = 1
         for s in self._batch_shardings(batch):
             axes = s.spec[0] if len(s.spec) else None
-            if axes is None:
-                continue
-            size = 1
-            for a in (axes if isinstance(axes, tuple) else (axes,)):
-                size *= self.mesh.shape[a]
-            mult = math.lcm(mult, size)
+            mult = math.lcm(mult, self._spec_dim_size(axes))
         target = to if to is not None else -(-n // mult) * mult
         enforce(
             target >= n and target % mult == 0,
             f"pad_batch: target {target} must be >= batch size {n} and "
-            f"divisible by the data-axis size {mult}",
+            f"divisible by the leading-dim shard multiple {mult} (LCM of "
+            "each arg's dim-0 sharding extents)",
         )
         mask = np.zeros((target,), np.float32)
         mask[:n] = 1.0

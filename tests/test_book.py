@@ -352,3 +352,49 @@ def test_image_classification(tmp_path):
         np.asarray(infer_model.apply(variables, jnp.asarray(img))[0]),
         rtol=1e-4, atol=1e-5,
     )
+
+
+def test_recognize_digits_real_data_to_accuracy():
+    """The reference book test trains on downloaded REAL MNIST to an
+    accuracy threshold (book/test_recognize_digits.py). Zero-egress
+    equivalent: bundled real UCI handwritten digits (dataset/digits.py,
+    unseen-writer test split), trained through the Trainer and scored with
+    the exact-N masked evaluate() over all 359 test samples."""
+    from paddle_tpu.dataset import digits as ds_digits
+    from paddle_tpu.trainer import Trainer
+
+    def net(img, label):
+        img = img.reshape(img.shape[0], 28, 28, 1)
+        conv = nets.simple_img_conv_pool(
+            img, num_filters=16, filter_size=5, pool_size=2, pool_stride=2,
+            act="relu")
+        logits = pt.layers.fc(conv.reshape(img.shape[0], -1), size=10,
+                              name="clf")
+        loss = pt.layers.softmax_with_cross_entropy(logits, label).mean()
+        return loss, logits
+
+    train_r = reader.stack_batch(
+        lambda: ((im, np.int64(lb)) for im, lb in ds_digits.train_as_mnist()()),
+        64,
+    )
+
+    def lab2d(b):  # labels as [B,1] int64 (softmax_with_cross_entropy shape)
+        return b[0].astype(np.float32), b[1].reshape(-1, 1)
+
+    tr = Trainer(lambda: pt.build(net, name="digits_book"),
+                 lambda: pt.optimizer.Adam(learning_rate=1e-3))
+    tr.train(num_epochs=30, reader=lambda: (lab2d(b) for b in train_r()))
+
+    test_r = reader.stack_batch(
+        lambda: ((im, np.int64(lb)) for im, lb in ds_digits.test_as_mnist()()),
+        128, drop_last=False,
+    )
+    acc = tr.evaluate(
+        lambda: (lab2d(b) for b in test_r()),
+        lambda out, x, y: (np.asarray(jnp.argmax(out[1], -1))
+                           == np.asarray(y)[:, 0]),
+    )
+    # real data, unseen writers, ~30 epochs of 1437 samples: above the
+    # ~90% linear-probe floor (CONVERGENCE_r05.json) but below the
+    # augmented 97% ceiling — the bound pins learning, not the ceiling
+    assert acc > 0.90, acc
