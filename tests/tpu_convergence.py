@@ -94,32 +94,35 @@ def main() -> int:
     #    so a linear probe sits near chance while the convnet can solve it.
     from paddle_tpu.dataset import digits as ds_digits
 
-    if ds_common.cached_npz("mnist", "train"):
+    forced = os.environ.get("PT_CONV_FORCE_SOURCE")  # e.g. "xor": exercise
+    # the sklearn-less fallback path on a host that has sklearn
+    if forced not in (None, "xor"):
+        raise SystemExit(f"PT_CONV_FORCE_SOURCE={forced!r} not recognized")
+    def _xor_reader(split: str, n: int):
+        # label = 2*pair + (s1*s2 > 0): within a pair both classes share
+        # E[x] = 0 (signs are +-1 uniform), so pixels carry no linear
+        # class-mean signal — disjoint generators per split
+        pats = np.random.RandomState(11).randn(5, 2, 784).astype(np.float32)
+
+        def reader():
+            r = np.random.RandomState(ds_common.synthetic_seed("xor", split))
+            for _ in range(n):
+                p = r.randint(5)
+                s1, s2 = r.choice([-1.0, 1.0], 2)
+                img = s1 * pats[p, 0] + s2 * pats[p, 1] + r.randn(784).astype(np.float32) * 0.3
+                yield np.tanh(img).astype(np.float32), int(2 * p + (s1 * s2 > 0))
+
+        return reader
+
+    if forced != "xor" and ds_common.cached_npz("mnist", "train"):
         data_source = "cached_real_mnist"
         train_reader, test_reader = dataset.mnist.train(), dataset.mnist.test()
-    elif ds_digits.available():
+    elif forced != "xor" and ds_digits.available():
         data_source = "real_uci_digits_upsampled"
         train_reader = ds_digits.train_as_mnist()
         test_reader = ds_digits.test_as_mnist()
     else:
         data_source = "synthetic_xor"
-
-        def _xor_reader(split: str, n: int):
-            # label = 2*pair + (s1*s2 > 0): within a pair both classes share
-            # E[x] = 0 (signs are +-1 uniform), so pixels carry no linear
-            # class-mean signal — disjoint generators per split
-            pats = np.random.RandomState(11).randn(5, 2, 784).astype(np.float32)
-
-            def reader():
-                r = np.random.RandomState(ds_common.synthetic_seed("xor", split))
-                for _ in range(n):
-                    p = r.randint(5)
-                    s1, s2 = r.choice([-1.0, 1.0], 2)
-                    img = s1 * pats[p, 0] + s2 * pats[p, 1] + r.randn(784).astype(np.float32) * 0.3
-                    yield np.tanh(img).astype(np.float32), int(2 * p + (s1 * s2 > 0))
-
-            return reader
-
         train_reader, test_reader = _xor_reader("train", 4096), _xor_reader("test", 1024)
 
     out = {
