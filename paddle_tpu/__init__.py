@@ -71,13 +71,18 @@ from paddle_tpu.tensor import create_lod_tensor, create_random_int_lodtensor
 from paddle_tpu.inferencer import Inferencer
 from paddle_tpu.reader.feeder import DataFeeder, FeedSpec
 from paddle_tpu import transpiler
-from paddle_tpu.transpiler import memory_optimize, release_memory
+from paddle_tpu.transpiler import DistributeTranspiler, memory_optimize, release_memory
 from paddle_tpu import dataset
 from paddle_tpu import debugger
+from paddle_tpu import recordio_writer
 from paddle_tpu.core import profiler
 
 CPUPlace = config.CPUPlace
 TPUPlace = config.TPUPlace
+# fluid.ParallelExecutor's replacement is DataParallel (one pjit step over a
+# Mesh — see parallel/data_parallel.py header); the reference name resolves
+# to it so ported call sites find the modern driver under the old name
+ParallelExecutor = DataParallel
 
 __all__ = [
     "__version__",
@@ -115,6 +120,9 @@ __all__ = [
     "checkpoint",
     "parallel",
     "DataParallel",
+    "ParallelExecutor",
+    "DistributeTranspiler",
+    "recordio_writer",
     "trainer",
     "Trainer",
     "CheckpointConfig",
