@@ -75,6 +75,7 @@ class DataParallel:
         self.zero_shard_optimizer = zero_shard_optimizer
         self._step_fn = None
         self._eval_fn = None
+        self._ragged_step_fns: dict = {}
         enforce(
             batch_axis in self.mesh.axis_names,
             f"batch axis {batch_axis!r} not in mesh axes {self.mesh.axis_names}",
@@ -114,6 +115,30 @@ class DataParallel:
             NamedSharding(self.mesh, P(self.batch_axis, *([None] * (jax.numpy.ndim(b) - 1))))
             for b in batch
         )
+
+    def batch_divisible(self, *batch) -> bool:
+        """True iff EVERY arg's leading dim divides its own dim-0 shard
+        extent (per-arg, mirroring ``_validate_batch`` — a replicated side
+        input must not veto the sharded args, and vice versa)."""
+        for b, s in zip(batch, self._batch_shardings(batch)):
+            shape = jax.numpy.shape(b)
+            if not shape:
+                continue
+            axes = s.spec[0] if len(s.spec) else None
+            if shape[0] % self._spec_dim_size(axes) != 0:
+                return False
+        return True
+
+    def leading_multiple(self, batch) -> int:
+        """The multiple every arg's leading dim must divide to shard on this
+        mesh: LCM over each arg's ACTUAL dim-0 sharding extents (batch_specs
+        may shard dim 0 over several axes, e.g. P(('data','seq'))) — not the
+        data-axis size alone."""
+        mult = 1
+        for s in self._batch_shardings(batch):
+            axes = s.spec[0] if len(s.spec) else None
+            mult = math.lcm(mult, self._spec_dim_size(axes))
+        return mult
 
     def _spec_dim_size(self, axes) -> int:
         """Total mesh extent a spec entry shards one dim over (1 if None)."""
@@ -171,14 +196,7 @@ class DataParallel:
                 int(jax.numpy.shape(b)[0]) == n,
                 "pad_batch: all batch args must share the leading dim",
             )
-        # the multiple each arg's leading dim actually needs comes from its
-        # REAL sharding (batch_specs may shard dim 0 over several axes, e.g.
-        # P(('data','seq'))) — take the LCM across args, not the data-axis
-        # size alone
-        mult = 1
-        for s in self._batch_shardings(batch):
-            axes = s.spec[0] if len(s.spec) else None
-            mult = math.lcm(mult, self._spec_dim_size(axes))
+        mult = self.leading_multiple(batch)
         target = to if to is not None else -(-n // mult) * mult
         enforce(
             target >= n and target % mult == 0,
@@ -235,6 +253,28 @@ class DataParallel:
         return var_sh, opt_sh
 
     # -- compiled steps -----------------------------------------------------
+    def _build_step_fn(self, variables, opt_state, batch_shardings, donate):
+        """Shared jit construction for step/step_ragged: only the batch
+        placement and donation differ between the two."""
+        raw = self.optimizer.minimize(self.model, loss_index=self.loss_index)
+
+        def positional(variables, opt_state, rng, *b):
+            return raw(variables, opt_state, *b, rng=rng)
+
+        var_sh, opt_sh = self._state_shardings(variables, opt_state)
+        rep = replicated(self.mesh)
+        in_sh = (var_sh, opt_sh, rep) + tuple(batch_shardings)
+        # pin outputs too: without this XLA may propagate a different
+        # sharding onto updated params (e.g. expert-sharded router
+        # weights) and the NEXT step's declared in_shardings would
+        # reject them. loss/outputs/finite replicate — FetchOpHandle
+        # gathered per-device outputs the same way (fetch_op_handle.cc)
+        out_sh = StepOutput(var_sh, opt_sh, rep, rep, rep)
+        return jax.jit(
+            positional, donate_argnums=donate, in_shardings=in_sh,
+            out_shardings=out_sh,
+        )
+
     def step(self, variables: Variables, opt_state: OptState, *batch, rng=None) -> StepOutput:
         """One compiled data-parallel train step. The jit carries explicit
         ``in_shardings`` built from ``batch_specs`` (default: leading-dim
@@ -244,28 +284,45 @@ class DataParallel:
         (``framework/parallel_executor.cc:330``). ``put_batch`` first is still
         the efficient path (it also validates divisibility)."""
         if self._step_fn is None:
-            raw = self.optimizer.minimize(self.model, loss_index=self.loss_index)
-
-            def positional(variables, opt_state, rng, *b):
-                return raw(variables, opt_state, *b, rng=rng)
-
-            donate = (0, 1) if self.donate else ()
-            var_sh, opt_sh = self._state_shardings(variables, opt_state)
-            rep = replicated(self.mesh)
-            in_sh = (var_sh, opt_sh, rep) + self._batch_shardings(batch)
-            # pin outputs too: without this XLA may propagate a different
-            # sharding onto updated params (e.g. expert-sharded router
-            # weights) and the NEXT step's declared in_shardings would
-            # reject them. loss/outputs/finite replicate — FetchOpHandle
-            # gathered per-device outputs the same way (fetch_op_handle.cc)
-            out_sh = StepOutput(var_sh, opt_sh, rep, rep, rep)
-            self._step_fn = jax.jit(
-                positional, donate_argnums=donate, in_shardings=in_sh,
-                out_shardings=out_sh,
+            self._step_fn = self._build_step_fn(
+                variables, opt_state, self._batch_shardings(batch),
+                donate=(0, 1) if self.donate else (),
             )
         self._validate_batch(batch, self._batch_shardings(batch))
         with self.mesh:
             return self._step_fn(variables, opt_state, rng, *batch)
+
+    # distinct ragged tail shapes a variable-batch reader may produce; the
+    # FIFO bound keeps a bucketed reader from accreting compiled steps
+    _RAGGED_CACHE_MAX = 8
+
+    def step_ragged(self, variables: Variables, opt_state: OptState, *batch, rng=None) -> StepOutput:
+        """Train step for a batch whose leading dim does NOT divide the
+        mesh: the batch is fed REPLICATED (every device computes the whole
+        small batch redundantly) while params/opt state keep their mesh
+        shardings, so the update is numerically identical to a single-device
+        step on that batch and the training state never leaves the mesh.
+
+        This completes data_balance parity on the TRAIN side (the reference
+        trains on every sample, ``details/data_balance_op_handle.cc:154``):
+        ``Trainer.train(..., allow_ragged=True)`` routes the final partial
+        batch here. Cost: one extra compile per distinct ragged shape
+        (typically one — the dataset's tail size; at most
+        ``_RAGGED_CACHE_MAX`` retained) and redundant compute for that
+        single batch per epoch; the steady-state path is untouched.
+        No donation: the step-fn cache is keyed per shape, and donated
+        buffers from a rarely-used variant would invalidate the caller's
+        arrays for the common path."""
+        key = tuple(jax.numpy.shape(b) for b in batch)
+        if key not in self._ragged_step_fns:
+            if len(self._ragged_step_fns) >= self._RAGGED_CACHE_MAX:
+                self._ragged_step_fns.pop(next(iter(self._ragged_step_fns)))
+            rep = replicated(self.mesh)
+            self._ragged_step_fns[key] = self._build_step_fn(
+                variables, opt_state, tuple(rep for _ in batch), donate=(),
+            )
+        with self.mesh:
+            return self._ragged_step_fns[key](variables, opt_state, rng, *batch)
 
     def eval_step(self, variables: Variables, *batch, rng=None):
         if self._eval_fn is None:

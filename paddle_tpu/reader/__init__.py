@@ -382,6 +382,10 @@ class DevicePrefetcher:
     keeps ``depth`` batches transferred ahead of compute."""
 
     def __init__(self, it: Iterable, device=None, depth: Optional[int] = None):
+        """``device``: a placement (device/sharding pytree), None for the
+        default device, or a CALLABLE item -> placement for streams whose
+        batches need different placements (e.g. a ragged tail batch that
+        cannot take the sharded placement of the full batches)."""
         from paddle_tpu.core import config as cfg
 
         self._it = iter(it)
@@ -397,7 +401,10 @@ class DevicePrefetcher:
 
         try:
             for item in self._it:
-                dev_item = jax.device_put(item, self._device)
+                placement = (
+                    self._device(item) if callable(self._device) else self._device
+                )
+                dev_item = jax.device_put(item, placement)
                 self._q.put(dev_item)
             self._q.put(self._end)
         except BaseException as e:  # surface pipeline errors, don't fake EOF

@@ -177,11 +177,20 @@ class Trainer:
         event_handler: Optional[Callable[[Any], None]] = None,
         reader: Optional[Callable[[], Iterable[Tuple]]] = None,
         feed_order=None,  # accepted for API parity; batches are positional
+        allow_ragged: bool = False,
     ):
         """Run the training loop (reference ``Trainer.train`` →
         ``_train_by_executor``/``_train_by_parallel_executor``,
-        trainer.py:404,541)."""
+        trainer.py:404,541).
+
+        ``allow_ragged``: in parallel mode, a batch whose leading dim does
+        not divide the mesh trains through ``DataParallel.step_ragged``
+        (replicated batch, sharded params — numerically a single-device
+        step) instead of raising, so ``drop_last=False`` readers train on
+        EVERY sample, the reference's data_balance guarantee
+        (``details/data_balance_op_handle.cc:154``)."""
         enforce(reader is not None, "Trainer.train needs a batched reader")
+        self._allow_ragged = allow_ragged
         handler = event_handler or (lambda event: None)
         # a Trainer may be re-entered after a preempted run (in-process
         # resume): stale flags must not end the new loop after one step
@@ -311,7 +320,15 @@ class Trainer:
         if first is None:
             return
         if self.parallel:
-            placement = tuple(self._dp._batch_shardings(first))
+            shardings = tuple(self._dp._batch_shardings(first))
+            if getattr(self, "_allow_ragged", False):
+                # a ragged tail batch cannot take the sharded placement —
+                # send it to the default device; step_ragged replicates it
+                placement = lambda item: (
+                    shardings if self._dp.batch_divisible(*item) else None
+                )
+            else:
+                placement = shardings
         else:
             placement = self.exe._device
         yield first
@@ -319,6 +336,12 @@ class Trainer:
 
     def _run_step(self, batch) -> StepOutput:
         if self.parallel:
+            if getattr(self, "_allow_ragged", False) and \
+                    not self._dp.batch_divisible(*batch):
+                return self._dp.step_ragged(
+                    self.variables, self.opt_state,
+                    *[jax.numpy.asarray(b) for b in batch],
+                )
             dev_batch = self._dp.put_batch(*batch)
             return self._dp.step(self.variables, self.opt_state, *dev_batch)
         step_fn = self._compiled_step()
