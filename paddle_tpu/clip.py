@@ -61,3 +61,40 @@ class GradientClipByGlobalNorm(GradientClipBase):
 def global_norm(grads) -> jax.Array:
     leaves = [g.astype(jnp.float32) for g in jax.tree_util.tree_leaves(grads)]
     return jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+
+
+# -- error clip (backprop-side) ---------------------------------------------
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def error_clip_by_value(x: jax.Array, max: float, min: float) -> jax.Array:
+    """Identity forward; clips the GRADIENT flowing back through this point
+    to [min, max] — the functional form of the reference's per-variable
+    ``error_clip`` (``clip.py:41`` ErrorClipByValue, applied to a var's
+    gradient during append_backward). Insert at the tensor whose incoming
+    error should be clipped. max/min are static (nondiff_argnums, the
+    convention of the repo's other custom_vjp sites)."""
+    return x
+
+
+def _ecv_fwd(x, max, min):
+    return x, None
+
+
+def _ecv_bwd(max, min, _res, g):
+    return (jnp.clip(g, min, max),)
+
+
+error_clip_by_value.defvjp(_ecv_fwd, _ecv_bwd)
+
+
+class ErrorClipByValue(GradientClipByValue):
+    """Reference ``clip.py:41``: clip the error (gradient) of a variable to
+    [min, max] during backprop. Functional usage — wrap the tensor inside
+    the model: ``x = ErrorClipByValue(max=5.0).apply(x)`` (identity forward,
+    clipped cotangent); calling on a gradient pytree behaves like
+    :class:`GradientClipByValue`."""
+
+    def apply(self, x: jax.Array) -> jax.Array:
+        return error_clip_by_value(x, self.max, self.min)

@@ -148,3 +148,26 @@ def start_profiler(log_dir: Optional[str] = None) -> None:
 def stop_profiler() -> dict:
     jax.profiler.stop_trace()
     return disable_profiler()
+
+
+def reset_profiler() -> None:
+    """Clear recorded host spans (reference ``profiler.py:104`` — works for
+    start/stop/``profiler``, not the CUDA runtime profiler)."""
+    _events.clear()
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    """Reference ``profiler.py:39`` is a thin shim over the CUDA runtime
+    profiler — there is no CUDA on TPU, so this delegates to the host/XLA
+    profiler (``profiler(log_dir=...)``) and warns once, keeping ported
+    scripts running with equivalent (better: device-aware) tracing."""
+    import warnings
+
+    warnings.warn(
+        "cuda_profiler: no CUDA runtime on TPU; delegating to the XLA "
+        "profiler (see paddle_tpu.core.profiler.profiler)",
+        stacklevel=2,
+    )
+    with profiler(log_dir=output_file):
+        yield

@@ -51,3 +51,13 @@ def guard(generator: Generator | None = None):
         yield _stack()[-1]
     finally:
         _stack().pop()
+
+
+def switch(new_generator=None):
+    """Swap the CURRENT frame's generator (reference ``unique_name.py:61``):
+    installs ``new_generator`` (or a fresh one) at the top of this thread's
+    stack and returns the previous generator."""
+    stack = _stack()
+    old = stack[-1]
+    stack[-1] = new_generator if new_generator is not None else Generator()
+    return old

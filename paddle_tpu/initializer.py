@@ -137,3 +137,31 @@ TruncatedNormalInitializer = TruncatedNormal
 XavierInitializer = Xavier
 MSRAInitializer = MSRA
 BilinearInitializer = Bilinear
+
+
+# -- init placement hints (reference initializer.py:32-66) -------------------
+_force_init_on_cpu = False
+
+
+def force_init_on_cpu() -> bool:
+    """Reference ``initializer.py:32``: query the init-on-CPU flag. On TPU
+    the flag is a hint only — initializer MATH is identical everywhere and
+    XLA owns placement; jit-traced init folds into the compiled program
+    regardless of host-side device context."""
+    return _force_init_on_cpu
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def init_on_cpu():
+    """Reference ``initializer.py:49``: run initializers under the CPU-init
+    hint (see :func:`force_init_on_cpu` for TPU semantics)."""
+    global _force_init_on_cpu
+    prev = _force_init_on_cpu
+    _force_init_on_cpu = True
+    try:
+        yield
+    finally:
+        _force_init_on_cpu = prev
