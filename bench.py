@@ -322,6 +322,18 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
                 if dcfg.get("scan_layers") else None
             )
 
+            def time_fn(fn, fetch, reps=3):
+                """Shared timing discipline for every decode-path variant:
+                warmup call, then reps timed calls, device_get sync via
+                ``fetch`` (see _bench_step for why not block_until_ready)."""
+                o = fn()
+                fetch(o)
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    o = fn()
+                fetch(o)
+                return (time.perf_counter() - t0) / reps
+
             def time_gen(bs, mnt, **gen_kw):
                 prompt = jnp.asarray(
                     drng.randint(1, dcfg["vocab"], size=(bs, Tp)).astype(np.int32)
@@ -330,14 +342,10 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
                     transformer_lm.generate, max_new_tokens=mnt, cfg=dcfg,
                     stacked_params=dstacked, **gen_kw,
                 ))
-                o = fn(dvars, prompt)
-                int(jax.device_get(o[0, -1]))
-                reps = 3
-                t0 = time.perf_counter()
-                for _ in range(reps):
-                    o = fn(dvars, prompt)
-                int(jax.device_get(o[0, -1]))
-                return (time.perf_counter() - t0) / reps
+                return time_fn(
+                    lambda: fn(dvars, prompt),
+                    lambda o: int(jax.device_get(o[0, -1])),
+                )
 
             for bs in bss:
                 if time.monotonic() > deadline - 30:
@@ -370,6 +378,37 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
                     result["notes"].append("decode_bf16cache_noise_dominated")
             elif not tiny:
                 result["notes"].append("decode_bf16cache_skipped_budget")
+            # beam decode (first-class path, scanned layer loop r5): same
+            # prefill-subtraction discipline as the decode rows — the rate
+            # covers only the beam scan steps, comparable to decode_tok_*
+            if not tiny and time.monotonic() < deadline - 30:
+                beam_bs, beam_mnt = 2, 16
+                bprompt = jnp.asarray(
+                    drng.randint(1, dcfg["vocab"], size=(beam_bs, Tp)).astype(np.int32)
+                )
+
+                def time_beam(mnt):
+                    fn = jax.jit(functools.partial(
+                        transformer_lm.generate_beam, max_new_tokens=mnt,
+                        cfg=dcfg, beam_size=4, stacked_params=dstacked,
+                    ))
+                    return time_fn(
+                        lambda: fn(dvars, bprompt),
+                        lambda o: int(jax.device_get(o[0][0, 0, -1])),
+                    )
+
+                t_bpre = time_beam(1)
+                t_bfull = time_beam(1 + beam_mnt)
+                if t_bfull - t_bpre > t_bpre * 0.05:
+                    result["beam_tok_per_sec_bs2_beam4"] = round(
+                        beam_bs * beam_mnt / (t_bfull - t_bpre), 1
+                    )
+                    print(f"beam decode: {result['beam_tok_per_sec_bs2_beam4']} tok/s",
+                          file=sys.stderr)
+                else:
+                    result["notes"].append("beam_noise_dominated")
+            elif not tiny:
+                result["notes"].append("beam_skipped_budget")
         except Exception as e:
             result["notes"].append(f"decode_failed: {type(e).__name__}: {e}"[:300])
         checkpoint_result()

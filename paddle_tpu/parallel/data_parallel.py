@@ -129,7 +129,7 @@ class DataParallel:
                 return False
         return True
 
-    def leading_multiple(self, batch) -> int:
+    def leading_multiple(self, *batch) -> int:
         """The multiple every arg's leading dim must divide to shard on this
         mesh: LCM over each arg's ACTUAL dim-0 sharding extents (batch_specs
         may shard dim 0 over several axes, e.g. P(('data','seq'))) — not the
@@ -196,7 +196,7 @@ class DataParallel:
                 int(jax.numpy.shape(b)[0]) == n,
                 "pad_batch: all batch args must share the leading dim",
             )
-        mult = self.leading_multiple(batch)
+        mult = self.leading_multiple(*batch)
         target = to if to is not None else -(-n // mult) * mult
         enforce(
             target >= n and target % mult == 0,
