@@ -16,17 +16,16 @@ ready-queue scheduler, no NCCL group guard.
 from __future__ import annotations
 
 import math
-from typing import Any, Callable, Optional, Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from paddle_tpu.core import logging as ptlog
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.framework import Model, Variables
 from paddle_tpu.optimizer import Optimizer, OptState, StepOutput
 from paddle_tpu.parallel import mesh as mesh_mod
-from paddle_tpu.parallel.sharding import batch_sharding, param_shardings, replicated, shard_variables
+from paddle_tpu.parallel.sharding import param_shardings, replicated, shard_variables
 
 
 class DataParallel:

@@ -19,8 +19,7 @@ writing (dtype coercion only; ragged padding stays a read-time concern).
 from __future__ import annotations
 
 import io
-import os
-from typing import Callable, Iterable, Optional, Sequence, Tuple
+from typing import Callable, Iterable, Sequence, Tuple
 
 import numpy as np
 

@@ -79,7 +79,6 @@ def main() -> int:
     except Exception:
         pass
 
-    import jax.numpy as jnp
     import numpy as np
 
     from paddle_tpu import dataset, models, reader
