@@ -508,6 +508,85 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
     print(json.dumps(result))
 
 
+def serve_main(duration_s: float = 3.0) -> dict:
+    """Serving-engine benchmark (``bench.py --serve``): closed-loop client
+    threads against ``paddle_tpu.serving.ServingEngine`` on CPU JAX.
+    Prints ONE JSON line: throughput (req/s), mean batch occupancy, and
+    p50/p99 request latency — the three numbers that tell whether dynamic
+    batching is doing its job (occupancy > 1 at sane tail latency)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import threading
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.reader.feeder import FeedSpec
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+
+    d_in, n_clients = 32, 8
+    result = {
+        "metric": "serving_requests_per_sec",
+        "value": 0.0,
+        "unit": "req/s",
+        "notes": [],
+    }
+    try:
+        def net(x):
+            h = pt.layers.fc(x, size=64, act="relu", name="fc1")
+            return pt.layers.fc(h, size=8, name="fc2")
+
+        model = pt.build(net)
+        rng = np.random.RandomState(0)
+        variables = model.init(0, rng.randn(4, d_in).astype(np.float32))
+        engine = ServingEngine(
+            model,
+            variables,
+            [FeedSpec("x", (d_in,), "float32")],
+            config=ServingConfig(
+                max_batch_size=16,
+                max_queue_delay_s=0.002,
+                queue_capacity=256,
+                num_replicas=2,
+            ),
+        )
+        stop = time.monotonic() + duration_s
+        counts = [0] * n_clients
+
+        def client(ci):
+            r = np.random.RandomState(ci)
+            while time.monotonic() < stop:
+                n = 1 + r.randint(4)  # mixed request sizes keep buckets honest
+                x = r.randn(n, d_in).astype(np.float32)
+                engine.infer({"x": x})
+                counts[ci] += 1
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=duration_s + 60)
+        dt = time.perf_counter() - t0
+        engine.close()
+        snap = engine.metrics.snapshot()
+        result["value"] = round(sum(counts) / dt, 1)
+        result["rows_per_sec"] = round(snap["rows_total"] / dt, 1)
+        result["batch_occupancy_mean"] = round(snap["mean_batch_occupancy"], 2)
+        result["p50_ms"] = round(snap["p50_ms"], 3)
+        result["p99_ms"] = round(snap["p99_ms"], 3)
+        result["batches_total"] = snap["batches_total"]
+        result["timeouts_total"] = snap["timeouts_total"]
+        result["errors_total"] = snap["errors_total"]
+        result["warmup_executables"] = snap["warmup_executables"]
+        result["distinct_dispatch_shapes"] = snap["distinct_dispatch_shapes"]
+    except Exception as e:  # same robustness contract as main(): always JSON
+        result["notes"].append(f"serve_failed: {type(e).__name__}: {e}"[:300])
+    print(json.dumps(result))
+    return result
+
+
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
 
@@ -613,5 +692,7 @@ def main() -> dict:
 if __name__ == "__main__":
     if "--child" in sys.argv:
         child_main(tiny="--tiny" in sys.argv, force_cpu="--cpu" in sys.argv)
+    elif "--serve" in sys.argv:
+        serve_main(duration_s=float(os.environ.get("PT_BENCH_SERVE_S", "3")))
     else:
         main()

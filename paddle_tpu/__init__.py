@@ -69,6 +69,8 @@ from paddle_tpu import nets
 from paddle_tpu import tensor
 from paddle_tpu.tensor import create_lod_tensor, create_random_int_lodtensor
 from paddle_tpu.inferencer import Inferencer
+from paddle_tpu import serving
+from paddle_tpu.serving import ServingConfig, ServingEngine
 from paddle_tpu.reader.feeder import DataFeeder, FeedSpec
 from paddle_tpu import transpiler
 from paddle_tpu.transpiler import DistributeTranspiler, memory_optimize, release_memory
@@ -132,6 +134,9 @@ __all__ = [
     "dataset",
     "debugger",
     "profiler",
+    "serving",
+    "ServingEngine",
+    "ServingConfig",
     "CPUPlace",
     "TPUPlace",
 ]

@@ -95,6 +95,14 @@ class Channel:
         with self._lock:
             return self._closed
 
+    def qsize(self) -> int:
+        """Number of values a receiver could take right now: buffered items
+        plus parked senders (a rendezvous sender counts — its value is
+        available). Advisory under concurrency, like ``queue.Queue.qsize``;
+        used for queue-depth gauges."""
+        with self._lock:
+            return len(self._buf) + len(self._senders)
+
     def _can_send_locked(self) -> bool:
         return not self._closed and (
             self.capacity > 0 and len(self._buf) < self.capacity

@@ -1,0 +1,30 @@
+"""Version compatibility shims for the underlying JAX installation.
+
+The codebase targets the current JAX API surface; this module papers over
+renames so the same call sites run on the older releases still found in
+hermetic containers.
+"""
+from __future__ import annotations
+
+import functools
+
+try:  # jax >= 0.5 exports it at top level
+    from jax import shard_map as _shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+import inspect
+
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+@functools.wraps(_shard_map)
+def shard_map(f, /, *args, **kwargs):
+    # check_rep (<= 0.4) was renamed check_vma (>= 0.5); translate whichever
+    # spelling the installed jax does not understand, drop it if unknown.
+    for old, new in (("check_vma", "check_rep"), ("check_rep", "check_vma")):
+        if old in kwargs and old not in _PARAMS:
+            val = kwargs.pop(old)
+            if new in _PARAMS:
+                kwargs.setdefault(new, val)
+    return _shard_map(f, *args, **kwargs)

@@ -96,6 +96,15 @@ def apply_compile_cache() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_compilation_cache_dir", dir_)  # enables last —
         # a failure above leaves the cache fully off, never half-configured
+        try:
+            from jax._src import compilation_cache as _cc
+
+            # jax latches "cache unused" on the first compile it sees; any
+            # jit before set_flags() would otherwise disable the cache for
+            # the rest of the process
+            _cc.reset_cache()
+        except Exception:
+            pass
         _compile_cache_applied = True
     except Exception as e:  # older jax without the knobs: soft-disable
         from paddle_tpu.core import logging as ptlog

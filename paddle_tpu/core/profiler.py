@@ -29,6 +29,46 @@ _spans: list[tuple[str, float, float, int]] = []
 _MAX_SPANS = 1_000_000
 _enabled: bool = False
 
+# -- counters/gauges: monotonically-increasing totals and last-value gauges
+# for long-running services (the serving engine's queue depth, batch
+# occupancy, timeout totals). Unlike record_event these are always on:
+# they are O(1) dict updates, and a serving process wants its counters
+# exported regardless of whether a profiling window is open.
+_metrics_lock = threading.Lock()
+_counters: dict[str, float] = defaultdict(float)
+_gauges: dict[str, float] = {}
+
+
+def inc_counter(name: str, value: float = 1.0) -> None:
+    """Add to a named monotonic counter (thread-safe)."""
+    with _metrics_lock:
+        _counters[name] += value
+
+
+def set_gauge(name: str, value: float) -> None:
+    """Set a named gauge to its latest value (thread-safe)."""
+    with _metrics_lock:
+        _gauges[name] = value
+
+
+def counters() -> dict[str, float]:
+    """Snapshot of all counters."""
+    with _metrics_lock:
+        return dict(_counters)
+
+
+def gauges() -> dict[str, float]:
+    """Snapshot of all gauges."""
+    with _metrics_lock:
+        return dict(_gauges)
+
+
+def reset_metrics() -> None:
+    """Clear counters and gauges (test isolation)."""
+    with _metrics_lock:
+        _counters.clear()
+        _gauges.clear()
+
 
 @contextlib.contextmanager
 def record_event(name: str) -> Iterator[None]:

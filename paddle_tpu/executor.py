@@ -57,9 +57,13 @@ class Executor:
         # key on the function object itself (kept alive by the cache) — an
         # id() key could collide after GC recycles the address
         cache_key = key if key is not None else (fn, tuple(donate_argnums), tuple(static_argnums))
-        if cache_key not in self._cache:
+        if cache_key in self._cache:
+            # LRU: refresh on hit so hot entries (serving buckets) are never
+            # evicted by a burst of cold one-off shapes
+            self._cache[cache_key] = self._cache.pop(cache_key)
+        else:
             if len(self._cache) >= self._max_cache:
-                # FIFO eviction: callers passing fresh closures per step would
+                # LRU eviction: callers passing fresh closures per step would
                 # otherwise leak a compiled executable per call
                 self._cache.pop(next(iter(self._cache)))
             self._cache[cache_key] = jax.jit(
@@ -75,6 +79,7 @@ class Executor:
         fn: Callable,
         *args,
         donate_argnums: Sequence[int] = (),
+        static_argnums: Sequence[int] = (),
         fetch: bool = False,
         **kwargs,
     ):
@@ -82,7 +87,9 @@ class Executor:
         device_get'ed to numpy (FetchOpHandle parity) and NaN/Inf-checked when
         flags().check_nan_inf is set (FLAGS_check_nan_inf,
         reference operator.cc:725-737)."""
-        compiled = self.prepare(fn, donate_argnums=donate_argnums)
+        compiled = self.prepare(
+            fn, donate_argnums=donate_argnums, static_argnums=static_argnums
+        )
         with prof.record_event(f"executor.run:{getattr(fn, '__name__', 'fn')}"):
             out = compiled(*args, **kwargs)
         if fetch:

@@ -3,7 +3,12 @@ Inferencer(infer_func, param_path, place) loads trained params and serves
 ``infer(feed)`` through a prepared executor).
 
 TPU-native: the infer function is built into a :class:`Model`, params load
-from a ``save_params`` directory, and inference is one jitted apply."""
+from a ``save_params`` directory, and inference dispatches through the
+shared :class:`paddle_tpu.executor.Executor` compile cache — the same
+cache the serving engine's AOT-warmed buckets live in, so a one-shot
+``infer`` and engine traffic never compile the same program twice. For
+sustained concurrent traffic, :meth:`as_engine` upgrades this one-shot
+client into a :class:`paddle_tpu.serving.ServingEngine`."""
 
 from __future__ import annotations
 
@@ -13,36 +18,74 @@ import jax
 
 from paddle_tpu import io as io_mod
 from paddle_tpu.core.enforce import enforce
+from paddle_tpu.executor import Executor
 from paddle_tpu.framework import Model, Variables, build
 
 __all__ = ["Inferencer"]
 
 
 class Inferencer:
-    def __init__(self, infer_func: Callable, param_path: str, place=None):
+    def __init__(
+        self,
+        infer_func: Callable,
+        param_path: str,
+        place=None,
+        feed_order: Optional[Sequence[Any]] = None,
+    ):
+        """``feed_order``: optional FeedSpec list (or slot-name list) fixing
+        the positional order dict feeds are unpacked in — the reference's
+        feed-target names. Without it, dict feeds fall back to insertion
+        order."""
         model = infer_func() if _is_builder(infer_func) else infer_func
         self.model = model if isinstance(model, Model) else build(model)
         self.variables = io_mod.load_params(param_path)
         self.place = place
-        self._jitted = None
+        self.feed_order = (
+            [getattr(s, "name", s) for s in feed_order] if feed_order else None
+        )
+        self._exe = Executor(place)
 
-    def infer(self, inputs: Sequence[Any]):
-        """Run inference on positional inputs (list/tuple, or the reference's
-        {name: value} dict — values are taken in insertion order)."""
+        def _fwd(variables, *args):
+            out, _ = self.model.apply(variables, *args, is_train=False)
+            return out
+
+        self._fwd = _fwd
+
+    def _ordered(self, feed: dict) -> list:
+        if self.feed_order is None:
+            return list(feed.values())  # legacy: raw insertion order
+        missing = [n for n in self.feed_order if n not in feed]
+        enforce(not missing, f"feed missing slots {missing}")
+        return [feed[n] for n in self.feed_order]
+
+    def infer(self, inputs):
+        """Run inference on positional inputs (list/tuple, or a {name: value}
+        dict — unpacked in ``feed_order`` when given, else insertion
+        order). Batched arrays pass straight through."""
         if isinstance(inputs, dict):
-            inputs = list(inputs.values())
+            inputs = self._ordered(inputs)
         enforce(isinstance(inputs, (list, tuple)), "inputs must be a sequence or dict")
-        if self._jitted is None:
-            from paddle_tpu.core import config as _cfg
+        compiled = self._exe.prepare(self._fwd, key=("inferencer", id(self)))
+        return compiled(self.variables, *[jax.numpy.asarray(a) for a in inputs])
 
-            _cfg.apply_compile_cache()
+    @property
+    def executor(self) -> Executor:
+        """The compile-cache-owning executor (shared with serving warmup
+        when an engine is built from this inferencer's model)."""
+        return self._exe
 
-            def fwd(variables, *args):
-                out, _ = self.model.apply(variables, *args, is_train=False)
-                return out
+    def as_engine(self, feed_specs, config=None):
+        """Upgrade to a dynamically-batched serving engine (the Inferencer
+        is the one-shot client; the engine is the production path)."""
+        from paddle_tpu.serving import ServingEngine
 
-            self._jitted = jax.jit(fwd)
-        return self._jitted(self.variables, *[jax.numpy.asarray(a) for a in inputs])
+        return ServingEngine(
+            self.model,
+            self.variables,
+            feed_specs,
+            config=config,
+            place=self.place,
+        )
 
 
 def _is_builder(fn: Callable) -> bool:

@@ -22,6 +22,11 @@ import numpy as np
 
 import jax
 
+try:
+    import jax.export  # some versions don't re-export it from jax/__init__
+except ImportError:  # pragma: no cover - very old jax; errors surface at use
+    pass
+
 from paddle_tpu.core import logging as ptlog
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.framework import Model, Variables

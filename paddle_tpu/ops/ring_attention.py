@@ -20,9 +20,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from paddle_tpu.core.compat import shard_map
 from paddle_tpu.core.dtypes import NEG_INF
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.ops.pallas.flash_attention import _float0_like

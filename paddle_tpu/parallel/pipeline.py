@@ -115,7 +115,7 @@ def pipeline_apply(
         outs = jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs))
         return jax.lax.psum(outs, axis)
 
-    from jax import shard_map
+    from paddle_tpu.core.compat import shard_map
 
     # microbatch rows shard over the non-pipe axes (params stay replicated
     # there): pipeline composes with data parallelism instead of every

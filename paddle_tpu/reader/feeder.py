@@ -25,6 +25,27 @@ class FeedSpec:
         self.ragged = ragged
         self.max_len = max_len
 
+    @property
+    def is_ragged(self) -> bool:
+        """True when any per-sample dim is variable (``ragged`` flag or a
+        ``None`` dim) — such slots need length bucketing to serve
+        (``paddle_tpu.serving.buckets``)."""
+        return self.ragged or any(d is None for d in self.shape)
+
+    def ragged_dims(self) -> Tuple[int, ...]:
+        """Indices of the variable per-sample dims (``ragged`` with a fully
+        fixed shape means the LEAD dim varies, DataFeeder-style)."""
+        dims = tuple(i for i, d in enumerate(self.shape) if d is None)
+        if self.ragged and not dims:
+            dims = (0,)
+        return dims
+
+    def __repr__(self):
+        return (
+            f"FeedSpec({self.name!r}, shape={self.shape}, "
+            f"dtype={self.dtype.name}, ragged={self.ragged})"
+        )
+
 
 class DataFeeder:
     def __init__(self, feed_list: Sequence[FeedSpec]):

@@ -1,0 +1,47 @@
+"""paddle_tpu.serving — dynamically-batched TPU inference serving.
+
+The production path from "trained model" to "heavy concurrent traffic":
+requests queue on a bounded :class:`paddle_tpu.concurrency.Channel`, a
+dynamic micro-batcher groups them into zero-padded shape buckets (AOT
+compiled at startup via ``Executor.prepare``), and batches round-robin
+across one replica per local device. See ``serving.engine`` for the full
+design; the reference stack's analogue is the Fluid inference engine
+behind the gRPC ``listen_and_serv`` server.
+
+Quickstart::
+
+    from paddle_tpu.serving import ServingEngine, ServingConfig
+    from paddle_tpu.reader.feeder import FeedSpec
+
+    engine = ServingEngine(
+        infer_net, "ckpt/params",
+        feed_specs=[FeedSpec("x", (784,), "float32")],
+        config=ServingConfig(max_batch_size=16, max_queue_delay_s=0.002),
+    )
+    logits = engine.infer({"x": batch})     # sync
+    fut = engine.submit({"x": batch})        # async → fut.result()
+    engine.close()                           # graceful drain
+"""
+
+from paddle_tpu.serving.batcher import Group, MicroBatcher
+from paddle_tpu.serving.buckets import ShapeBuckets
+from paddle_tpu.serving.engine import (
+    DeadlineExceeded,
+    EngineClosedError,
+    PendingResult,
+    ServingConfig,
+    ServingEngine,
+)
+from paddle_tpu.serving.metrics import ServingMetrics
+
+__all__ = [
+    "ServingEngine",
+    "ServingConfig",
+    "PendingResult",
+    "DeadlineExceeded",
+    "EngineClosedError",
+    "MicroBatcher",
+    "Group",
+    "ShapeBuckets",
+    "ServingMetrics",
+]

@@ -1,0 +1,131 @@
+"""Serving observability: request/batch counters, queue-depth gauge, and a
+latency reservoir with percentile readout.
+
+Everything mirrors into the framework-wide counter/gauge registry in
+``paddle_tpu.core.profiler`` (``serving.*`` names) so one scrape point sees
+the whole process; :meth:`ServingMetrics.snapshot` returns the same data as
+a plain dict for tests and the bench CLI.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+from typing import Dict, Optional
+
+from paddle_tpu.core import profiler as prof
+
+__all__ = ["ServingMetrics"]
+
+
+def _percentile(sorted_values, q: float) -> float:
+    """Nearest-rank percentile on an already-sorted list."""
+    if not sorted_values:
+        return 0.0
+    rank = max(1, math.ceil(q / 100.0 * len(sorted_values)))
+    return sorted_values[rank - 1]
+
+
+class ServingMetrics:
+    """Thread-safe counters for one engine instance."""
+
+    def __init__(self, latency_window: int = 8192):
+        self._lock = threading.Lock()
+        self.requests_total = 0
+        self.responses_total = 0
+        self.timeouts_total = 0
+        self.errors_total = 0
+        self.batches_total = 0
+        self.rows_total = 0          # real rows dispatched (excl. padding)
+        self.padded_rows_total = 0   # zero rows added by bucketing
+        self.padded_batches_total = 0  # batches where bucket_b > rows
+        self.warmup_executables = 0
+        self.dispatch_shapes: set = set()  # distinct (sig, bucket_b) sent
+        self._latencies = collections.deque(maxlen=latency_window)
+
+    # -- recorders (called from engine/batcher/worker threads) -------------
+
+    def record_submit(self, rows: int, queue_depth: int) -> None:
+        with self._lock:
+            self.requests_total += 1
+        prof.inc_counter("serving.requests_total")
+        prof.set_gauge("serving.queue_depth", queue_depth)
+
+    def record_batch(self, rows: int, bucket_rows: int, sig) -> None:
+        with self._lock:
+            self.batches_total += 1
+            self.rows_total += rows
+            self.padded_rows_total += bucket_rows - rows
+            if bucket_rows > rows:
+                self.padded_batches_total += 1
+            self.dispatch_shapes.add((sig, bucket_rows))
+        prof.inc_counter("serving.batches_total")
+        prof.inc_counter("serving.rows_total", rows)
+        prof.set_gauge("serving.last_batch_occupancy", rows / bucket_rows)
+
+    def record_response(self, latency_s: float) -> None:
+        with self._lock:
+            self.responses_total += 1
+            self._latencies.append(latency_s)
+        prof.inc_counter("serving.responses_total")
+
+    def record_timeout(self) -> None:
+        with self._lock:
+            self.timeouts_total += 1
+        prof.inc_counter("serving.timeouts_total")
+
+    def record_error(self, n: int = 1) -> None:
+        with self._lock:
+            self.errors_total += n
+        prof.inc_counter("serving.errors_total", n)
+
+    def record_warmup(self, n: int = 1) -> None:
+        with self._lock:
+            self.warmup_executables += n
+        prof.inc_counter("serving.warmup_executables", n)
+
+    def set_queue_depth(self, depth: int) -> None:
+        prof.set_gauge("serving.queue_depth", depth)
+
+    # -- readout -----------------------------------------------------------
+
+    def mean_batch_occupancy(self) -> float:
+        """Mean real rows per dispatched batch — > 1 means the dynamic
+        batcher is actually coalescing traffic."""
+        with self._lock:
+            if self.batches_total == 0:
+                return 0.0
+            return self.rows_total / self.batches_total
+
+    def latency_percentiles(self) -> Dict[str, float]:
+        with self._lock:
+            vals = sorted(self._latencies)
+        return {
+            "p50_ms": _percentile(vals, 50) * 1e3,
+            "p99_ms": _percentile(vals, 99) * 1e3,
+        }
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            vals = sorted(self._latencies)
+            snap = {
+                "requests_total": self.requests_total,
+                "responses_total": self.responses_total,
+                "timeouts_total": self.timeouts_total,
+                "errors_total": self.errors_total,
+                "batches_total": self.batches_total,
+                "rows_total": self.rows_total,
+                "padded_rows_total": self.padded_rows_total,
+                "padded_batches_total": self.padded_batches_total,
+                "warmup_executables": self.warmup_executables,
+                "distinct_dispatch_shapes": len(self.dispatch_shapes),
+                "mean_batch_occupancy": (
+                    self.rows_total / self.batches_total
+                    if self.batches_total
+                    else 0.0
+                ),
+            }
+        snap["p50_ms"] = _percentile(vals, 50) * 1e3
+        snap["p99_ms"] = _percentile(vals, 99) * 1e3
+        return snap

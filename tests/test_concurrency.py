@@ -327,3 +327,147 @@ def test_select_timeout_leaves_select_retryable():
     assert s.run(timeout=5) == 42
     with pytest.raises(RuntimeError, match="twice"):
         s.run()
+
+
+# ---- serving-queue usage pattern: multi-threaded load with timeouts and
+# close-while-waiting (the exact shape of the engine's request channel) ----
+
+
+def test_mpmc_load_with_timeouts_no_deadlock():
+    """8 producers / 4 consumers over a small buffer, every operation
+    under timeout with retry — the serving engine's steady-state pattern.
+    All values delivered exactly once, all threads exit."""
+    ch = cc.Channel(capacity=4)
+    n_prod, per = 8, 40
+    delivered = []
+    lock = threading.Lock()
+
+    def producer(pid):
+        for i in range(per):
+            while True:
+                try:
+                    ch.send(pid * per + i, timeout=0.02)
+                    break
+                except TimeoutError:
+                    continue  # backpressure: retry
+
+    def consumer():
+        while True:
+            try:
+                v, ok = ch.recv(timeout=0.02)
+            except TimeoutError:
+                continue
+            if not ok:
+                return
+            with lock:
+                delivered.append(v)
+
+    prods = [cc.go(producer, p) for p in range(n_prod)]
+    cons = [cc.go(consumer) for _ in range(4)]
+    for t in prods:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    ch.close()
+    for t in cons:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    assert sorted(delivered) == list(range(n_prod * per))
+
+
+def test_close_while_many_receivers_waiting():
+    """Engine shutdown shape: every consumer parked in recv() must wake on
+    close() with (None, False), not hang."""
+    ch = cc.Channel(capacity=2)
+    woke = []
+    lock = threading.Lock()
+
+    def waiter():
+        v, ok = ch.recv()  # no timeout: close() must wake us
+        with lock:
+            woke.append((v, ok))
+
+    threads = [cc.go(waiter) for _ in range(6)]
+    time.sleep(0.05)  # let them all park
+    ch.close()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive()
+    assert woke == [(None, False)] * 6
+
+
+def test_close_while_senders_blocked_with_timeouts():
+    """Producers blocked on a full buffer during shutdown: each either
+    completed its send before close landed or got ChannelClosedError —
+    never a hang, never a lost-and-unreported value."""
+    ch = cc.Channel(capacity=1)
+    ch.send("seed")  # buffer now full: all senders park
+    outcomes = []
+    lock = threading.Lock()
+
+    def sender(i):
+        try:
+            ch.send(i, timeout=5.0)
+            with lock:
+                outcomes.append(("sent", i))
+        except cc.ChannelClosedError:
+            with lock:
+                outcomes.append(("closed", i))
+
+    threads = [cc.go(sender, i) for i in range(5)]
+    time.sleep(0.05)
+    assert ch.recv() == ("seed", True)  # lets at most one sender through
+    time.sleep(0.05)
+    ch.close()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive()
+    assert len(outcomes) == 5
+    sent = [i for kind, i in outcomes if kind == "sent"]
+    # drain everything that made it in before close
+    drained = [v for v in ch]
+    assert sorted(drained) == sorted(sent)
+
+
+def test_select_consumer_under_producer_load():
+    """A Select-driven consumer multiplexing two producer channels under
+    load with a stop channel — the engine's drain loop shape."""
+    a, b = cc.Channel(capacity=2), cc.Channel(capacity=2)
+    got = []
+
+    def producer(ch, base):
+        for i in range(20):
+            ch.send(base + i)
+        ch.close()
+
+    cc.go(producer, a, 0)
+    cc.go(producer, b, 1000)
+    closed = set()
+    deadline = time.monotonic() + 30
+    while len(closed) < 2 and time.monotonic() < deadline:
+        s = cc.Select()
+        if "a" not in closed:
+            s.recv(a, lambda v, ok: ("a", v, ok))
+        if "b" not in closed:
+            s.recv(b, lambda v, ok: ("b", v, ok))
+        name, v, ok = s.run(timeout=10)
+        if not ok:
+            closed.add(name)
+        else:
+            got.append(v)
+    assert closed == {"a", "b"}
+    assert sorted(v for v in got if v < 1000) == list(range(20))
+    assert sorted(v for v in got if v >= 1000) == list(range(1000, 1020))
+
+
+def test_qsize_counts_buffer_and_parked_senders():
+    ch = cc.Channel(capacity=2)
+    assert ch.qsize() == 0
+    ch.send(1)
+    ch.send(2)
+    assert ch.qsize() == 2
+    t = cc.go(ch.send, 3)  # parks: buffer full
+    time.sleep(0.05)
+    assert ch.qsize() == 3  # parked sender's value is receivable
+    assert ch.recv() == (1, True)
+    t.join(timeout=5)
+    assert ch.qsize() == 2

@@ -228,3 +228,80 @@ def test_persistent_compile_cache_flag(tmp_path, rng):
         jax.config.update("jax_compilation_cache_dir", None)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         cfg_mod._compile_cache_applied = prev_applied
+
+
+def test_inferencer_dict_feed_in_feed_order(tmp_path, rng):
+    """Dict feeds must be unpacked in feed_order (FeedSpec order), not raw
+    insertion order — clients over the wire give no ordering guarantee."""
+    def net(a, b):
+        return layers.fc(a, size=2, name="fa") + layers.fc(b, size=2, name="fb")
+
+    model = pt.build(net)
+    a = rng.randn(4, 3).astype(np.float32)
+    b = rng.randn(4, 7).astype(np.float32)
+    variables = model.init(0, a, b)
+    pt.io.save_params(str(tmp_path / "p"), variables)
+
+    inf = pt.Inferencer(
+        net, str(tmp_path / "p"),
+        feed_order=[pt.FeedSpec("a", (3,)), pt.FeedSpec("b", (7,))],
+    )
+    # feed dict built backwards: insertion order would swap the slots
+    out = inf.infer({"b": b, "a": a})
+    expect, _ = model.apply(variables, jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect), rtol=1e-5)
+
+
+def test_inferencer_reuses_executor_compile_cache(tmp_path, rng):
+    """infer() compiles through the shared Executor cache (one entry,
+    reused), not a private slot."""
+    def net(x):
+        return layers.fc(x, size=2, name="fc")
+
+    model = pt.build(net)
+    x = rng.randn(4, 5).astype(np.float32)
+    variables = model.init(0, x)
+    pt.io.save_params(str(tmp_path / "p"), variables)
+    inf = pt.Inferencer(net, str(tmp_path / "p"))
+    assert len(inf.executor._cache) == 0
+    inf.infer([x])
+    assert len(inf.executor._cache) == 1
+    inf.infer([x])
+    assert len(inf.executor._cache) == 1  # cache hit, no new entry
+
+
+def test_executor_run_forwards_static_argnums():
+    """run() must forward static_argnums to prepare — a python-branching
+    static arg traced as a Tracer would raise."""
+    exe = pt.Executor()
+
+    def f(x, mode):
+        if mode == "double":  # concretization error unless mode is static
+            return x * 2
+        return x
+
+    out = exe.run(f, jnp.ones((3,)), "double", static_argnums=(1,))
+    np.testing.assert_allclose(np.asarray(out), 2 * np.ones((3,)))
+    out = exe.run(f, jnp.ones((3,)), "id", static_argnums=(1,))
+    np.testing.assert_allclose(np.asarray(out), np.ones((3,)))
+
+
+def test_executor_cache_lru_not_fifo():
+    """A cache hit refreshes recency: hot entries (serving buckets) must
+    survive a burst of cold one-off functions; FIFO would evict them."""
+    exe = pt.Executor(max_cache=2)
+    hot = exe.prepare(lambda x: x + 1, key="hot")
+    exe.prepare(lambda x: x + 2, key="cold1")
+    assert exe.prepare(lambda x: x, key="hot") is hot  # hit → move to end
+    exe.prepare(lambda x: x + 3, key="cold2")  # evicts cold1, NOT hot
+    assert "hot" in exe._cache and "cold1" not in exe._cache
+    assert exe.prepare(lambda x: x, key="hot") is hot
+
+
+def test_executor_cache_eviction_bound():
+    exe = pt.Executor(max_cache=4)
+    for i in range(10):
+        exe.prepare(lambda x, i=i: x + i, key=("k", i))
+    assert len(exe._cache) == 4
+    # the most recent 4 survive
+    assert [k[1] for k in exe._cache] == [6, 7, 8, 9]
