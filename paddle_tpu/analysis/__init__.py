@@ -1,0 +1,56 @@
+"""paddle_tpu.analysis — static analysis for the native IR and models.
+
+Three layers, one diagnostics vocabulary (:mod:`.diagnostics`):
+
+* :mod:`.verifier` — SSA + shape/dtype verification of native ``Program``
+  text (wired into ``PassManager.run`` and ``native.export``);
+* :mod:`.model_lint` — abstract-traces a ``framework.Model`` via
+  ``jax.eval_shape`` and reports structural problems (lazy import: pulls
+  in jax);
+* :mod:`.source_lint` — AST lint of the repo's own Python sources for
+  repo-specific invariants (stdlib only).
+
+CLI: ``python -m paddle_tpu.analysis [paths...] [--verify-program DIR]``.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.analysis.diagnostics import (
+    ERROR,
+    WARNING,
+    Diagnostic,
+    format_diagnostics,
+    has_errors,
+)
+from paddle_tpu.analysis.source_lint import lint_file, lint_source
+from paddle_tpu.analysis.verifier import (
+    VerificationError,
+    verify_or_raise,
+    verify_program,
+    verify_text,
+)
+
+__all__ = [
+    "Diagnostic",
+    "ERROR",
+    "WARNING",
+    "format_diagnostics",
+    "has_errors",
+    "lint_file",
+    "lint_model",
+    "lint_source",
+    "VerificationError",
+    "verify_or_raise",
+    "verify_program",
+    "verify_text",
+]
+
+
+def __getattr__(name):
+    # lint_model imports jax; load it only when asked for so that the
+    # verifier path (used inside PassManager) stays stdlib-light.
+    if name == "lint_model":
+        from paddle_tpu.analysis.model_lint import lint_model
+
+        return lint_model
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,68 @@
+"""CLI: ``python -m paddle_tpu.analysis``.
+
+Default action lints Python sources (the whole ``paddle_tpu`` package when
+no paths are given). ``--verify-program DIR`` additionally verifies an
+exported native program directory (``program.txt`` + ``weights.bin``).
+Exit status 1 when any error-severity diagnostic was produced.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+from paddle_tpu.analysis.diagnostics import Diagnostic, format_diagnostics, has_errors
+from paddle_tpu.analysis.source_lint import lint_source
+from paddle_tpu.analysis.verifier import verify_text
+
+
+def _verify_program_dir(path: str) -> List[Diagnostic]:
+    prog_path = os.path.join(path, "program.txt") if os.path.isdir(path) else path
+    with open(prog_path, "r", encoding="utf-8") as f:
+        text = f.read()
+    weights = b""
+    wpath = os.path.join(os.path.dirname(prog_path), "weights.bin")
+    if os.path.exists(wpath):
+        with open(wpath, "rb") as f:
+            weights = f.read()
+    return verify_text(text, weights=weights)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="paddle_tpu static analysis: source lint + program verifier",
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files/directories to source-lint (default: the paddle_tpu package)",
+    )
+    ap.add_argument(
+        "--verify-program", metavar="DIR", default=None,
+        help="also verify an exported native program (directory containing "
+        "program.txt, or the program.txt path itself)",
+    )
+    ap.add_argument(
+        "--no-source-lint", action="store_true",
+        help="skip the source lint (e.g. with --verify-program alone)",
+    )
+    args = ap.parse_args(argv)
+
+    diags: List[Diagnostic] = []
+    if not args.no_source_lint:
+        diags.extend(lint_source(args.paths or None))
+    if args.verify_program:
+        diags.extend(_verify_program_dir(args.verify_program))
+
+    if diags:
+        print(format_diagnostics(diags))
+    n_err = sum(1 for d in diags if d.severity == "error")
+    n_warn = len(diags) - n_err
+    print(f"paddle_tpu.analysis: {n_err} error(s), {n_warn} warning(s)")
+    return 1 if has_errors(diags) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,202 @@
+"""Model linter: abstract-trace a :class:`framework.Model` and report
+structural problems before they cost device time.
+
+The reference framework caught these classes of bug operationally —
+``PADDLE_ENFORCE`` inside InferShape, duplicate-variable checks when
+appending to a ``BlockDesc``, regularizer plumbing in the optimizer — but
+always one bug per run, at run time. Here the whole model is traced once
+through ``jax.eval_shape`` (zero FLOPs, zero device memory) and every
+finding comes back as a structured :class:`Diagnostic`:
+
+* ``param-collision`` — two ``create_parameter`` calls resolve to the same
+  full name (explicit ``ParamAttr.name`` reuse inside one scope);
+* ``init-apply-mismatch`` — ``apply`` requests a parameter ``init`` never
+  created, or with a different shape;
+* ``unused-param`` — a parameter exists in the variable set but no apply
+  path reads it (checkpoint/config drift; sees through scan-over-layers
+  via the frame's read ledger);
+* ``sharding-rank`` — a ``ParamAttr.sharding`` spec whose rank disagrees
+  with the parameter shape (would fail at mesh-partition time);
+* ``float64-leak`` — a parameter/state/output declared float64: on TPU
+  this silently downcasts (x64 off) or catastrophically deoptimizes
+  (x64 on);
+* ``stale-state`` — a state entry created in init but never updated by a
+  training-mode apply (a moving statistic that never moves);
+* ``cross-scope-state`` — an ``update_state`` that only resolved through
+  the bare-name fallback (see ``framework.update_state``);
+* ``regularizer-non-trainable`` — weight decay attached to a frozen
+  parameter: it would silently do nothing.
+
+Used directly (``lint_model``), from the CLI (``python -m
+paddle_tpu.analysis --model``-style fixtures in tests), and as the serving
+warm-up hook (``serving.engine.ServingEngine`` lints the model it is about
+to compile and logs findings).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.analysis.diagnostics import ERROR, WARNING, Diagnostic
+from paddle_tpu.core.enforce import EnforceError
+
+__all__ = ["lint_model"]
+
+
+def _sds(x):
+    import jax
+
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+    arr = np.asarray(x)
+    return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+
+def _split_variables(variables) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    if hasattr(variables, "params"):
+        return dict(variables.params), dict(getattr(variables, "state", {}) or {})
+    if isinstance(variables, tuple) and len(variables) == 2:
+        return dict(variables[0]), dict(variables[1] or {})
+    return dict(variables), {}
+
+
+def _is_f64(dtype) -> bool:
+    try:
+        return np.dtype(dtype) == np.float64
+    except TypeError:
+        return str(dtype) in ("float64", "f64")
+
+
+def lint_model(
+    model,
+    example_inputs: Sequence,
+    variables=None,
+    rng: int = 0,
+    train: bool = True,
+) -> List[Diagnostic]:
+    """Abstractly trace ``model`` over ``example_inputs`` and return
+    diagnostics. ``example_inputs`` may be arrays or
+    ``jax.ShapeDtypeStruct``s — nothing is ever computed. When
+    ``variables`` is omitted, ``model.init`` is traced too (enabling the
+    init-vs-apply checks); otherwise the provided variable set is linted
+    against a single apply trace."""
+    import jax
+
+    from paddle_tpu.framework import Model, build
+
+    if not isinstance(model, Model):
+        model = build(model)
+    diags: List[Diagnostic] = []
+    key_struct = _sds(jax.random.PRNGKey(rng))
+    abstract_inputs = tuple(_sds(x) for x in example_inputs)
+
+    init_info = None
+    if variables is None:
+        try:
+            variables = jax.eval_shape(
+                lambda k, *xs: model.init(k, *xs), key_struct, *abstract_inputs
+            )
+        except EnforceError as e:
+            code = (
+                "param-collision"
+                if "duplicate parameter" in str(e)
+                else "init-error"
+            )
+            diags.append(Diagnostic(code, str(e), where=f"{model.name}.init"))
+            return diags
+        init_info = dict(model.param_info)
+    else:
+        variables = jax.tree_util.tree_map(_sds, variables)
+    params, state = _split_variables(variables)
+
+    try:
+        out_struct = jax.eval_shape(
+            lambda k, v, *xs: model.apply(v, *xs, rng=k, is_train=train),
+            key_struct, variables, *abstract_inputs,
+        )
+    except EnforceError as e:
+        diags.append(
+            Diagnostic("init-apply-mismatch", str(e), where=f"{model.name}.apply")
+        )
+        return diags
+
+    apply_info = dict(model._last_param_info)
+    reads = set(model._last_param_reads)
+    updated = set(model._last_state_updates)
+    cross_scope = set(model._last_cross_scope_updates)
+
+    # -- structural: params present but never read by this apply trace
+    for name in sorted(set(params) - reads):
+        diags.append(Diagnostic(
+            "unused-param",
+            f"parameter {name!r} exists in the variable set but no apply "
+            "path reads it (checkpoint/config drift, or a branch this trace "
+            "did not take)",
+            severity=WARNING, where=name,
+        ))
+
+    # -- per-param metadata checks (init metadata wins: it records every
+    # parameter; apply-only tracing still covers what was read)
+    info = dict(apply_info)
+    if init_info:
+        info.update(init_info)
+    for name, pi in sorted(info.items()):
+        if pi.sharding is not None and len(pi.sharding) != len(pi.shape):
+            diags.append(Diagnostic(
+                "sharding-rank",
+                f"parameter {name!r} has sharding spec {pi.sharding} of rank "
+                f"{len(pi.sharding)} but shape {pi.shape} of rank "
+                f"{len(pi.shape)} — pjit partitioning would reject it",
+                where=name,
+            ))
+        if _is_f64(pi.dtype):
+            diags.append(Diagnostic(
+                "float64-leak",
+                f"parameter {name!r} is declared float64; TPU-native code is "
+                "f32/bf16 — with x64 disabled this silently downcasts",
+                where=name,
+            ))
+        if pi.regularizer is not None and not pi.trainable:
+            diags.append(Diagnostic(
+                "regularizer-non-trainable",
+                f"parameter {name!r} is non-trainable but carries a "
+                "regularizer; the optimizer will never apply it",
+                severity=WARNING, where=name,
+            ))
+
+    # -- state checks
+    if train:
+        for name in sorted(set(state) - updated):
+            diags.append(Diagnostic(
+                "stale-state",
+                f"state entry {name!r} was created but never updated by a "
+                "training-mode apply — a moving statistic that never moves",
+                severity=WARNING, where=name,
+            ))
+    for scoped, bare in sorted(cross_scope):
+        diags.append(Diagnostic(
+            "cross-scope-state",
+            f"update_state({bare!r}) inside scope {scoped.rsplit('/', 1)[0]!r} "
+            "resolved through the bare-name fallback; address state within "
+            "the name_scope that created it",
+            severity=WARNING, where=scoped,
+        ))
+
+    # -- dtype promotion leaks on state and outputs
+    for name, s in sorted(state.items()):
+        if _is_f64(getattr(s, "dtype", None)):
+            diags.append(Diagnostic(
+                "float64-leak", f"state entry {name!r} is float64", where=name
+            ))
+    out_leaves = jax.tree_util.tree_leaves(out_struct[0])
+    for i, leaf in enumerate(out_leaves):
+        if _is_f64(getattr(leaf, "dtype", None)):
+            diags.append(Diagnostic(
+                "float64-leak",
+                f"model output {i} has dtype float64 — a python-float/x64 "
+                "promotion leaked into the traced program",
+                where=f"{model.name}.apply output {i}",
+            ))
+    return diags

@@ -1,0 +1,289 @@
+"""Repo source lint: AST-based checks for paddle_tpu's own invariants.
+
+PR 1 shipped a seed breakage this linter would have caught: a raw ``from
+jax import shard_map`` import that only worked on new jax releases until
+``core/compat.py`` grew a shim. Generic linters cannot know the repo's
+rules; this one encodes them:
+
+* ``compat-import`` — version-sensitive jax symbols (``shard_map``) must
+  be imported via ``paddle_tpu.core.compat``, never straight from jax;
+* ``unguarded-export-import`` — ``jax.export`` imports must sit inside a
+  ``try/except ImportError`` (older jax does not re-export it);
+* ``traced-wallclock`` / ``traced-py-rng`` — traced model/op code (the
+  ``ops``/``layers``/``models`` trees and ``nets.py``) must not call
+  wall-clock functions or Python/global-numpy RNGs: under ``jax.jit`` the
+  value is frozen at trace time and silently reused forever after;
+* ``bare-assert`` — user-facing (public) functions must raise
+  ``paddle_tpu.core.enforce.enforce()`` instead of ``assert``: asserts
+  vanish under ``python -O`` and carry no structured context.
+
+Runnable as ``python -m paddle_tpu.analysis`` and over the whole tree in
+``tests/test_source_lint.py`` (so the gate rides tier-1). Suppress a
+finding with a ``# lint: allow`` comment on the offending line.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from paddle_tpu.analysis.diagnostics import ERROR, WARNING, Diagnostic
+
+__all__ = ["lint_source", "lint_file", "default_roots"]
+
+_SUPPRESS = "# lint: allow"
+
+# dirs (relative to the package) whose code runs under jax tracing
+_TRACED_DIRS = ("ops", "layers", "models")
+_TRACED_FILES = ("nets.py",)
+
+# dotted call chains that freeze a trace-time value into the program
+_WALLCLOCK_CALLS = {
+    "time.time", "time.time_ns", "time.perf_counter", "time.monotonic",
+    "time.process_time", "time.sleep",
+    "datetime.datetime.now", "datetime.datetime.utcnow", "datetime.date.today",
+}
+# np.random.<fn> constructors that are fine (explicitly-seeded generators
+# passed around as values, not hidden global state)
+_NP_RANDOM_OK = {"RandomState", "default_rng", "Generator", "SeedSequence",
+                 "PCG64", "Philox", "MT19937", "BitGenerator"}
+
+
+def default_roots() -> List[str]:
+    """The package tree this lint governs."""
+    return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_traced_path(path: str) -> bool:
+    norm = os.path.normpath(path).split(os.sep)
+    if "paddle_tpu" in norm:
+        rel = norm[norm.index("paddle_tpu") + 1:]
+    else:
+        rel = norm[-2:]
+    if rel and rel[0] in _TRACED_DIRS:
+        return True
+    return bool(rel) and rel[-1] in _TRACED_FILES
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, source_lines: List[str], traced: bool,
+                 is_compat_module: bool):
+        self.path = path
+        self.lines = source_lines
+        self.traced = traced
+        self.is_compat_module = is_compat_module
+        self.diags: List[Diagnostic] = []
+        # lexical context stacks
+        self._try_depth = 0          # inside a try: with an except clause
+        self._scope: List[str] = []  # enclosing class/function names
+
+    # -- helpers -----------------------------------------------------------
+
+    def _diag(self, code: str, message: str, node: ast.AST,
+              severity: str = ERROR) -> None:
+        line_no = getattr(node, "lineno", 0)
+        src = self.lines[line_no - 1] if 0 < line_no <= len(self.lines) else ""
+        if _SUPPRESS in src:
+            return
+        self.diags.append(Diagnostic(
+            code, message, severity=severity,
+            where=f"{self.path}:{line_no}", source=src,
+        ))
+
+    def _public_context(self) -> bool:
+        """True when every enclosing def/class is public (dunders count as
+        public: __init__/__call__ are user entry points; a single leading
+        underscore marks internal)."""
+        if not self._scope:
+            return True  # module level
+        for name in self._scope:
+            if name.startswith("_") and not (
+                name.startswith("__") and name.endswith("__")
+            ):
+                return False
+        return True
+
+    # -- scope/ancestor tracking ------------------------------------------
+
+    def visit_Try(self, node: ast.Try) -> None:
+        catches_import_error = any(
+            h.type is None
+            or (isinstance(h.type, ast.Name) and h.type.id in
+                ("ImportError", "ModuleNotFoundError", "Exception"))
+            or (isinstance(h.type, ast.Tuple) and any(
+                isinstance(e, ast.Name) and e.id in
+                ("ImportError", "ModuleNotFoundError", "Exception")
+                for e in h.type.elts))
+            for h in node.handlers
+        )
+        if catches_import_error:
+            self._try_depth += 1
+            for stmt in node.body:
+                self.visit(stmt)
+            self._try_depth -= 1
+            for part in (node.handlers, node.orelse, node.finalbody):
+                for stmt in part:
+                    self.visit(stmt)
+        else:
+            self.generic_visit(node)
+
+    def _visit_scoped(self, node) -> None:
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    visit_FunctionDef = _visit_scoped
+    visit_AsyncFunctionDef = _visit_scoped
+    visit_ClassDef = _visit_scoped
+
+    # -- rule: compat-sensitive imports -----------------------------------
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        mod = node.module or ""
+        names = [a.name for a in node.names]
+        if not self.is_compat_module:
+            if (mod == "jax" and "shard_map" in names) or mod.startswith(
+                "jax.experimental.shard_map"
+            ):
+                self._diag(
+                    "compat-import",
+                    "shard_map moved between jax releases; import it from "
+                    "paddle_tpu.core.compat, which shims both spellings",
+                    node,
+                )
+        if (mod == "jax.export" or (mod == "jax" and "export" in names)) \
+                and not self._try_depth:
+            self._diag(
+                "unguarded-export-import",
+                "jax.export is absent on older jax; wrap the import in "
+                "try/except ImportError (see paddle_tpu/io.py)",
+                node,
+            )
+        self.generic_visit(node)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.name.startswith("jax.experimental.shard_map") \
+                    and not self.is_compat_module:
+                self._diag(
+                    "compat-import",
+                    "import shard_map via paddle_tpu.core.compat",
+                    node,
+                )
+            if alias.name == "jax.export" and not self._try_depth:
+                self._diag(
+                    "unguarded-export-import",
+                    "jax.export is absent on older jax; wrap the import in "
+                    "try/except ImportError (see paddle_tpu/io.py)",
+                    node,
+                )
+        self.generic_visit(node)
+
+    # -- rules on expressions ---------------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if not self.is_compat_module:
+            chain = _dotted(node)
+            if chain in ("jax.shard_map", "jax.experimental.shard_map"):
+                self._diag(
+                    "compat-import",
+                    "use paddle_tpu.core.compat.shard_map, not a raw jax "
+                    "attribute path",
+                    node,
+                )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.traced:
+            chain = _dotted(node.func)
+            if chain in _WALLCLOCK_CALLS:
+                self._diag(
+                    "traced-wallclock",
+                    f"{chain}() inside traced model/op code is frozen at "
+                    "trace time and silently reused on every later call; "
+                    "thread times in as inputs instead",
+                    node,
+                )
+            elif chain and chain.startswith("random."):
+                self._diag(
+                    "traced-py-rng",
+                    f"{chain}() uses Python's global RNG inside traced code; "
+                    "use jax.random with an explicit key "
+                    "(framework.next_rng_key)",
+                    node,
+                )
+            elif chain and (
+                chain.startswith("np.random.") or chain.startswith("numpy.random.")
+            ):
+                fn = chain.rsplit(".", 1)[-1]
+                if fn not in _NP_RANDOM_OK:
+                    self._diag(
+                        "traced-py-rng",
+                        f"{chain}() draws from numpy's hidden global RNG "
+                        "inside traced code; pass an explicit "
+                        "np.random.RandomState / jax key instead",
+                        node,
+                    )
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        if self._public_context():
+            self._diag(
+                "bare-assert",
+                "bare assert on a user-facing path: it vanishes under "
+                "python -O and reports no context — use "
+                "paddle_tpu.core.enforce.enforce()",
+                node,
+            )
+        self.generic_visit(node)
+
+
+def lint_file(path: str, text: Optional[str] = None,
+              traced: Optional[bool] = None) -> List[Diagnostic]:
+    """Lint one Python file. ``traced`` overrides the path-based detection
+    of traced model/op code (tests use this on fixture files)."""
+    if text is None:
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError as e:
+        return [Diagnostic("syntax-error", str(e), where=f"{path}:{e.lineno or 0}")]
+    if traced is None:
+        traced = _is_traced_path(path)
+    is_compat = os.path.normpath(path).endswith(os.path.join("core", "compat.py"))
+    linter = _Linter(path, text.splitlines(), traced, is_compat)
+    linter.visit(tree)
+    return linter.diags
+
+
+def lint_source(paths: Optional[Sequence[str]] = None) -> List[Diagnostic]:
+    """Lint a set of files/directories (default: the paddle_tpu package)."""
+    targets: List[str] = []
+    for p in (list(paths) if paths else default_roots()):
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                targets.extend(
+                    os.path.join(dirpath, f)
+                    for f in sorted(filenames) if f.endswith(".py")
+                )
+        else:
+            targets.append(p)
+    diags: List[Diagnostic] = []
+    for path in targets:
+        diags.extend(lint_file(path))
+    return diags

@@ -1,0 +1,701 @@
+"""IR verifier for the native serving program.
+
+The reference framework validated graphs before execution — ProgramDesc
+checks on load, ``PADDLE_ENFORCE`` inside every OpDesc InferShape, and the
+``ir::Graph`` pass infrastructure asserting graph invariants between
+passes. The native line IR (``paddle_tpu/native/passes.py`` ←
+``native/export.py`` → ``csrc/predictor.cc``) had no equivalent: a buggy
+pass produced a program that failed deep inside the C++ interpreter (or
+worse, computed garbage). This module is the missing layer:
+
+* **structural checks** — well-formed lines, op arity, known prims/attrs;
+* **SSA invariants** — single definition per id, def-before-use, no
+  dangling uses, every ``output`` defined;
+* **per-prim shape/dtype inference** — re-deriving every op's result shape
+  the same way ``csrc/ops.cc`` computes it, so a rewrite that silently
+  changes an operand (the classic CSE/remap bug class) is caught at
+  verify time with the offending line, not at predict time.
+
+``PassManager.run`` calls :func:`verify_or_raise` after every pass when
+verification is enabled (on by default under pytest — the TVM-style
+verify-between-passes discipline), and ``native/export.py`` verifies the
+final program before writing ``program.txt``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from paddle_tpu.analysis.diagnostics import ERROR, WARNING, Diagnostic, format_diagnostics, has_errors
+from paddle_tpu.core.enforce import EnforceError
+
+__all__ = [
+    "Diagnostic",
+    "VerificationError",
+    "verify_text",
+    "verify_program",
+    "verify_or_raise",
+]
+
+# storage dtype tags (csrc/predictor.cc parse_dtype) -> payload bytes/elem
+_DTYPE_BYTES = {"f32": 4, "bf16": 2, "i32": 4, "i64": 8, "i8": 1}
+
+_UNARY = {
+    "exp", "log", "neg", "abs", "sign", "floor", "rsqrt", "sqrt", "tanh",
+    "logistic", "sin", "cos", "erf", "ceil", "expm1", "log1p", "not",
+    "is_finite", "round", "round_away",
+}
+_BINARY = {
+    "add", "sub", "mul", "div", "max", "min", "pow", "eq", "lt", "gt", "ge",
+    "le", "and", "or", "rem", "atan2", "ne",
+}
+_IDENTITY = {"copy", "convert_element_type", "stop_gradient"}
+_REDUCE = {"reduce_sum", "reduce_max", "reduce_min", "reduce_or", "reduce_and"}
+_CUMULATIVE = {"cumsum", "cumprod", "cummax", "cummin"}
+
+
+class VerificationError(EnforceError):
+    """The program violates an IR invariant; carries the diagnostics."""
+
+    def __init__(self, message: str, diagnostics: Sequence[Diagnostic]):
+        self.diagnostics = list(diagnostics)
+        detail = format_diagnostics(
+            [d for d in self.diagnostics if d.severity == ERROR], limit=20
+        )
+        super().__init__(f"{message}\n{detail}" if detail else message)
+
+
+class _Invalid(Exception):
+    """Internal: a shape/attr rule failed for one op."""
+
+    def __init__(self, code: str, message: str):
+        self.code = code
+        self.message = message
+
+
+@dataclasses.dataclass
+class _Val:
+    shape: Optional[Tuple[int, ...]]  # None = unknown (upstream error)
+    dtype: str
+    line_no: int
+
+
+@dataclasses.dataclass
+class _OpRec:
+    prim: str
+    out: int
+    ins: List[int]
+    attrs: Dict[str, List[int]]
+    fval: Optional[float]
+    line_no: int
+    raw: str
+
+
+def _numel(shape: Tuple[int, ...]) -> int:
+    n = 1
+    for d in shape:
+        n *= d
+    return n
+
+
+def _parse_attrs(token: str) -> Tuple[Dict[str, List[int]], Optional[float], List[str]]:
+    """Parse the ``k=v;k=v`` attr token (csv ints; ``fval`` is float).
+    Returns (attrs, fval, malformed-chunks)."""
+    attrs: Dict[str, List[int]] = {}
+    fval: Optional[float] = None
+    bad: List[str] = []
+    if token == "-":
+        return attrs, fval, bad
+    for chunk in token.split(";"):
+        if "=" not in chunk:
+            if chunk:
+                bad.append(chunk)
+            continue
+        key, val = chunk.split("=", 1)
+        if key == "fval":
+            try:
+                fval = float(val)
+            except ValueError:
+                bad.append(chunk)
+            continue
+        try:
+            attrs[key] = [int(v) for v in val.split(",") if v != ""]
+        except ValueError:
+            bad.append(chunk)
+    return attrs, fval, bad
+
+
+# ---- per-prim shape rules -------------------------------------------------
+# Each rule mirrors the corresponding evaluator in csrc/ops.cc: the verifier
+# accepts exactly what the interpreter executes.
+
+
+def _attr(op: _OpRec, key: str, length: Optional[int] = None) -> List[int]:
+    if key not in op.attrs:
+        raise _Invalid("missing-attr", f"op '{op.prim}' requires attr '{key}'")
+    val = op.attrs[key]
+    if length is not None and len(val) != length:
+        raise _Invalid(
+            "bad-attr",
+            f"op '{op.prim}' attr '{key}' must have {length} values, got {len(val)}",
+        )
+    return val
+
+
+def _arity(op: _OpRec, lo: int, hi: Optional[int] = None) -> None:
+    hi = lo if hi is None else hi
+    if not (lo <= len(op.ins) <= hi):
+        want = str(lo) if lo == hi else f"{lo}..{hi}"
+        raise _Invalid(
+            "bad-arity", f"op '{op.prim}' expects {want} inputs, got {len(op.ins)}"
+        )
+
+
+def _broadcast2(op: _OpRec, a: Tuple[int, ...], b: Tuple[int, ...]) -> Tuple[int, ...]:
+    """csrc/ops.cc binary_impl: equal shapes, either side numel==1, or equal
+    rank with size-1 dims broadcasting. NOT full numpy trailing-dim rules."""
+    if a == b:
+        return a
+    if _numel(b) == 1:
+        return a
+    if _numel(a) == 1:
+        return b
+    if len(a) != len(b):
+        raise _Invalid(
+            "shape-mismatch",
+            f"op '{op.prim}' rank mismatch: {a} vs {b} (the native interpreter "
+            "broadcasts size-1 dims at equal rank only)",
+        )
+    out = []
+    for da, db in zip(a, b):
+        if da != db and da != 1 and db != 1:
+            raise _Invalid(
+                "shape-mismatch", f"op '{op.prim}' incompatible shapes {a} vs {b}"
+            )
+        out.append(max(da, db))
+    return tuple(out)
+
+
+def _check_axis(op: _OpRec, axis: int, rank: int, what: str = "axis") -> int:
+    if not (0 <= axis < rank):
+        raise _Invalid(
+            "bad-attr", f"op '{op.prim}' {what} {axis} out of range for rank {rank}"
+        )
+    return axis
+
+
+def _infer_shape(op: _OpRec, ins: List[Tuple[int, ...]]) -> Tuple[int, ...]:
+    p = op.prim
+    if p in _UNARY or p in {"to_bf16", "to_int", "integer_pow"} or p in _IDENTITY:
+        _arity(op, 1)
+        if p == "integer_pow":
+            _attr(op, "y", 1)
+        return ins[0]
+    if p in _BINARY:
+        _arity(op, 2)
+        return _broadcast2(op, ins[0], ins[1])
+    if p == "clamp":  # lax.clamp(min, x, max): max(x, min) then min(., max)
+        _arity(op, 3)
+        return _broadcast2(op, _broadcast2(op, ins[1], ins[0]), ins[2])
+    if p in ("reshape", "squeeze"):
+        _arity(op, 1)
+        shape = tuple(_attr(op, "shape"))
+        if _numel(shape) != _numel(ins[0]):
+            raise _Invalid(
+                "shape-mismatch",
+                f"op '{p}' cannot reshape {ins[0]} ({_numel(ins[0])} elements) "
+                f"to {shape} ({_numel(shape)} elements)",
+            )
+        return shape
+    if p == "transpose":
+        _arity(op, 1)
+        perm = _attr(op, "perm", len(ins[0]))
+        if sorted(perm) != list(range(len(ins[0]))):
+            raise _Invalid(
+                "bad-attr", f"op 'transpose' perm {perm} is not a permutation "
+                f"of rank {len(ins[0])}"
+            )
+        return tuple(ins[0][d] for d in perm)
+    if p == "broadcast_in_dim":
+        _arity(op, 1)
+        out = tuple(_attr(op, "shape"))
+        dims = _attr(op, "dims", len(ins[0]))
+        if any(not (0 <= d < len(out)) for d in dims) or list(dims) != sorted(set(dims)):
+            raise _Invalid(
+                "bad-attr",
+                f"op 'broadcast_in_dim' dims {dims} must be strictly increasing "
+                f"and < rank {len(out)}",
+            )
+        for src_d, out_d in enumerate(dims):
+            if ins[0][src_d] not in (1, out[out_d]):
+                raise _Invalid(
+                    "shape-mismatch",
+                    f"op 'broadcast_in_dim' input dim {src_d} (={ins[0][src_d]}) "
+                    f"does not broadcast to output dim {out_d} (={out[out_d]})",
+                )
+        return out
+    if p in _REDUCE:
+        _arity(op, 1)
+        axes = _attr(op, "axes")
+        if len(set(axes)) != len(axes):
+            raise _Invalid("bad-attr", f"op '{p}' repeated axes {axes}")
+        for a in axes:
+            _check_axis(op, a, len(ins[0]))
+        return tuple(d for i, d in enumerate(ins[0]) if i not in set(axes))
+    if p in _CUMULATIVE:
+        _arity(op, 1)
+        _check_axis(op, _attr(op, "axis", 1)[0], len(ins[0]))
+        _attr(op, "reverse", 1)
+        return ins[0]
+    if p in ("argmax", "argmin"):
+        _arity(op, 1)
+        axis = _check_axis(op, _attr(op, "axis", 1)[0], len(ins[0]))
+        return tuple(d for i, d in enumerate(ins[0]) if i != axis)
+    if p == "dot_general":
+        return _infer_dot_general(op, ins)
+    if p == "conv":
+        return _infer_conv(op, ins)
+    if p in ("reduce_window_max", "reduce_window_sum"):
+        return _infer_reduce_window(op, ins)
+    if p == "slice":
+        _arity(op, 1)
+        rank = len(ins[0])
+        start = _attr(op, "start", rank)
+        limit = _attr(op, "limit", rank)
+        stride = _attr(op, "stride", rank)
+        out = []
+        for d, (s, l, st, n) in enumerate(zip(start, limit, stride, ins[0])):
+            if st <= 0 or not (0 <= s <= l <= n):
+                raise _Invalid(
+                    "bad-attr",
+                    f"op 'slice' dim {d}: start={s} limit={l} stride={st} "
+                    f"invalid for size {n}",
+                )
+            out.append(-(-(l - s) // st))
+        return tuple(out)
+    if p == "pad":
+        _arity(op, 1, 2)
+        if len(op.ins) == 1 and op.fval is None:
+            raise _Invalid("missing-attr", "op 'pad' needs a value operand or fval=")
+        if len(op.ins) == 2 and _numel(ins[1]) != 1:
+            raise _Invalid(
+                "shape-mismatch", f"op 'pad' value operand must be scalar, got {ins[1]}"
+            )
+        rank = len(ins[0])
+        lo = _attr(op, "lo", rank)
+        hi = _attr(op, "hi", rank)
+        inter = _attr(op, "interior", rank)
+        out = []
+        for d, (l, h, i, n) in enumerate(zip(lo, hi, inter, ins[0])):
+            if i < 0:
+                raise _Invalid("bad-attr", f"op 'pad' negative interior at dim {d}")
+            size = n + l + h + max(n - 1, 0) * i
+            if size < 0:
+                raise _Invalid(
+                    "shape-mismatch", f"op 'pad' dim {d} pads to negative size {size}"
+                )
+            out.append(size)
+        return tuple(out)
+    if p == "select_n":
+        _arity(op, 2, 64)
+        cases = ins[1:]
+        if any(c != cases[0] for c in cases):
+            raise _Invalid(
+                "shape-mismatch", f"op 'select_n' case shapes differ: {ins[1:]}"
+            )
+        if _numel(ins[0]) not in (1, _numel(cases[0])):
+            raise _Invalid(
+                "shape-mismatch",
+                f"op 'select_n' predicate shape {ins[0]} matches neither a "
+                f"scalar nor the case shape {cases[0]}",
+            )
+        return cases[0]
+    if p == "gather":
+        return _infer_gather(op, ins)
+    if p == "concatenate":
+        _arity(op, 1, 1 << 30)
+        dim = _attr(op, "dim", 1)[0]
+        rank = len(ins[0])
+        _check_axis(op, dim, rank, "dim")
+        for i, s in enumerate(ins[1:], start=1):
+            if len(s) != rank or any(
+                a != b for d, (a, b) in enumerate(zip(ins[0], s)) if d != dim
+            ):
+                raise _Invalid(
+                    "shape-mismatch",
+                    f"op 'concatenate' operand {i} shape {s} incompatible with "
+                    f"{ins[0]} along dim {dim}",
+                )
+        return tuple(
+            sum(s[d] for s in ins) if d == dim else ins[0][d] for d in range(rank)
+        )
+    if p == "rev":
+        _arity(op, 1)
+        for d in _attr(op, "dims"):
+            _check_axis(op, d, len(ins[0]), "dim")
+        return ins[0]
+    if p == "dynamic_slice":
+        rank = len(ins[0])
+        _arity(op, 1 + rank)
+        sizes = _attr(op, "sizes", rank)
+        for d, (sz, n) in enumerate(zip(sizes, ins[0])):
+            if not (0 < sz <= n):
+                raise _Invalid(
+                    "bad-attr", f"op 'dynamic_slice' size {sz} invalid for dim "
+                    f"{d} of {ins[0]}"
+                )
+        for i, s in enumerate(ins[1:], start=1):
+            if _numel(s) != 1:
+                raise _Invalid(
+                    "shape-mismatch",
+                    f"op 'dynamic_slice' start operand {i} must be scalar, got {s}",
+                )
+        return tuple(sizes)
+    if p == "dynamic_update_slice":
+        rank = len(ins[0])
+        _arity(op, 2 + rank)
+        if len(ins[1]) != rank or any(u > n for u, n in zip(ins[1], ins[0])):
+            raise _Invalid(
+                "shape-mismatch",
+                f"op 'dynamic_update_slice' update {ins[1]} does not fit in "
+                f"operand {ins[0]}",
+            )
+        for i, s in enumerate(ins[2:], start=2):
+            if _numel(s) != 1:
+                raise _Invalid(
+                    "shape-mismatch",
+                    f"op 'dynamic_update_slice' start operand {i} must be "
+                    f"scalar, got {s}",
+                )
+        return ins[0]
+    raise _Invalid(
+        "unknown-prim",
+        f"primitive '{p}' is not in the native interpreter's op set "
+        "(csrc/predictor.cc run_instr)",
+    )
+
+
+def _infer_dot_general(op: _OpRec, ins: List[Tuple[int, ...]]) -> Tuple[int, ...]:
+    _arity(op, 2)
+    lhs, rhs = ins
+    lc, rc = _attr(op, "lc"), _attr(op, "rc")
+    lb, rb = _attr(op, "lb"), _attr(op, "rb")
+    if len(lc) != len(rc) or len(lb) != len(rb):
+        raise _Invalid(
+            "bad-attr",
+            f"op 'dot_general' contraction/batch dim counts differ: "
+            f"lc={lc} rc={rc} lb={lb} rb={rb}",
+        )
+    for dims, shape, what in ((lc, lhs, "lc"), (rc, rhs, "rc"), (lb, lhs, "lb"), (rb, rhs, "rb")):
+        for d in dims:
+            _check_axis(op, d, len(shape), what)
+    if set(lb) & set(lc) or set(rb) & set(rc):
+        raise _Invalid("bad-attr", "op 'dot_general' batch and contraction dims overlap")
+    for dl, dr in zip(lc, rc):
+        if lhs[dl] != rhs[dr]:
+            raise _Invalid(
+                "shape-mismatch",
+                f"op 'dot_general' contraction size mismatch: lhs dim {dl} "
+                f"(={lhs[dl]}) vs rhs dim {dr} (={rhs[dr]})",
+            )
+    for dl, dr in zip(lb, rb):
+        if lhs[dl] != rhs[dr]:
+            raise _Invalid(
+                "shape-mismatch",
+                f"op 'dot_general' batch size mismatch: lhs dim {dl} "
+                f"(={lhs[dl]}) vs rhs dim {dr} (={rhs[dr]})",
+            )
+    lhs_free = [d for d in range(len(lhs)) if d not in set(lc) | set(lb)]
+    rhs_free = [d for d in range(len(rhs)) if d not in set(rc) | set(rb)]
+    return (
+        tuple(lhs[d] for d in lb)
+        + tuple(lhs[d] for d in lhs_free)
+        + tuple(rhs[d] for d in rhs_free)
+    )
+
+
+def _infer_conv(op: _OpRec, ins: List[Tuple[int, ...]]) -> Tuple[int, ...]:
+    # NHWC x HWIO (export canonicalizes layouts); optional fused addend
+    _arity(op, 2, 3)
+    x, w = ins[0], ins[1]
+    if len(x) != 4 or len(w) != 4:
+        raise _Invalid(
+            "shape-mismatch", f"op 'conv' wants rank-4 NHWC x HWIO, got {x} x {w}"
+        )
+    strides = _attr(op, "strides", 2)
+    pad_lo = _attr(op, "pad_lo", 2)
+    pad_hi = _attr(op, "pad_hi", 2)
+    groups = _attr(op, "groups", 1)[0]
+    n, h, wid, c = x
+    kh, kw, ci, co = w
+    if groups < 1 or ci * groups != c or co % groups:
+        raise _Invalid(
+            "shape-mismatch",
+            f"op 'conv' channel mismatch: input C={c}, filter I={ci}, O={co}, "
+            f"groups={groups}",
+        )
+    out_sp = []
+    for d, (k, s, pl, ph, size) in enumerate(
+        zip((kh, kw), strides, pad_lo, pad_hi, (h, wid))
+    ):
+        if s <= 0 or size + pl + ph < k:
+            raise _Invalid(
+                "shape-mismatch",
+                f"op 'conv' spatial dim {d}: size {size} + pads ({pl},{ph}) "
+                f"< window {k} (stride {s})",
+            )
+        out_sp.append((size + pl + ph - k) // s + 1)
+    out = (n, out_sp[0], out_sp[1], co)
+    if len(ins) == 3:  # fused residual addend (fuse-conv-epilogue)
+        if _broadcast2(op, out, ins[2]) != out:
+            raise _Invalid(
+                "shape-mismatch",
+                f"op 'conv' fused addend shape {ins[2]} does not broadcast "
+                f"into conv output {out}",
+            )
+    return out
+
+
+def _infer_reduce_window(op: _OpRec, ins: List[Tuple[int, ...]]) -> Tuple[int, ...]:
+    _arity(op, 1)
+    x = ins[0]
+    if len(x) != 4:
+        raise _Invalid("shape-mismatch", f"op '{op.prim}' wants rank-4 NHWC, got {x}")
+    window = _attr(op, "window", 4)
+    strides = _attr(op, "strides", 4)
+    pad_lo = _attr(op, "pad_lo", 4)
+    pad_hi = _attr(op, "pad_hi", 4)
+    out = []
+    for d, (k, s, pl, ph, size) in enumerate(zip(window, strides, pad_lo, pad_hi, x)):
+        if s <= 0 or k <= 0 or size + pl + ph < k:
+            raise _Invalid(
+                "shape-mismatch",
+                f"op '{op.prim}' dim {d}: size {size} + pads ({pl},{ph}) < "
+                f"window {k} (stride {s})",
+            )
+        out.append((size + pl + ph - k) // s + 1)
+    return tuple(out)
+
+
+def _infer_gather(op: _OpRec, ins: List[Tuple[int, ...]]) -> Tuple[int, ...]:
+    # XLA gather shape rule over the attrs the exporter emits
+    _arity(op, 2)
+    operand, indices = ins
+    offset_dims = _attr(op, "offset_dims")
+    collapsed = _attr(op, "collapsed_dims")
+    start_map = _attr(op, "start_index_map")
+    slice_sizes = _attr(op, "slice_sizes", len(operand))
+    _attr(op, "fill_oob", 1)
+    if not indices:
+        raise _Invalid("shape-mismatch", "op 'gather' indices must have rank >= 1")
+    if indices[-1] != len(start_map):
+        raise _Invalid(
+            "shape-mismatch",
+            f"op 'gather' trailing index dim {indices[-1]} != "
+            f"len(start_index_map) {len(start_map)}",
+        )
+    for d in collapsed:
+        _check_axis(op, d, len(operand), "collapsed dim")
+        if slice_sizes[d] != 1:
+            raise _Invalid(
+                "bad-attr", f"op 'gather' collapsed dim {d} has slice size "
+                f"{slice_sizes[d]} != 1"
+            )
+    for d in start_map:
+        _check_axis(op, d, len(operand), "start_index_map dim")
+    for d, (sz, n) in enumerate(zip(slice_sizes, operand)):
+        if not (0 <= sz <= n):
+            raise _Invalid(
+                "bad-attr", f"op 'gather' slice size {sz} invalid for operand "
+                f"dim {d} (={n})"
+            )
+    batch = list(indices[:-1])
+    offsets = [slice_sizes[d] for d in range(len(operand)) if d not in set(collapsed)]
+    out_rank = len(batch) + len(offset_dims)
+    if len(offsets) != len(offset_dims):
+        raise _Invalid(
+            "bad-attr",
+            f"op 'gather' offset_dims {offset_dims} inconsistent with "
+            f"{len(offsets)} non-collapsed slice dims",
+        )
+    out: List[Optional[int]] = [None] * out_rank
+    for pos, d in enumerate(offset_dims):
+        if not (0 <= d < out_rank) or out[d] is not None:
+            raise _Invalid("bad-attr", f"op 'gather' bad offset_dims {offset_dims}")
+        out[d] = offsets[pos]
+    it = iter(batch)
+    for d in range(out_rank):
+        if out[d] is None:
+            out[d] = next(it)
+    return tuple(out)  # type: ignore[arg-type]
+
+
+# ---- the verifier ---------------------------------------------------------
+
+
+def verify_text(text: str, weights: bytes = b"") -> List[Diagnostic]:
+    """Verify a serialized native program. Returns diagnostics (empty =
+    clean). Never raises on malformed input — every problem becomes a
+    structured :class:`Diagnostic` pointing at the offending line."""
+    diags: List[Diagnostic] = []
+    lines = text.splitlines()
+
+    def diag(code, msg, line_no, raw="", severity=ERROR):
+        diags.append(Diagnostic(code, msg, severity=severity,
+                                where=f"program:{line_no}", source=raw))
+
+    # -- line-level parse (tolerant: records what it can, reports the rest)
+    records: List[Tuple[int, str, object]] = []  # (line_no, kind, payload)
+    header_seen = False
+    for ln, raw in enumerate(lines, start=1):
+        s = raw.strip()
+        if not s:
+            continue
+        if s.startswith("#"):
+            if not header_seen:
+                header_seen = True
+                if "native program" not in s:
+                    diag("unknown-header", f"unrecognized header {s!r}", ln, raw,
+                         severity=WARNING)
+            continue
+        parts = s.split()
+        kind = parts[0]
+        try:
+            if kind == "input":
+                vid, nd = int(parts[1]), int(parts[2])
+                dims = [int(d) for d in parts[3:3 + nd]]
+                if len(dims) != nd or len(parts) > 3 + nd:
+                    raise ValueError(f"input line declares {nd} dims")
+                records.append((ln, "input", (vid, tuple(dims))))
+            elif kind == "const":
+                vid, off, nd = int(parts[1]), int(parts[2]), int(parts[3])
+                dims = [int(d) for d in parts[4:4 + nd]]
+                if len(dims) != nd:
+                    raise ValueError(f"const line declares {nd} dims")
+                rest = parts[4 + nd:]
+                if len(rest) > 1:
+                    raise ValueError("trailing tokens after dtype tag")
+                dtag = rest[0] if rest else "f32"  # v1 lines have no tag
+                records.append((ln, "const", (vid, off, tuple(dims), dtag)))
+            elif kind == "op":
+                prim, out, nin = parts[1], int(parts[2]), int(parts[3])
+                ids = parts[4:4 + nin]
+                if len(ids) != nin:
+                    raise ValueError(
+                        f"op declares {nin} inputs but carries {len(ids)}"
+                    )
+                if len(parts) != 5 + nin:
+                    raise ValueError(
+                        "op line must end with exactly one attrs token"
+                    )
+                attrs, fval, bad = _parse_attrs(parts[4 + nin])
+                for chunk in bad:
+                    diag("bad-attr", f"malformed attr chunk {chunk!r}", ln, raw)
+                records.append(
+                    (ln, "op",
+                     _OpRec(prim, out, [int(i) for i in ids], attrs, fval, ln, raw))
+                )
+            elif kind == "output":
+                if len(parts) != 2:
+                    raise ValueError("output line must be 'output <id>'")
+                records.append((ln, "output", int(parts[1])))
+            else:
+                raise ValueError(f"unknown line kind {kind!r}")
+        except (ValueError, IndexError) as e:
+            diag("malformed-line", str(e), ln, raw)
+
+    # -- SSA + shape/dtype inference in one ordered walk
+    env: Dict[int, _Val] = {}
+    defined_at: Dict[int, int] = {}
+    all_defs = {
+        payload[0] if kind in ("input", "const") else payload.out: ln
+        for ln, kind, payload in records
+        if kind in ("input", "const", "op")
+    }
+    n_outputs = 0
+
+    def define(vid: int, val: _Val, ln: int, raw: str) -> None:
+        if vid in defined_at:
+            diag("redefined",
+                 f"id {vid} already defined at program:{defined_at[vid]} "
+                 "(single-definition SSA violated)", ln, raw)
+            return
+        defined_at[vid] = ln
+        env[vid] = val
+
+    def resolve(vid: int, ln: int, raw: str, what: str) -> Optional[_Val]:
+        if vid in env:
+            return env[vid]
+        if vid in all_defs:
+            diag("use-before-def",
+                 f"{what} uses id {vid} before its definition at "
+                 f"program:{all_defs[vid]}", ln, raw)
+        else:
+            diag("undefined-use", f"{what} uses id {vid}, which is never defined",
+                 ln, raw)
+        return None
+
+    for ln, kind, payload in records:
+        if kind == "input":
+            vid, shape = payload
+            define(vid, _Val(shape, "f32", ln), ln, lines[ln - 1])
+        elif kind == "const":
+            vid, off, shape, dtag = payload
+            if dtag not in _DTYPE_BYTES:
+                diag("bad-dtype",
+                     f"const id {vid} has storage dtype {dtag!r}; the native "
+                     f"runtime supports {sorted(_DTYPE_BYTES)}", ln, lines[ln - 1])
+                define(vid, _Val(shape, "f32", ln), ln, lines[ln - 1])
+                continue
+            if weights:
+                need = off + _numel(shape) * _DTYPE_BYTES[dtag]
+                if off < 0 or need > len(weights):
+                    diag("const-out-of-range",
+                         f"const id {vid} reads bytes [{off}, {need}) but "
+                         f"weights.bin holds {len(weights)}", ln, lines[ln - 1])
+            define(vid, _Val(shape, dtag, ln), ln, lines[ln - 1])
+        elif kind == "op":
+            op: _OpRec = payload
+            in_vals = [resolve(i, ln, op.raw, f"op '{op.prim}'") for i in op.ins]
+            if op.out in op.ins:
+                diag("self-reference", f"op '{op.prim}' result id {op.out} is "
+                     "also one of its inputs", ln, op.raw)
+            shape: Optional[Tuple[int, ...]] = None
+            if all(v is not None and v.shape is not None for v in in_vals):
+                try:
+                    shape = _infer_shape(op, [v.shape for v in in_vals])  # type: ignore[union-attr]
+                except _Invalid as e:
+                    diag(e.code, e.message, ln, op.raw)
+            dtype = "bf16" if op.prim == "to_bf16" else (
+                "i32" if op.prim == "to_int" else "f32")
+            define(op.out, _Val(shape, dtype, ln), ln, op.raw)
+        else:  # output
+            n_outputs += 1
+            resolve(payload, ln, lines[ln - 1], "output")
+
+    if n_outputs == 0:
+        diags.append(Diagnostic(
+            "no-outputs", "program has no output lines; it computes nothing",
+            where="program"))
+    return diags
+
+
+def verify_program(prog) -> List[Diagnostic]:
+    """Verify a parsed :class:`paddle_tpu.native.passes.Program`."""
+    return verify_text(prog.serialize(), weights=prog.weights)
+
+
+def verify_or_raise(prog_or_text: Union[str, object], weights: bytes = b"",
+                    where: str = "") -> None:
+    """Raise :class:`VerificationError` when the program has error-severity
+    diagnostics (warnings are tolerated)."""
+    if isinstance(prog_or_text, str):
+        diags = verify_text(prog_or_text, weights=weights)
+    else:
+        diags = verify_program(prog_or_text)
+    if has_errors(diags):
+        ctx = f" ({where})" if where else ""
+        raise VerificationError(
+            f"native program failed IR verification{ctx}", diags
+        )

@@ -34,6 +34,9 @@ class Flags:
     use_flash_attention: bool = False
     # fused Pallas backward for flash attention (False = recomputed XLA vjp)
     flash_fused_bwd: bool = True
+    # run the IR verifier between native-program passes (always on under
+    # pytest; see paddle_tpu.analysis.verifier / native.passes.PassManager)
+    verify_passes: bool = False
     # default seed for program-level RNG when none is given
     seed: int = 0
     # host data pipeline: prefetch depth of the device double-buffer

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import logging
 import sys
+import threading
 
 _logger = logging.getLogger("paddle_tpu")
 if not _logger.handlers:
@@ -37,6 +38,31 @@ def info(msg: str, *args) -> None:
 
 def warning(msg: str, *args) -> None:
     _logger.warning(msg, *args)
+
+
+_warned_once: set = set()
+_warned_once_lock = threading.Lock()
+
+
+def warn_once(key, msg: str, *args) -> bool:
+    """Emit ``warning(msg, *args)`` only the first time ``key`` is seen.
+
+    For diagnostics sitting on hot paths (e.g. a per-trace state-name
+    fallback in ``framework.update_state``): the first occurrence is
+    signal, the ten-thousandth is log spam. Returns True when the warning
+    was actually emitted."""
+    with _warned_once_lock:
+        if key in _warned_once:
+            return False
+        _warned_once.add(key)
+    _logger.warning(msg, *args)
+    return True
+
+
+def reset_warn_once() -> None:
+    """Clear the warn_once dedup set (tests)."""
+    with _warned_once_lock:
+        _warned_once.clear()
 
 
 def error(msg: str, *args) -> None:

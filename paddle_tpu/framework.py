@@ -115,6 +115,11 @@ class _Frame:
         self.is_train = is_train
         self.name_stack: list[str] = []
         self.generator = unique_name.Generator()
+        # analysis hooks: params actually read this trace (create_parameter /
+        # gather_layer_params) and cross-scope update_state fallbacks — the
+        # model linter reads these off Model.apply's last trace
+        self.param_reads: set = set()
+        self.cross_scope_updates: set = set()
 
 
 _tls = threading.local()
@@ -241,7 +246,13 @@ def gather_layer_params(n_layers: int, name_of):
     frame = _current_frame()
     prefix = "/".join(frame.name_stack)
     prefix = prefix + "/" if prefix else ""
-    return stack_layer_params(frame.params, n_layers, name_of, prefix)
+    stacked = stack_layer_params(frame.params, n_layers, name_of, prefix)
+    # scanned layers read params without create_parameter; record the reads
+    # so model_lint's unused-param check sees through scan-over-layers
+    for i in range(n_layers):
+        for s in stacked:
+            frame.param_reads.add(f"{prefix}{name_of(i)}/{s}")
+    return stacked
 
 
 def scan_layer_stack(x, n_layers: int, name_of, template: str, body,
@@ -412,6 +423,7 @@ def create_parameter(
             f"parameter {full!r} not found in provided params; model structure "
             "must match between init and apply"
         )
+    frame.param_reads.add(full)
     value = frame.params[full]
     if tuple(value.shape) != shape:
         raise EnforceError(
@@ -442,10 +454,28 @@ def create_state(
 
 def update_state(name: str, value) -> None:
     """Record a new value for a state entry, addressed by the same local name
-    (within the same name_scope) it was created with."""
+    (within the same name_scope) it was created with.
+
+    A bare name that misses in the current scope falls back to the root
+    name — which can silently update a DIFFERENT layer's state when names
+    collide across scopes. The fallback still works (compat), but it now
+    emits a once-per-key warning and is recorded on the frame so
+    ``paddle_tpu.analysis.model_lint`` surfaces it as a diagnostic."""
     frame = _current_frame()
     scoped = "/".join(frame.name_stack + [name])
     full = scoped if (scoped in frame.state or scoped in frame.new_state) else name
+    if full is name and scoped != name and name in frame.state:
+        from paddle_tpu.core import logging as ptlog
+
+        frame.cross_scope_updates.add((scoped, name))
+        ptlog.warn_once(
+            ("update_state-cross-scope", scoped),
+            "update_state(%r): no state entry at scope %r; falling back to the "
+            "root-level name %r — a cross-scope state update resolves by "
+            "accident when names collide. Address state from within the "
+            "name_scope that created it.",
+            name, scoped, name,
+        )
     if frame.mode == "init":
         if full not in frame.state:
             raise EnforceError(f"unknown state {name!r} (create_state first)")
@@ -467,6 +497,10 @@ class Model:
         self._fn = fn
         self.name = name or getattr(fn, "__name__", "model")
         self.param_info: Dict[str, ParamInfo] = {}
+        self._last_param_info: Dict[str, ParamInfo] = {}
+        self._last_param_reads: frozenset = frozenset()
+        self._last_state_updates: frozenset = frozenset()
+        self._last_cross_scope_updates: frozenset = frozenset()
 
     def init(self, rng: Optional[jax.Array] = None, *args, **kwargs) -> Variables:
         if isinstance(rng, int):
@@ -509,6 +543,13 @@ class Model:
             _tls.frame = prev
         if not self.param_info:
             self.param_info = frame.param_info
+        # trace introspection for paddle_tpu.analysis.model_lint: what the
+        # last apply actually touched (python side effects survive tracing,
+        # so these are populated even under jax.eval_shape)
+        self._last_param_info = frame.param_info
+        self._last_param_reads = frozenset(frame.param_reads)
+        self._last_state_updates = frozenset(frame.new_state)
+        self._last_cross_scope_updates = frozenset(frame.cross_scope_updates)
         new_state = dict(state)
         new_state.update(frame.new_state)
         return out, new_state

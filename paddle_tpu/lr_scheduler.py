@@ -15,6 +15,8 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.core.enforce import enforce
+
 
 class LRScheduler:
     def __call__(self, step: jax.Array) -> jax.Array:
@@ -79,7 +81,11 @@ class PolynomialDecay(LRScheduler):
 
 class PiecewiseDecay(LRScheduler):
     def __init__(self, boundaries: Sequence[int], values: Sequence[float]):
-        assert len(values) == len(boundaries) + 1
+        enforce(
+            len(values) == len(boundaries) + 1,
+            "PiecewiseDecay needs len(values) == len(boundaries) + 1, got "
+            f"{len(values)} values for {len(boundaries)} boundaries",
+        )
         self.boundaries = [int(b) for b in boundaries]
         self.values = [float(v) for v in values]
 

@@ -565,6 +565,13 @@ def export_program(
         weights=b"".join(em.weights),
     )
     prog = native_passes.PassManager().run(prog, dump_dir=dump_passes_to)
+
+    # final gate: never write an artifact the C++ interpreter would reject
+    # (or worse, misexecute) — the analogue of the reference's ProgramDesc
+    # validation before save_inference_model serialized it
+    from paddle_tpu.analysis import verifier as _verifier
+
+    _verifier.verify_or_raise(prog, where="exported program")
     with open(os.path.join(out_dir, "program.txt"), "w") as f:
         f.write(prog.serialize())
     with open(os.path.join(out_dir, "weights.bin"), "wb") as f:

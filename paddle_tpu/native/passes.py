@@ -143,13 +143,37 @@ _REGISTRY: Dict[str, type] = {}
 
 
 def register_pass(cls: type) -> type:
-    """Class decorator: register under ``cls.name`` (REGISTER_PASS parity)."""
-    _REGISTRY[cls.name] = cls
+    """Class decorator: register under ``cls.name`` (REGISTER_PASS parity).
+    Rejects duplicate names — two passes silently shadowing each other is
+    exactly the registry bug class the reference's REGISTER_PASS macro
+    guarded with a compile-time check."""
+    from paddle_tpu.core.enforce import EnforceError
+
+    name = getattr(cls, "name", None)
+    if not isinstance(name, str) or not name:
+        raise EnforceError(f"pass class {cls.__qualname__} must set a non-empty 'name'")
+    existing = _REGISTRY.get(name)
+    if existing is not None and (
+        existing.__module__, existing.__qualname__
+    ) != (cls.__module__, cls.__qualname__):
+        # same-module/qualname re-registration is a module reload, not a clash
+        raise EnforceError(
+            f"duplicate pass name {name!r}: already registered by "
+            f"{existing.__module__}.{existing.__qualname__}"
+        )
+    _REGISTRY[name] = cls
     return cls
 
 
 def get_pass(name: str) -> "Pass":
-    return _REGISTRY[name]()
+    from paddle_tpu.core.enforce import EnforceError
+
+    cls = _REGISTRY.get(name)
+    if cls is None:
+        raise EnforceError(
+            f"unknown pass {name!r}; registered passes: {sorted(_REGISTRY)}"
+        )
+    return cls()
 
 
 class Pass:
@@ -352,14 +376,40 @@ def default_pipeline() -> List[Pass]:
     ]
 
 
+def _verify_default() -> bool:
+    """Verify-between-passes default: the ``verify_passes`` flag, forced on
+    under pytest so a broken rewrite fails the test that exercised it."""
+    from paddle_tpu.core import config
+
+    return bool(config.flags().verify_passes) or "PYTEST_CURRENT_TEST" in os.environ
+
+
 class PassManager:
     """Apply a pass pipeline; optionally dump the program after each pass
-    (``<dump_dir>/pass_<NN>_<name>.txt``) for pipeline debugging."""
+    (``<dump_dir>/pass_<NN>_<name>.txt``) for pipeline debugging.
+
+    With ``verify`` enabled (default: on under pytest or when the
+    ``verify_passes`` flag is set) the IR verifier
+    (``paddle_tpu.analysis.verifier``) checks the program before the
+    pipeline and after every pass — the TVM-style verify-between-passes
+    discipline — so a rewrite that breaks SSA or shape invariants is
+    attributed to the exact pass that introduced it."""
 
     def __init__(self, passes: Optional[Sequence[Pass]] = None):
         self.passes = list(passes) if passes is not None else default_pipeline()
 
-    def run(self, prog: Program, dump_dir: Optional[str] = None) -> Program:
+    def run(
+        self,
+        prog: Program,
+        dump_dir: Optional[str] = None,
+        verify: Optional[bool] = None,
+    ) -> Program:
+        if verify is None:
+            verify = _verify_default()
+        if verify:
+            from paddle_tpu.analysis import verifier
+
+            verifier.verify_or_raise(prog, where="before any pass")
         if dump_dir:
             os.makedirs(dump_dir, exist_ok=True)
             with open(os.path.join(dump_dir, "pass_00_input.txt"), "w") as f:
@@ -370,4 +420,6 @@ class PassManager:
                 path = os.path.join(dump_dir, f"pass_{i:02d}_{p.name}.txt")
                 with open(path, "w") as f:
                     f.write(prog.serialize())
+            if verify:
+                verifier.verify_or_raise(prog, where=f"after pass '{p.name}'")
         return prog

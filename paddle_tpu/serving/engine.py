@@ -88,6 +88,10 @@ class ServingConfig:
     num_replicas: Optional[int] = None
     # compile every (signature, batch bucket) executable at startup
     warmup: bool = True
+    # abstract-trace the model through paddle_tpu.analysis.lint_model before
+    # warm-up and log findings (never fatal); catches stale checkpoints,
+    # sharding-rank mistakes and f64 leaks before paying compile time
+    lint_model: bool = True
     # default per-request deadline; None = no deadline
     default_deadline_s: Optional[float] = None
 
@@ -219,6 +223,8 @@ class ServingEngine:
                 _Replica(i, exe, rep_vars, compiled, Channel(capacity=2))
             )
 
+        if self.config.lint_model:
+            self._lint_model(variables)
         if self.config.warmup:
             self._warmup()
 
@@ -235,6 +241,33 @@ class ServingEngine:
         self._batcher_thread = go(self._batcher.run)
 
     # -- startup -----------------------------------------------------------
+
+    def _lint_model(self, variables) -> None:
+        """Abstract-trace the model over the smallest warm-up signature and
+        surface structural findings (stale params, sharding-rank mismatches,
+        f64 leaks) in the log before compile time is spent. Best-effort:
+        lint failure never blocks serving."""
+        from paddle_tpu.core import logging as ptlog
+
+        try:
+            from paddle_tpu.analysis import lint_model as _lint
+
+            sig = sorted(self.buckets.all_signatures())[0]
+            rows = min(self.buckets.batch_buckets)
+            diags = _lint(
+                self.model, self._zeros_for(sig, rows),
+                variables=variables, train=False,
+            )
+            for d in diags:
+                ptlog.warn_once(
+                    ("serving-model-lint", self.model.name, d.code, d.where),
+                    "model lint [%s]: %s", d.code, str(d),
+                )
+        except Exception as e:  # pragma: no cover - defensive
+            ptlog.warn_once(
+                ("serving-model-lint-failed", self.model.name),
+                "model lint skipped: %s", e,
+            )
 
     def _zeros_for(self, sig, rows: int):
         return [

@@ -13,7 +13,7 @@ from paddle_tpu.native import passes as P
 
 PROG = """# paddle_tpu native program v2
 input 0 2 4 8
-const 1 0 1 8 f32
+const 1 0 2 1 8 f32
 op mul 2 2 0 1 -
 op mul 3 2 0 1 -
 op add 4 2 2 3 -

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Inspect an exported native serving program.
+
+Prints per-primitive op counts, const payload sizes, and the live-value
+high-water mark for a ``program.txt`` produced by
+``paddle_tpu.native.export.export_program``. With ``--verify`` the full
+IR verifier (``paddle_tpu.analysis.verifier``) runs too and the process
+exits non-zero on any error diagnostic — usable as a CI gate over
+exported artifacts.
+
+Usage:
+    python tools/lint_program.py EXPORT_DIR [--verify] [--top N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.native.passes import Program  # noqa: E402
+
+
+def _load(path: str):
+    prog_path = os.path.join(path, "program.txt") if os.path.isdir(path) else path
+    with open(prog_path, "r", encoding="utf-8") as f:
+        text = f.read()
+    weights = b""
+    wpath = os.path.join(os.path.dirname(prog_path), "weights.bin")
+    if os.path.exists(wpath):
+        with open(wpath, "rb") as f:
+            weights = f.read()
+    return Program.parse(text, weights)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="export directory (or program.txt path)")
+    ap.add_argument("--verify", action="store_true",
+                    help="run the IR verifier; exit 1 on errors")
+    ap.add_argument("--top", type=int, default=12,
+                    help="show the N most frequent primitives")
+    args = ap.parse_args(argv)
+
+    prog = _load(args.path)
+    kinds = collections.Counter(it.kind for it in prog.items)
+    prims = collections.Counter(it.prim for it in prog.items if it.kind == "op")
+
+    print(f"{prog.header.strip() or '(no header)'}")
+    print(f"lines: {len(prog.items)}  inputs: {kinds['input']}  "
+          f"consts: {kinds['const']}  ops: {kinds['op']}  "
+          f"outputs: {kinds['output']}")
+    print(f"weights.bin: {len(prog.weights)} bytes")
+    if prims:
+        print("top primitives:")
+        for prim, n in prims.most_common(args.top):
+            print(f"  {prim:24s} {n}")
+
+    if args.verify:
+        from paddle_tpu.analysis.diagnostics import format_diagnostics, has_errors
+        from paddle_tpu.analysis.verifier import verify_program
+
+        diags = verify_program(prog)
+        if diags:
+            print(format_diagnostics(diags))
+        if has_errors(diags):
+            print("verification FAILED")
+            return 1
+        print("verification OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
