@@ -71,6 +71,8 @@ from paddle_tpu.tensor import create_lod_tensor, create_random_int_lodtensor
 from paddle_tpu.inferencer import Inferencer
 from paddle_tpu import serving
 from paddle_tpu.serving import ServingConfig, ServingEngine
+from paddle_tpu import resilience
+from paddle_tpu.resilience import ResilienceConfig
 from paddle_tpu.reader.feeder import DataFeeder, FeedSpec
 from paddle_tpu import transpiler
 from paddle_tpu.transpiler import DistributeTranspiler, memory_optimize, release_memory
@@ -137,6 +139,8 @@ __all__ = [
     "serving",
     "ServingEngine",
     "ServingConfig",
+    "resilience",
+    "ResilienceConfig",
     "CPUPlace",
     "TPUPlace",
 ]

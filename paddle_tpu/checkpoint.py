@@ -18,13 +18,16 @@ import json
 import os
 import shutil
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from paddle_tpu.core import logging as ptlog
 from paddle_tpu.core.enforce import enforce
+from paddle_tpu.core.retry import retry_call
+from paddle_tpu.resilience import faults, integrity
+from paddle_tpu.resilience.integrity import CheckpointCorruptError
 
 _META = "checkpoint.json"
 
@@ -80,18 +83,22 @@ def save_checkpoint(
     extra_meta: Optional[dict] = None,
 ) -> str:
     """Save a full training pytree under a new serial dir; prune old serials
-    (reference save_checkpoint + _scroll_delete, trainer.py:663)."""
+    (reference save_checkpoint + _scroll_delete, trainer.py:663).
+
+    Durability contract (Go pserver parity, ``service.go:346-450``): shard
+    npz + META are written to a tmp dir, fsync'd, CRC32 of the npz recorded
+    in META, published by atomic rename, and the parent dir fsync'd — a
+    crash at any point leaves the previous serial intact. Transient IO
+    errors retry with backoff (``core.retry``)."""
     os.makedirs(root, exist_ok=True)
     serials = sorted(_existing_serials(root))
     serial = (serials[-1] + 1) if serials else 0
     final_dir = _serial_dir(root, serial)
     tmp_dir = final_dir + ".tmp"
-    if os.path.exists(tmp_dir):
-        shutil.rmtree(tmp_dir)
-    os.makedirs(tmp_dir)
 
+    # host snapshot once; only the file IO below is retried
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    np.savez(os.path.join(tmp_dir, f"shard_{trainer_id}.npz"), **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
+    arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
     meta = {
         "step": int(step),
         "epoch": int(epoch),
@@ -103,9 +110,26 @@ def save_checkpoint(
     }
     if extra_meta:
         meta.update(extra_meta)
-    with open(os.path.join(tmp_dir, _META), "w") as f:
-        json.dump(meta, f, indent=1)
-    os.rename(tmp_dir, final_dir)  # atomic publish
+
+    def write_and_publish():
+        faults.inject(faults.CHECKPOINT_SAVE, root=root, serial=serial)
+        if os.path.exists(tmp_dir):  # idempotent across retries
+            shutil.rmtree(tmp_dir)
+        os.makedirs(tmp_dir)
+        shard_path = os.path.join(tmp_dir, f"shard_{trainer_id}.npz")
+        np.savez(shard_path, **arrays)
+        integrity.fsync_file(shard_path)
+        meta["crc32"] = {os.path.basename(shard_path): integrity.crc32_file(shard_path)}
+        integrity.write_json_durable(os.path.join(tmp_dir, _META), meta)
+        integrity.fsync_dir(tmp_dir)
+        os.rename(tmp_dir, final_dir)  # atomic publish
+        integrity.fsync_dir(root)  # make the rename itself durable
+
+    retry_call(
+        write_and_publish,
+        retries=2, base_delay=0.02, max_delay=0.5,
+        what=f"checkpoint save (serial {serial})",
+    )
 
     for old in serials[: max(0, len(serials) + 1 - max_num_checkpoints)]:
         shutil.rmtree(_serial_dir(root, old), ignore_errors=True)
@@ -118,7 +142,11 @@ def _existing_serials(root: str):
     if not os.path.isdir(root):
         return out
     for name in os.listdir(root):
-        if name.startswith("checkpoint_") and not name.endswith(".tmp"):
+        if (
+            name.startswith("checkpoint_")
+            and not name.endswith(".tmp")
+            and integrity.CORRUPT_SUFFIX not in name  # quarantined serials
+        ):
             try:
                 out.append(int(name.split("_")[-1]))
             except ValueError:
@@ -131,19 +159,64 @@ def latest_checkpoint(root: str) -> Optional[str]:
     return _serial_dir(root, max(serials)) if serials else None
 
 
+def _load_serial(path: str, trainer_id: int) -> Tuple[List[np.ndarray], dict]:
+    """Read + verify one serial dir. Raises CheckpointCorruptError (or an
+    IO/parse error) on any integrity failure; callers decide fallback."""
+    faults.inject(faults.CHECKPOINT_LOAD, path=path)
+    meta_path = os.path.join(path, _META)
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise CheckpointCorruptError(f"{meta_path}: unparseable META ({e})") from e
+    shard_name = f"shard_{trainer_id}.npz"
+    shard_path = os.path.join(path, shard_name)
+    # CRC recorded at save time (absent on pre-integrity checkpoints:
+    # verify what we can, stay loadable)
+    expected = (meta.get("crc32") or {}).get(shard_name)
+    if expected is not None:
+        integrity.verify_crc(shard_path, expected, what=shard_path)
+    try:
+        with np.load(shard_path) as z:
+            leaves = [z[f"leaf_{i}"] for i in range(meta["num_leaves"])]
+    except (ValueError, KeyError, OSError, EOFError) as e:
+        # truncated zip / missing member / bad pickle header all land here
+        raise CheckpointCorruptError(f"{shard_path}: unreadable ({e})") from e
+    return leaves, meta
+
+
 def load_checkpoint(path_or_root: str, tree_like: Any, trainer_id: int = 0) -> Tuple[Any, dict]:
     """Load into the structure of ``tree_like``; returns (tree, meta).
     Auto-resolves the latest serial when given the root dir (the auto-resume
-    path of Trainer.__init__, trainer.py:594)."""
-    path = path_or_root
-    if not os.path.exists(os.path.join(path, _META)):
-        latest = latest_checkpoint(path_or_root)
-        enforce(latest is not None, f"no checkpoint found under {path_or_root}")
-        path = latest
-    with open(os.path.join(path, _META)) as f:
-        meta = json.load(f)
-    with np.load(os.path.join(path, f"shard_{trainer_id}.npz")) as z:
-        leaves = [z[f"leaf_{i}"] for i in range(meta["num_leaves"])]
+    path of Trainer.__init__, trainer.py:594).
+
+    Integrity: each candidate serial's META CRC32 is verified against the
+    shard npz. A corrupt/truncated serial is QUARANTINED (renamed
+    ``*.corrupt``) and — when loading from the root — the previous good
+    serial is tried instead, so one torn write never kills auto-resume."""
+    explicit = os.path.exists(os.path.join(path_or_root, _META))
+    if explicit:
+        candidates = [path_or_root]
+    else:
+        serials = sorted(_existing_serials(path_or_root), reverse=True)
+        enforce(bool(serials), f"no checkpoint found under {path_or_root}")
+        candidates = [_serial_dir(path_or_root, s) for s in serials]
+
+    last_err: Optional[Exception] = None
+    leaves, meta = None, None
+    for path in candidates:
+        try:
+            leaves, meta = _load_serial(path, trainer_id)
+            break
+        except (CheckpointCorruptError, OSError) as e:
+            last_err = e
+            ptlog.error("checkpoint %s failed verification: %s", path, e)
+            integrity.quarantine(path)
+    enforce(
+        leaves is not None,
+        f"no loadable checkpoint under {path_or_root} "
+        f"(all candidates corrupt; last error: {last_err})",
+    )
     treedef = jax.tree_util.tree_structure(tree_like)
     like_leaves = jax.tree_util.tree_leaves(tree_like)
     enforce(
@@ -169,11 +242,6 @@ def update_meta(path_or_root: str, updates: dict) -> None:
     with open(meta_path) as f:
         meta = json.load(f)
     meta.update(updates)
-    # atomic publish: a crash mid-write must not corrupt the latest
-    # checkpoint's metadata (auto-resume reads it)
-    tmp_path = meta_path + ".tmp"
-    with open(tmp_path, "w") as f:
-        json.dump(meta, f, indent=1)
-        f.flush()
-        os.fsync(f.fileno())
-    os.rename(tmp_path, meta_path)
+    # atomic + durable publish: a crash mid-write must not corrupt the
+    # latest checkpoint's metadata (auto-resume reads it)
+    integrity.write_json_durable(meta_path, meta)

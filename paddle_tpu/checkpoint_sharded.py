@@ -32,8 +32,14 @@ import numpy as np
 
 from paddle_tpu.core import logging as ptlog
 from paddle_tpu.core.enforce import enforce
+from paddle_tpu.core.retry import retry_call
+from paddle_tpu.resilience import faults, integrity
+from paddle_tpu.resilience.integrity import CheckpointCorruptError
 
 _MANIFEST = "manifest.json"
+# per-shard CRC32 sidecar: shards_pN.npz.crc (each process writes its own
+# shard file, so the pid-0 manifest cannot carry every shard's checksum)
+_CRC_SUFFIX = ".crc"
 
 
 def _index_key(leaf_i: int, index: Tuple[slice, ...], shape: Tuple[int, ...]) -> str:
@@ -89,24 +95,44 @@ def _snapshot(tree: Any, step: int, epoch: int, extra_meta: Optional[dict]):
 
 
 def _write_local(tmp_dir: str, pid: int, shard_data, manifest, write_manifest: bool):
-    np.savez(os.path.join(tmp_dir, f"shards_p{pid}.npz"), **shard_data)
+    """Write one process's shard npz (+ CRC sidecar, fsync'd) and, for the
+    manifest owner, the durable manifest JSON."""
+    faults.inject(faults.CHECKPOINT_SAVE, dir=tmp_dir, pid=pid)
+    shard_path = os.path.join(tmp_dir, f"shards_p{pid}.npz")
+    np.savez(shard_path, **shard_data)
+    integrity.fsync_file(shard_path)
+    crc_path = shard_path + _CRC_SUFFIX
+    with open(crc_path, "w") as f:
+        f.write(str(integrity.crc32_file(shard_path)))
+        f.flush()
+        os.fsync(f.fileno())
     if write_manifest:
-        with open(os.path.join(tmp_dir, _MANIFEST), "w") as f:
-            json.dump(manifest, f, indent=1)
+        integrity.write_json_durable(os.path.join(tmp_dir, _MANIFEST), manifest)
 
 
 def _write_publish_local(root: str, step: int, shard_data, manifest, max_num: int) -> str:
     """Single-process write + atomic publish + prune — ONE owner of the
     tmp-dir/rename/prune protocol, shared by the sync path and the async
-    writer thread."""
+    writer thread. Files are fsync'd before the rename and the parent dir
+    after it (durable publish); transient IO errors retry with backoff."""
     final_dir = os.path.join(root, f"checkpoint_{step}")
     tmp_dir = final_dir + ".tmp"
-    os.makedirs(root, exist_ok=True)
-    if os.path.exists(tmp_dir):
-        shutil.rmtree(tmp_dir)
-    os.makedirs(tmp_dir)
-    _write_local(tmp_dir, 0, shard_data, manifest, write_manifest=True)
-    os.rename(tmp_dir, final_dir)  # atomic publish
+
+    def write_and_publish():
+        os.makedirs(root, exist_ok=True)
+        if os.path.exists(tmp_dir):  # idempotent across retries
+            shutil.rmtree(tmp_dir)
+        os.makedirs(tmp_dir)
+        _write_local(tmp_dir, 0, shard_data, manifest, write_manifest=True)
+        integrity.fsync_dir(tmp_dir)
+        os.rename(tmp_dir, final_dir)  # atomic publish
+        integrity.fsync_dir(root)  # make the rename itself durable
+
+    retry_call(
+        write_and_publish,
+        retries=2, base_delay=0.02, max_delay=0.5,
+        what=f"sharded checkpoint save (step {step})",
+    )
     _prune(root, max_num)
     return final_dir
 
@@ -142,7 +168,9 @@ def save_sharded(
     _write_local(tmp_dir, pid, shard_data, manifest, write_manifest=pid == 0)
     _barrier("ckpt_written")
     if pid == 0:
+        integrity.fsync_dir(tmp_dir)
         os.rename(tmp_dir, final_dir)  # atomic publish
+        integrity.fsync_dir(root)  # make the rename itself durable
         _prune(root, max_num_checkpoints)
     _barrier("ckpt_published")
     ptlog.vlog(1, "sharded checkpoint step %d -> %s (process %d)", step, final_dir, pid)
@@ -239,20 +267,68 @@ def latest_sharded_checkpoint(root: str) -> Optional[str]:
     return os.path.join(root, f"checkpoint_{max(steps)}") if steps else None
 
 
+def _verify_serial(path: str) -> dict:
+    """Parse the manifest and CRC-verify every shard npz of one serial;
+    raises CheckpointCorruptError on any integrity failure."""
+    faults.inject(faults.CHECKPOINT_LOAD, path=path)
+    mpath = os.path.join(path, _MANIFEST)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise CheckpointCorruptError(f"{mpath}: unparseable manifest ({e})") from e
+    shard_files = sorted(glob.glob(os.path.join(path, "shards_p*.npz")))
+    if not shard_files:
+        raise CheckpointCorruptError(f"{path}: no shard files")
+    for fn in shard_files:
+        crc_path = fn + _CRC_SUFFIX
+        if not os.path.exists(crc_path):
+            continue  # pre-integrity checkpoint: stays loadable
+        try:
+            with open(crc_path) as f:
+                expected = int(f.read().strip())
+        except ValueError as e:
+            raise CheckpointCorruptError(f"{crc_path}: unreadable CRC ({e})") from e
+        integrity.verify_crc(fn, expected, what=fn)
+    return manifest
+
+
 def load_sharded(path_or_root: str, tree_like: Any) -> Tuple[Any, dict]:
     """Restore into the structure/shardings of ``tree_like`` (arrays or
     ShapeDtypeStructs with ``.sharding``). Returns (tree, manifest).
 
     Each process materializes only its addressable shards: exact slice
     matches read one saved block; resharded targets assemble from the
-    overlapping saved blocks."""
-    path = path_or_root
-    if not os.path.exists(os.path.join(path, _MANIFEST)):
-        latest = latest_sharded_checkpoint(path_or_root)
-        enforce(latest is not None, f"no sharded checkpoint under {path_or_root}")
-        path = latest
-    with open(os.path.join(path, _MANIFEST)) as f:
-        manifest = json.load(f)
+    overlapping saved blocks.
+
+    Integrity: every shard npz is CRC32-verified against its sidecar before
+    any bytes are trusted. A corrupt serial is quarantined (``*.corrupt``)
+    and — when resolving from the root — the previous good serial is used
+    instead. (Multi-host: every process applies the same deterministic
+    fallback order; the quarantine rename is first-writer-wins.)"""
+    explicit = os.path.exists(os.path.join(path_or_root, _MANIFEST))
+    if explicit:
+        candidates = [path_or_root]
+    else:
+        steps = sorted(_existing_steps(path_or_root), reverse=True)
+        enforce(bool(steps), f"no sharded checkpoint under {path_or_root}")
+        candidates = [os.path.join(path_or_root, f"checkpoint_{s}") for s in steps]
+
+    manifest, path, last_err = None, None, None
+    for cand in candidates:
+        try:
+            manifest = _verify_serial(cand)
+            path = cand
+            break
+        except (CheckpointCorruptError, OSError) as e:
+            last_err = e
+            ptlog.error("sharded checkpoint %s failed verification: %s", cand, e)
+            integrity.quarantine(cand)
+    enforce(
+        manifest is not None,
+        f"no loadable sharded checkpoint under {path_or_root} "
+        f"(all candidates corrupt; last error: {last_err})",
+    )
 
     # shard index: leaf -> [(slices, file, npz_key)]
     index: Dict[int, list] = {}
@@ -339,7 +415,11 @@ def _existing_steps(root: str):
     if not os.path.isdir(root):
         return out
     for name in os.listdir(root):
-        if name.startswith("checkpoint_") and not name.endswith(".tmp"):
+        if (
+            name.startswith("checkpoint_")
+            and not name.endswith(".tmp")
+            and integrity.CORRUPT_SUFFIX not in name  # quarantined serials
+        ):
             sub = os.path.join(root, name)
             if os.path.exists(os.path.join(sub, _MANIFEST)):
                 try:

@@ -26,6 +26,12 @@ class Flags:
     # numeric sanitizer: check NaN/Inf on fetched outputs (FLAGS_check_nan_inf,
     # reference operator.cc:28,725-737). In-graph via jax_debug_nans is separate.
     check_nan_inf: bool = False
+    # what the Trainer does with a non-finite step when check_nan_inf is on:
+    # "raise" | "skip_step" | "rollback" (see resilience.ResilienceConfig)
+    check_nan_inf_policy: str = "raise"
+    # consecutive bad steps before the "rollback" policy restores the last
+    # good checkpoint
+    nan_rollback_after: int = 3
     # print per-step timing/memory like FLAGS_benchmark (executor.cc:399-401)
     benchmark: bool = False
     # mixed precision: bf16 compute for matmul/conv (MXU-native)

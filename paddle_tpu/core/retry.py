@@ -1,0 +1,96 @@
+"""Retry with exponential backoff + jitter — the shared recovery primitive.
+
+Reference: the Go pserver client retried RPCs around its CRC-checked
+checkpoint protocol (``go/pserver/client/client.go`` selective re-dial on
+connection loss); the C++ side leaned on gRPC's own backoff. Here one
+helper owns the policy so checkpoint IO, replica health probes, and any
+future flaky-IO path degrade the same way: capped exponential delays with
+jitter (decorrelating a fleet of workers hammering shared storage), a
+typed allowlist of retryable exceptions, and deterministic behavior when
+the caller seeds the rng — fault-injection tests assert exact schedules.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Callable, Iterator, Optional, Sequence, Tuple, Type
+
+from paddle_tpu.core.enforce import enforce
+
+__all__ = ["backoff_delays", "next_backoff", "retry_call"]
+
+
+def next_backoff(
+    attempt: int,
+    base_delay: float = 0.05,
+    max_delay: float = 2.0,
+    jitter: float = 0.5,
+    rng: Optional[random.Random] = None,
+) -> float:
+    """Delay before retry number ``attempt`` (0-based): ``base * 2**attempt``
+    capped at ``max_delay``, then stretched by up to ``jitter`` fraction.
+    With ``rng=None`` the jitter draw comes from a module-default seeded
+    generator, so schedules are reproducible run-to-run."""
+    enforce(attempt >= 0, f"attempt must be >= 0, got {attempt}")
+    d = min(max_delay, base_delay * (2.0 ** attempt))
+    if jitter > 0.0:
+        r = rng if rng is not None else _default_rng
+        d *= 1.0 + jitter * r.random()
+    return d
+
+
+# deterministic default: a fixed seed keeps un-seeded call sites reproducible
+_default_rng = random.Random(0x5EED)
+
+
+def backoff_delays(
+    retries: int,
+    base_delay: float = 0.05,
+    max_delay: float = 2.0,
+    jitter: float = 0.5,
+    rng: Optional[random.Random] = None,
+) -> Iterator[float]:
+    """Yield the ``retries`` successive sleep delays of one retry loop."""
+    for attempt in range(retries):
+        yield next_backoff(attempt, base_delay, max_delay, jitter, rng)
+
+
+def retry_call(
+    fn: Callable[..., Any],
+    *args: Any,
+    retries: int = 3,
+    retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+    base_delay: float = 0.05,
+    max_delay: float = 2.0,
+    jitter: float = 0.5,
+    rng: Optional[random.Random] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    on_retry: Optional[Callable[[int, BaseException, float], None]] = None,
+    what: Optional[str] = None,
+    **kwargs: Any,
+):
+    """Call ``fn(*args, **kwargs)``, retrying up to ``retries`` times on any
+    exception in ``retry_on`` (``retries + 1`` attempts total). Non-listed
+    exceptions propagate immediately; the last listed exception propagates
+    once attempts are exhausted. ``on_retry(attempt, exc, delay)`` observes
+    each retry (tests, metrics); ``sleep`` is injectable so unit tests run
+    without wall-clock waits."""
+    from paddle_tpu.core import logging as ptlog
+
+    enforce(retries >= 0, f"retries must be >= 0, got {retries}")
+    label = what or getattr(fn, "__name__", "call")
+    for attempt in range(retries + 1):
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:
+            if attempt >= retries:
+                raise
+            delay = next_backoff(attempt, base_delay, max_delay, jitter, rng)
+            ptlog.warning(
+                "%s failed (%s: %s); retry %d/%d in %.3fs",
+                label, type(e).__name__, e, attempt + 1, retries, delay,
+            )
+            if on_retry is not None:
+                on_retry(attempt, e, delay)
+            sleep(delay)

@@ -45,6 +45,7 @@ __all__ = [
     "shard",
     "xmap_readers",
     "multiprocess_reader",
+    "ReaderWorkerError",
     "batch",
     "stack_batch",
     "cache",
@@ -244,13 +245,27 @@ def xmap_readers(mapper: Callable, reader: Reader, process_num: int, buffer_size
     return xreader
 
 
+class ReaderWorkerError(RuntimeError):
+    """A multiprocess_reader worker failed. ``pid`` is the worker process;
+    ``retryable`` distinguishes a transient crash (process killed hard —
+    OOM/segfault/preemption; a retry may succeed) from a poison pill (the
+    reader itself RAISED on some sample — deterministic, retrying replays
+    the same failure)."""
+
+    def __init__(self, message: str, pid: Optional[int], retryable: bool):
+        super().__init__(message)
+        self.pid = pid
+        self.retryable = retryable
+
+
 def multiprocess_reader(readers: Sequence[Reader], use_pipe: bool = True, queue_size: int = 1000) -> Reader:
     """Run each reader in its own OS PROCESS, interleaving their samples
     (reference ``decorator.py:338`` multiprocess_reader) — sidesteps the
     GIL for CPU-heavy decode, unlike the thread-based ``xmap_readers``.
     Samples must be picklable; ``use_pipe`` is accepted for API parity
     (one shared queue serves both modes here). Worker exceptions re-raise
-    in the consumer."""
+    in the consumer as :class:`ReaderWorkerError` carrying the worker pid
+    and whether the failure looks transient."""
     from paddle_tpu.core.enforce import enforce as _enforce
 
     _enforce(len(readers) > 0, "multiprocess_reader needs at least one reader")
@@ -275,6 +290,8 @@ def multiprocess_reader(readers: Sequence[Reader], use_pipe: bool = True, queue_
         q = ctx.Queue(queue_size)
 
         def work(r):
+            import os as _os
+
             try:
                 for sample in r():
                     # pickle HERE, not in mp.Queue's feeder thread — a
@@ -283,7 +300,7 @@ def multiprocess_reader(readers: Sequence[Reader], use_pipe: bool = True, queue_
                     # consumer as an error message
                     q.put(("item", pickle.dumps(sample)))
             except Exception as e:  # picklable summary, not the traceback
-                q.put(("error", f"{type(e).__name__}: {e}"))
+                q.put(("error", (_os.getpid(), f"{type(e).__name__}: {e}")))
             finally:
                 q.put(("end", None))
 
@@ -297,17 +314,34 @@ def multiprocess_reader(readers: Sequence[Reader], use_pipe: bool = True, queue_
                     kind, payload = q.get(timeout=1.0)
                 except _qm.Empty:
                     # a worker killed hard (OOM/segfault) never posts its
-                    # sentinel — detect instead of blocking forever
+                    # sentinel — detect instead of blocking forever. That
+                    # death is environmental, so a rerun may well succeed:
+                    # retryable, attributed to the dead pid.
                     if not any(p.is_alive() for p in procs) and q.empty():
-                        raise RuntimeError(
-                            "multiprocess_reader: worker process died without "
-                            "finishing (killed or crashed)"
+                        dead = next(
+                            (p for p in procs if p.exitcode not in (0, None)),
+                            None,
+                        )
+                        raise ReaderWorkerError(
+                            "multiprocess_reader: worker process "
+                            f"{dead.pid if dead else '?'} died without "
+                            "finishing (killed or crashed, exitcode "
+                            f"{dead.exitcode if dead else '?'})",
+                            pid=dead.pid if dead else None,
+                            retryable=True,
                         )
                     continue
                 if kind == "end":
                     finished += 1
                 elif kind == "error":
-                    raise RuntimeError(f"multiprocess_reader worker failed: {payload}")
+                    # the reader RAISED on a sample — a poison pill that a
+                    # retry would deterministically replay: not retryable
+                    wpid, msg = payload
+                    raise ReaderWorkerError(
+                        f"multiprocess_reader worker {wpid} failed: {msg}",
+                        pid=wpid,
+                        retryable=False,
+                    )
                 else:
                     yield pickle.loads(payload)
         finally:

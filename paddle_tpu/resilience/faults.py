@@ -1,0 +1,229 @@
+"""Deterministic fault injection: named points, seeded schedules.
+
+The reference framework's robustness machinery (per-op
+``FLAGS_check_nan_inf`` in ``operator.cc:725-737``, the Go pserver's
+CRC-checked checkpoints, the trainer's ExceptionHolder) was tested by
+real clusters failing. This reproduction tests it on purpose: production
+code calls :func:`inject` at a handful of named points, and a test (or
+``tools/chaos_smoke.py``) installs a :class:`FaultSpec` schedule that
+makes exactly the chosen hits fail — IO errors, NaN gradients, stalls,
+simulated preemption — so every recovery path is exercised determin-
+istically under tier-1.
+
+With no plan installed, :func:`inject` is a single global ``is None``
+check — zero overhead on production hot paths.
+
+Fault kinds:
+
+- ``"error"``  — raise ``spec.exc`` (default ``OSError``) at the point;
+- ``"nan"``    — return the spec; the call site poisons its own numerics
+  (the trainer treats the step's gradients as non-finite);
+- ``"stall"``  — sleep ``spec.stall_s`` then return the spec (exercises
+  the step watchdog);
+- ``"preempt"``— deliver SIGTERM to this process (the cluster-preemption
+  signal the Trainer already catches at step boundaries).
+
+Scheduling: a spec fires on hit numbers ``after .. after+times-1`` of its
+point (per-spec hit counter), or — when ``p`` is set — on each hit with
+probability ``p`` drawn from the PLAN's seeded rng, so a whole chaos
+schedule replays identically for a given seed.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from paddle_tpu.core import logging as ptlog
+from paddle_tpu.core import profiler as prof
+from paddle_tpu.core.enforce import enforce, enforce_in
+
+__all__ = [
+    "FaultSpec",
+    "FaultPlan",
+    "install",
+    "clear",
+    "injected",
+    "inject",
+    "active_plan",
+    "stats",
+    "CHECKPOINT_SAVE",
+    "CHECKPOINT_LOAD",
+    "READER_NEXT",
+    "TRAINER_STEP",
+    "SERVING_DISPATCH",
+]
+
+# the named injection points wired into the framework
+CHECKPOINT_SAVE = "checkpoint.save"
+CHECKPOINT_LOAD = "checkpoint.load"
+READER_NEXT = "reader.next"
+TRAINER_STEP = "trainer.step"
+SERVING_DISPATCH = "serving.dispatch"
+
+_KINDS = ("error", "nan", "stall", "preempt")
+
+
+class FaultSpec:
+    """One scheduled fault at one injection point."""
+
+    def __init__(
+        self,
+        point: str,
+        kind: str = "error",
+        *,
+        after: int = 0,
+        times: int = 1,
+        p: Optional[float] = None,
+        exc: Optional[BaseException] = None,
+        stall_s: float = 0.0,
+        match: Optional[Dict[str, Any]] = None,
+    ):
+        enforce_in(kind, _KINDS, "fault kind")
+        enforce(times >= 1, f"times must be >= 1, got {times}")
+        enforce(after >= 0, f"after must be >= 0, got {after}")
+        enforce(p is None or 0.0 < p <= 1.0, f"p must be in (0, 1], got {p}")
+        self.point = point
+        self.kind = kind
+        self.after = after
+        self.times = times
+        self.p = p
+        self.exc = exc
+        self.stall_s = float(stall_s)
+        # only hits whose context contains these key/value pairs count
+        # (e.g. match={"replica": 0} pins a serving fault to one replica)
+        self.match = dict(match or {})
+        self.hits = 0   # matching calls observed
+        self.fired = 0  # faults actually triggered
+
+    def __repr__(self):
+        return (
+            f"FaultSpec({self.point!r}, {self.kind!r}, after={self.after}, "
+            f"times={self.times}, p={self.p}, fired={self.fired})"
+        )
+
+    def _matches(self, ctx: Dict[str, Any]) -> bool:
+        return all(ctx.get(k) == v for k, v in self.match.items())
+
+    def _due(self, rng: random.Random) -> bool:
+        """Called with the plan lock held, after ``hits`` was bumped."""
+        if self.p is not None:
+            return self.fired < self.times and rng.random() < self.p
+        hit = self.hits - 1  # 0-based index of this hit
+        return self.after <= hit < self.after + self.times
+
+
+class FaultPlan:
+    """An installed set of specs sharing one seeded rng."""
+
+    def __init__(self, specs: List[FaultSpec], seed: int = 0):
+        self.specs = list(specs)
+        self.rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def stats(self) -> Dict[str, int]:
+        """point -> total faults fired (summed over specs)."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for s in self.specs:
+                out[s.point] = out.get(s.point, 0) + s.fired
+            return out
+
+    def all_fired(self) -> bool:
+        """True when every spec triggered at least once — chaos_smoke's
+        "the schedule actually ran" assertion."""
+        with self._lock:
+            return all(s.fired > 0 for s in self.specs)
+
+
+_plan: Optional[FaultPlan] = None
+
+
+def install(*specs: FaultSpec, seed: int = 0) -> FaultPlan:
+    """Install a fault plan (replacing any active one). Returns the plan so
+    callers can read per-spec ``fired`` counters afterwards."""
+    global _plan
+    _plan = FaultPlan(list(specs), seed=seed)
+    return _plan
+
+
+def clear() -> None:
+    global _plan
+    _plan = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _plan
+
+
+def stats() -> Dict[str, int]:
+    """Fired-fault counts of the active plan ({} when none installed)."""
+    return _plan.stats() if _plan is not None else {}
+
+
+class injected:
+    """Context manager: install specs on enter, restore the previous plan on
+    exit (tests)."""
+
+    def __init__(self, *specs: FaultSpec, seed: int = 0):
+        self._specs = specs
+        self._seed = seed
+        self._prev: Optional[FaultPlan] = None
+        self.plan: Optional[FaultPlan] = None
+
+    def __enter__(self) -> FaultPlan:
+        global _plan
+        self._prev = _plan
+        self.plan = install(*self._specs, seed=self._seed)
+        return self.plan
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> bool:
+        global _plan
+        _plan = self._prev
+        return False
+
+
+def inject(point: str, **ctx: Any) -> Optional[FaultSpec]:
+    """Fault injection point. No-op (returns None) unless an installed spec
+    matches ``point`` (+ ``ctx``) and its schedule says this hit fires.
+
+    ``"error"``/``"preempt"`` act here (raise / SIGTERM); ``"nan"`` and
+    ``"stall"`` return the fired spec so the call site applies the fault to
+    its own state. At most one spec fires per call (first match wins)."""
+    plan = _plan
+    if plan is None:
+        return None
+    fired: Optional[FaultSpec] = None
+    with plan._lock:
+        for spec in plan.specs:
+            if spec.point != point or not spec._matches(ctx):
+                continue
+            spec.hits += 1
+            if spec._due(plan.rng):
+                spec.fired += 1
+                fired = spec
+                break
+    if fired is None:
+        return None
+    prof.inc_counter(f"resilience.faults_fired:{point}")
+    ptlog.warning(
+        "fault injected at %s (%s, fired %d): ctx=%r",
+        point, fired.kind, fired.fired, ctx,
+    )
+    if fired.kind == "error":
+        raise fired.exc if fired.exc is not None else OSError(
+            f"injected fault at {point}"
+        )
+    if fired.kind == "stall":
+        time.sleep(fired.stall_s)
+        return fired
+    if fired.kind == "preempt":
+        # the real thing: the cluster-preemption signal, delivered to this
+        # process; the Trainer's handler checkpoints at the step boundary
+        os.kill(os.getpid(), signal.SIGTERM)
+        return fired
+    return fired  # "nan": the caller poisons its own numerics

@@ -29,6 +29,7 @@ from paddle_tpu.serving.engine import (
     DeadlineExceeded,
     EngineClosedError,
     PendingResult,
+    ReplicaDied,
     ServingConfig,
     ServingEngine,
 )
@@ -40,6 +41,7 @@ __all__ = [
     "PendingResult",
     "DeadlineExceeded",
     "EngineClosedError",
+    "ReplicaDied",
     "MicroBatcher",
     "Group",
     "ShapeBuckets",

@@ -45,11 +45,14 @@ import numpy as np
 
 from paddle_tpu.concurrency import Channel, ChannelClosedError, go
 from paddle_tpu.core import config as cfg
+from paddle_tpu.core import logging as ptlog
 from paddle_tpu.core import profiler as prof
 from paddle_tpu.core.enforce import EnforceError, enforce
 from paddle_tpu.executor import Executor
 from paddle_tpu.framework import Model, Variables, build
 from paddle_tpu.reader.feeder import FeedSpec
+from paddle_tpu.resilience import faults
+from paddle_tpu.resilience.circuit import CircuitBreaker
 from paddle_tpu.serving.batcher import Group, MicroBatcher
 from paddle_tpu.serving.buckets import ShapeBuckets
 from paddle_tpu.serving.metrics import ServingMetrics
@@ -60,6 +63,7 @@ __all__ = [
     "PendingResult",
     "DeadlineExceeded",
     "EngineClosedError",
+    "ReplicaDied",
 ]
 
 
@@ -69,6 +73,11 @@ class DeadlineExceeded(TimeoutError):
 
 class EngineClosedError(RuntimeError):
     """submit() after close() — the engine no longer accepts requests."""
+
+
+class ReplicaDied(RuntimeError):
+    """The replica worker thread exited while this request was queued on
+    its channel (and no healthy replica could take the batch)."""
 
 
 @dataclasses.dataclass
@@ -94,6 +103,13 @@ class ServingConfig:
     lint_model: bool = True
     # default per-request deadline; None = no deadline
     default_deadline_s: Optional[float] = None
+    # -- replica health (resilience.circuit.CircuitBreaker per replica) ----
+    # consecutive batch failures that eject a replica from rotation
+    replica_failure_threshold: int = 3
+    # cooldown before an ejected replica gets a half-open probe batch;
+    # successive re-trips back off exponentially up to the max
+    replica_cooldown_s: float = 1.0
+    replica_max_cooldown_s: float = 30.0
 
 
 class PendingResult:
@@ -148,15 +164,20 @@ class _ReplicaPlace(cfg.Place):
 
 
 class _Replica:
-    __slots__ = ("index", "exe", "variables", "compiled", "channel", "thread")
+    __slots__ = (
+        "index", "exe", "variables", "compiled", "channel", "thread",
+        "breaker", "dead",
+    )
 
-    def __init__(self, index: int, exe: Executor, variables, compiled, channel):
+    def __init__(self, index: int, exe: Executor, variables, compiled, channel, breaker):
         self.index = index
         self.exe = exe
         self.variables = variables
         self.compiled = compiled
         self.channel = channel
         self.thread = None
+        self.breaker = breaker  # health gate: CLOSED/OPEN/HALF_OPEN
+        self.dead = False       # worker thread exited abnormally
 
 
 class ServingEngine:
@@ -196,7 +217,10 @@ class ServingEngine:
         self.metrics = ServingMetrics()
         self._closed = False
         self._close_lock = threading.Lock()
-        self._rr = 0  # round-robin cursor (batcher thread only)
+        self._rr = 0  # round-robin cursor (guarded by _pick_lock)
+        # replica picking happens on the batcher thread AND on worker
+        # threads redispatching a failed batch
+        self._pick_lock = threading.Lock()
 
         base_place = place or cfg.default_place()
         platform = base_place.platform
@@ -219,9 +243,15 @@ class ServingEngine:
             exe = Executor(_ReplicaPlace(platform, i))
             rep_vars = jax.device_put(variables, exe.device)
             compiled = exe.prepare(self._fwd, key=("serving", self.model.name, i))
-            self._replicas.append(
-                _Replica(i, exe, rep_vars, compiled, Channel(capacity=2))
+            breaker = CircuitBreaker(
+                failure_threshold=self.config.replica_failure_threshold,
+                cooldown_s=self.config.replica_cooldown_s,
+                max_cooldown_s=self.config.replica_max_cooldown_s,
             )
+            self._replicas.append(
+                _Replica(i, exe, rep_vars, compiled, Channel(capacity=2), breaker)
+            )
+        self.metrics.set_healthy_replicas(n_rep)
 
         if self.config.lint_model:
             self._lint_model(variables)
@@ -402,23 +432,88 @@ class ServingEngine:
         slots = self.buckets.pad_rows(slots, bucket_b)
         self.metrics.record_batch(rows, bucket_b, group.sig)
         self.metrics.set_queue_depth(self._queue.qsize())
-        rep = self._replicas[self._rr % len(self._replicas)]
-        self._rr += 1
-        rep.channel.send((live, slots, bucket_b))
+        self._send_to_replica(live, slots, bucket_b, attempt=0)
+
+    def _pick_replica(self, exclude: Optional[_Replica] = None) -> Optional[_Replica]:
+        """Next replica in round-robin order whose breaker admits a batch.
+        When EVERY live breaker is open mid-cooldown, degrade: force a
+        half-open probe on the one closest to its retry time — serving at
+        reduced health beats failing all traffic. None = no live replicas."""
+        with self._pick_lock:
+            alive = [r for r in self._replicas if not r.dead and r is not exclude]
+            if not alive:
+                return None
+            n = len(self._replicas)
+            for k in range(n):
+                rep = self._replicas[(self._rr + k) % n]
+                if rep.dead or rep is exclude:
+                    continue
+                if rep.breaker.allow():
+                    self._rr = (self._rr + k + 1) % n
+                    return rep
+            rep = min(alive, key=lambda r: r.breaker.retry_in())
+            rep.breaker.force_allow()
+            return rep
+
+    def _send_to_replica(self, live, slots, bucket_b: int, attempt: int) -> None:
+        """Route one padded batch to a healthy replica; a replica dying
+        between pick and send is retried against the others. With no live
+        replica left, the callers fail instead of hanging."""
+        exclude = None
+        for _ in range(len(self._replicas)):
+            rep = self._pick_replica(exclude=exclude)
+            if rep is None:
+                break
+            try:
+                rep.channel.send((live, slots, bucket_b, attempt))
+                return
+            except ChannelClosedError:
+                exclude = rep  # died between pick and send
+        self._fail_requests(live, ReplicaDied("no healthy replicas available"))
+
+    def _fail_requests(self, live, exc: BaseException) -> None:
+        self.metrics.record_error(len(live))
+        for req in live:
+            req.pending._fail(exc)
 
     # -- execution (replica worker threads) --------------------------------
 
     def _worker(self, rep: _Replica) -> None:
-        for live, slots, bucket_b in rep.channel:
+        """Replica thread wrapper: ANY exit of the loop itself — including
+        BaseException (KeyboardInterrupt, MemoryError, a bug in the loop) —
+        marks the replica dead and fails everything queued on its channel,
+        so no caller ever hangs on a worker that silently died."""
+        try:
+            self._worker_loop(rep)
+        except BaseException as e:
+            self._replica_died(rep, e)
+
+    def _worker_loop(self, rep: _Replica) -> None:
+        for live, slots, bucket_b, attempt in rep.channel:
             try:
+                # fault point: a seeded "error" here exercises the breaker
+                # exactly like a real device failure would
+                faults.inject(faults.SERVING_DISPATCH, replica=rep.index)
                 with prof.record_event(f"serving.batch:replica{rep.index}"):
                     out = rep.compiled(rep.variables, *slots)
                     out = jax.device_get(out)
             except Exception as e:  # complete, never hang the callers
-                self.metrics.record_error(len(live))
-                for req in live:
-                    req.pending._fail(e)
+                self._batch_failed(rep, live, slots, bucket_b, attempt, e)
                 continue
+            except BaseException as e:
+                # the worker is about to die (KeyboardInterrupt, MemoryError,
+                # SystemExit): the in-flight batch must fail, not hang
+                self._fail_requests(
+                    live, ReplicaDied(f"replica {rep.index} worker died: {e!r}")
+                )
+                raise
+            if rep.breaker.record_success():
+                self.metrics.record_replica_recovery()
+                ptlog.vlog(
+                    0, "serving replica %d recovered (half-open probe ok)",
+                    rep.index,
+                )
+                self.metrics.set_healthy_replicas(self._count_healthy())
             offset = 0
             now = time.monotonic()
             for req in live:
@@ -427,6 +522,63 @@ class ServingEngine:
                 )
                 self.metrics.record_response(now - req.t_submit)
                 offset += req.n
+
+    def _batch_failed(
+        self, rep: _Replica, live, slots, bucket_b: int, attempt: int,
+        exc: Exception,
+    ) -> None:
+        """One batch failed on ``rep``: charge its breaker and give the
+        batch ONE redispatch to a different healthy replica (a sick device
+        must not fail callers a healthy one could serve) before failing the
+        callers for real."""
+        if rep.breaker.record_failure():
+            self.metrics.record_replica_ejection()
+            ptlog.error(
+                "serving replica %d ejected after %d consecutive failures "
+                "(retry in %.2fs): %s",
+                rep.index, rep.breaker.consecutive_failures,
+                rep.breaker.retry_in(), exc,
+            )
+            self.metrics.set_healthy_replicas(self._count_healthy())
+        if attempt == 0:
+            target = self._pick_replica(exclude=rep)
+            if target is not None:
+                try:
+                    target.channel.send((live, slots, bucket_b, 1), timeout=5.0)
+                    self.metrics.record_redispatch()
+                    return
+                except (ChannelClosedError, TimeoutError):
+                    pass  # target gone/wedged: fall through to failing
+        self._fail_requests(live, exc)
+
+    def _replica_died(self, rep: _Replica, exc: BaseException) -> None:
+        """Permanently remove a replica whose worker thread is gone; every
+        batch still queued on its channel fails (or redispatches via the
+        batcher's next pick — they are failed here to stay bounded)."""
+        rep.dead = True
+        self.metrics.record_replica_death()
+        self.metrics.set_healthy_replicas(self._count_healthy())
+        ptlog.error("serving replica %d worker died: %r", rep.index, exc)
+        rep.channel.close()
+        while True:  # drain: nothing queued may hang its caller (a closed
+            item, ok = rep.channel.recv()  # channel's recv never blocks)
+            if not ok:
+                break
+            self._fail_requests(
+                item[0], ReplicaDied(f"replica {rep.index} worker died: {exc!r}")
+            )
+
+    def _count_healthy(self) -> int:
+        return sum(
+            1 for r in self._replicas if not r.dead and r.breaker.state == "closed"
+        )
+
+    def replica_health(self) -> List[dict]:
+        """Per-replica health readout: breaker state + lifetime counters."""
+        return [
+            dict(index=r.index, dead=r.dead, **r.breaker.snapshot())
+            for r in self._replicas
+        ]
 
     @staticmethod
     def _slice_out(out, bucket_b: int, offset: int, n: int):
@@ -441,21 +593,34 @@ class ServingEngine:
 
     # -- shutdown ----------------------------------------------------------
 
-    def close(self, timeout: Optional[float] = None) -> None:
+    def close(self, timeout: Optional[float] = None) -> List[str]:
         """Graceful drain: stop intake, flush every accepted request through
-        the device, then stop all threads. Idempotent."""
+        the device, then stop all threads. Idempotent. Returns the names of
+        threads that did NOT join within ``timeout`` (empty list = clean
+        shutdown) — a wedged worker must be reported, not silently leaked."""
         with self._close_lock:
             if self._closed:
-                return
+                return []
             self._closed = True
+        unjoined: List[str] = []
         self._queue.close()  # batcher drains the buffer, flushes, exits
         self._batcher_thread.join(timeout)
+        if self._batcher_thread.is_alive():
+            unjoined.append(self._batcher_thread.name)
         for rep in self._replicas:
             rep.channel.close()
         for rep in self._replicas:
             if rep.thread is not None:
                 rep.thread.join(timeout)
+                if rep.thread.is_alive():
+                    unjoined.append(rep.thread.name)
+        if unjoined:
+            ptlog.error(
+                "ServingEngine.close: %d thread(s) failed to join within %s: %s",
+                len(unjoined), timeout, ", ".join(unjoined),
+            )
         self.metrics.set_queue_depth(0)
+        return unjoined
 
     @property
     def closed(self) -> bool:

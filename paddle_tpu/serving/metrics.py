@@ -42,6 +42,11 @@ class ServingMetrics:
         self.padded_batches_total = 0  # batches where bucket_b > rows
         self.warmup_executables = 0
         self.dispatch_shapes: set = set()  # distinct (sig, bucket_b) sent
+        # replica health (circuit breaker / worker-death accounting)
+        self.replica_ejections_total = 0   # breaker trips
+        self.replica_recoveries_total = 0  # half-open probes that re-admitted
+        self.replica_deaths_total = 0      # worker threads that exited
+        self.redispatches_total = 0        # failed batches retried elsewhere
         self._latencies = collections.deque(maxlen=latency_window)
 
     # -- recorders (called from engine/batcher/worker threads) -------------
@@ -88,6 +93,29 @@ class ServingMetrics:
     def set_queue_depth(self, depth: int) -> None:
         prof.set_gauge("serving.queue_depth", depth)
 
+    def record_replica_ejection(self) -> None:
+        with self._lock:
+            self.replica_ejections_total += 1
+        prof.inc_counter("serving.replica_ejections_total")
+
+    def record_replica_recovery(self) -> None:
+        with self._lock:
+            self.replica_recoveries_total += 1
+        prof.inc_counter("serving.replica_recoveries_total")
+
+    def record_replica_death(self) -> None:
+        with self._lock:
+            self.replica_deaths_total += 1
+        prof.inc_counter("serving.replica_deaths_total")
+
+    def record_redispatch(self) -> None:
+        with self._lock:
+            self.redispatches_total += 1
+        prof.inc_counter("serving.redispatches_total")
+
+    def set_healthy_replicas(self, n: int) -> None:
+        prof.set_gauge("serving.healthy_replicas", n)
+
     # -- readout -----------------------------------------------------------
 
     def mean_batch_occupancy(self) -> float:
@@ -120,6 +148,10 @@ class ServingMetrics:
                 "padded_batches_total": self.padded_batches_total,
                 "warmup_executables": self.warmup_executables,
                 "distinct_dispatch_shapes": len(self.dispatch_shapes),
+                "replica_ejections_total": self.replica_ejections_total,
+                "replica_recoveries_total": self.replica_recoveries_total,
+                "replica_deaths_total": self.replica_deaths_total,
+                "redispatches_total": self.redispatches_total,
                 "mean_batch_occupancy": (
                     self.rows_total / self.batches_total
                     if self.batches_total

@@ -27,10 +27,13 @@ import numpy as np
 from paddle_tpu import checkpoint as ckpt_mod
 from paddle_tpu.checkpoint import CheckpointConfig
 from paddle_tpu.core import logging as ptlog
+from paddle_tpu.core import profiler as prof
 from paddle_tpu.core.enforce import EnforceError, enforce
 from paddle_tpu.executor import Executor
 from paddle_tpu.framework import Model, Variables
 from paddle_tpu.optimizer import Optimizer, OptState, StepOutput
+from paddle_tpu.resilience import ResilienceConfig, faults
+from paddle_tpu.resilience.watchdog import StepWatchdog
 
 __all__ = [
     "Trainer",
@@ -39,6 +42,7 @@ __all__ = [
     "BeginStepEvent",
     "EndStepEvent",
     "CheckpointConfig",
+    "ResilienceConfig",
 ]
 
 
@@ -85,6 +89,7 @@ class Trainer:
         rng: int | jax.Array | None = 0,
         parallel_kwargs: Optional[dict] = None,
         prefetch: bool = False,
+        resilience: Optional[ResilienceConfig] = None,
     ):
         from paddle_tpu.framework import build
 
@@ -114,6 +119,14 @@ class Trainer:
         # True after train() returned early because of a signal.
         self.preempted = False
         self._preempt_requested = False
+        # self-healing policy (default from flags: PADDLE_TPU_CHECK_NAN_INF_POLICY
+        # etc.; the flags default is "raise", the pre-resilience behavior)
+        self.resilience = resilience if resilience is not None else ResilienceConfig.from_flags()
+        self.bad_steps = 0  # non-finite steps whose update was dropped
+        self.rollbacks = 0  # checkpoint restores triggered by the nan policy
+        self._consec_bad = 0
+        self._rollbacks_since_good = 0
+        self._watchdog: Optional[StepWatchdog] = None
 
     # -- init / resume ------------------------------------------------------
     def _ensure_initialized(self, first_batch: Sequence[Any]):
@@ -203,6 +216,9 @@ class Trainer:
             enforce(first is not None, "reader yielded no batches")
             self._ensure_initialized(first)
         prev_handlers = self._install_preemption_handlers()
+        res = self.resilience
+        if res is not None and res.stall_timeout_s is not None and self._watchdog is None:
+            self._watchdog = StepWatchdog(res.stall_timeout_s)
         try:
             for epoch_id in range(self.epoch, num_epochs):
                 self.epoch = epoch_id
@@ -210,17 +226,32 @@ class Trainer:
                 for step_id, batch in enumerate(self._batches(reader)):
                     begin_ev = BeginStepEvent(epoch_id, step_id)
                     handler(begin_ev)
-                    out = self._run_step(batch)
-                    if out.finite is not None and not bool(out.finite):
-                        raise EnforceError(
-                            f"NaN/Inf in loss or gradients at epoch {epoch_id} "
-                            f"step {step_id} (check_nan_inf)"
-                        )
-                    self.variables, self.opt_state = out.variables, out.opt_state
-                    self.global_step += 1
-                    # honoring fetch_metrics avoids a host sync per step
-                    # (reference BeginStepEvent.fetch_metrics, trainer.py:158)
-                    metrics = float(out.loss) if begin_ev.fetch_metrics else None
+                    # fault point: "error" raises here (a crashing step),
+                    # "nan" forces this step to count as non-finite,
+                    # "preempt" delivers SIGTERM (handled at the boundary below)
+                    spec = faults.inject(
+                        faults.TRAINER_STEP, epoch=epoch_id, step=step_id
+                    )
+                    if self._watchdog is not None:
+                        with self._watchdog.watch(f"epoch {epoch_id} step {step_id}"):
+                            out = self._run_step(batch)
+                    else:
+                        out = self._run_step(batch)
+                    bad = (out.finite is not None and not bool(out.finite)) or (
+                        spec is not None and spec.kind == "nan"
+                    )
+                    if bad:
+                        # may raise (policy "raise", or rollback gave up)
+                        self._handle_bad_step(epoch_id, step_id)
+                        metrics = float("nan") if begin_ev.fetch_metrics else None
+                    else:
+                        self._consec_bad = 0
+                        self._rollbacks_since_good = 0
+                        self.variables, self.opt_state = out.variables, out.opt_state
+                        self.global_step += 1
+                        # honoring fetch_metrics avoids a host sync per step
+                        # (reference BeginStepEvent.fetch_metrics, trainer.py:158)
+                        metrics = float(out.loss) if begin_ev.fetch_metrics else None
                     handler(EndStepEvent(epoch_id, step_id, metrics))
                     if self._preempt_requested:
                         self._preemption_save(next_epoch=epoch_id)
@@ -234,6 +265,9 @@ class Trainer:
                     return
         finally:
             self._restore_signal_handlers(prev_handlers)
+            if self._watchdog is not None:
+                self._watchdog.close()
+                self._watchdog = None
             if self.checkpoint_cfg is not None and getattr(self.checkpoint_cfg, "async_save", False):
                 from paddle_tpu import checkpoint_sharded as cks
 
@@ -248,6 +282,76 @@ class Trainer:
                     # the loop is already unwinding with its own exception —
                     # log the writer failure instead of masking the cause
                     ptlog.error("async checkpoint writer failed during train() exit: %s", e)
+
+    # -- self-healing (resilience.ResilienceConfig) -------------------------
+    def _handle_bad_step(self, epoch_id: int, step_id: int) -> None:
+        """A non-finite step (in-step check_nan_inf, or an injected "nan"
+        fault). Policy "raise" keeps the pre-resilience fatal behavior;
+        "skip_step" drops the update and continues; "rollback" additionally
+        restores the last good checkpoint after ``rollback_after``
+        CONSECUTIVE bad steps — and gives up (raises) after
+        ``max_rollbacks`` restores with no good step in between."""
+        res = self.resilience
+        msg = (
+            f"NaN/Inf in loss or gradients at epoch {epoch_id} "
+            f"step {step_id} (check_nan_inf)"
+        )
+        if res is None or res.nan_policy == "raise":
+            raise EnforceError(msg)
+        self.bad_steps += 1
+        self._consec_bad += 1
+        prof.inc_counter("resilience.bad_steps")
+        ptlog.warning(
+            "%s — policy %r: update dropped (%d consecutive bad)",
+            msg, res.nan_policy, self._consec_bad,
+        )
+        if res.nan_policy == "skip_step" or self._consec_bad < res.rollback_after:
+            return
+        # rollback due
+        enforce(
+            self.checkpoint_cfg is not None,
+            f"nan_policy='rollback' needs a checkpoint_config to restore "
+            f"from ({msg})",
+        )
+        enforce(
+            self._rollbacks_since_good < res.max_rollbacks,
+            f"giving up after {self._rollbacks_since_good} rollbacks without "
+            f"a good step in between ({msg})",
+        )
+        self._rollback()
+
+    def _rollback(self) -> None:
+        """Restore params + optimizer state from the last good checkpoint
+        (corrupt serials already fall back inside load_*)."""
+        cfg = self.checkpoint_cfg
+        root = cfg.checkpoint_dir
+        tree = (self.variables, self.opt_state)
+        if cfg.use_sharded():
+            from paddle_tpu import checkpoint_sharded as cks
+
+            cks.wait_pending_save()
+            enforce(
+                cks.latest_sharded_checkpoint(root) is not None,
+                f"rollback: no checkpoint under {root} to restore",
+            )
+            tree, meta = cks.load_sharded(root, tree)
+        else:
+            enforce(
+                ckpt_mod.latest_checkpoint(root) is not None,
+                f"rollback: no checkpoint under {root} to restore",
+            )
+            tree, meta = ckpt_mod.load_checkpoint(root, tree, self.trainer_id)
+        self.variables, self.opt_state = tree
+        self.global_step = int(meta.get("step", self.global_step))
+        self._last_saved_step = self.global_step
+        self.rollbacks += 1
+        self._rollbacks_since_good += 1
+        self._consec_bad = 0
+        prof.inc_counter("resilience.rollbacks")
+        ptlog.error(
+            "rolled back to checkpoint step %d (rollback %d this run)",
+            self.global_step, self.rollbacks,
+        )
 
     # -- preemption (SURVEY §5.3 failure detection / recovery) --------------
     def _install_preemption_handlers(self):
@@ -310,6 +414,13 @@ class Trainer:
         run on a producer thread ``prefetch_depth`` batches ahead, already
         placed with the step's input shardings, so the step never waits on
         host->device copies."""
+        for batch in self._raw_batches(reader):
+            # fault point: reader-side IO errors / stalls surface here, on
+            # the consuming thread (a prefetcher producer re-raises anyway)
+            faults.inject(faults.READER_NEXT, epoch=self.epoch, step=self.global_step)
+            yield batch
+
+    def _raw_batches(self, reader):
         it = iter(reader())
         if not self.prefetch:
             yield from it

@@ -1,0 +1,511 @@
+"""paddle_tpu.resilience — fault injection, self-healing training, and
+checkpoint integrity.
+
+Acceptance contract (ISSUE 3): with faults injected — a corrupt latest
+serial, NaN steps, one persistently failing replica (covered in
+test_serving.py) — training completes via checkpoint fallback and
+skip/rollback policies, and every recovery path here runs deterministically
+under tier-1 instead of being hoped correct.
+"""
+
+import glob
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import checkpoint as ckpt_mod
+from paddle_tpu import checkpoint_sharded as cks
+from paddle_tpu.core.enforce import EnforceError
+from paddle_tpu.core.retry import backoff_delays, next_backoff, retry_call
+from paddle_tpu.resilience import ResilienceConfig, faults
+from paddle_tpu.resilience.circuit import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
+from paddle_tpu.resilience.integrity import CheckpointCorruptError
+from paddle_tpu.resilience.watchdog import StepWatchdog
+from paddle_tpu.trainer import CheckpointConfig, EndStepEvent, Trainer
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    yield
+    faults.clear()
+
+
+def _linreg_model():
+    def net(x, y):
+        pred = pt.layers.fc(x, size=1)
+        return pt.layers.mean((pred - y) ** 2)
+
+    return net
+
+
+def _reader(n_batches=6, bs=8, seed=0):
+    def reader():
+        rng = np.random.RandomState(seed)
+        w = np.array([[2.0], [-1.0], [0.5], [3.0]], np.float32)
+        for _ in range(n_batches):
+            x = rng.randn(bs, 4).astype(np.float32)
+            yield x, x @ w + 0.1
+
+    return reader
+
+
+# ---- core/retry -----------------------------------------------------------
+
+
+def test_backoff_schedule_monotone_and_capped():
+    delays = list(backoff_delays(8, base_delay=0.1, max_delay=1.0, jitter=0.0))
+    assert delays[0] == pytest.approx(0.1)
+    assert delays == sorted(delays)
+    assert max(delays) == pytest.approx(1.0)
+    # jitter stretches but never shrinks below the deterministic base
+    import random
+
+    rng = random.Random(7)
+    for attempt in range(6):
+        base = next_backoff(attempt, base_delay=0.1, max_delay=1.0, jitter=0.0)
+        j = next_backoff(attempt, base_delay=0.1, max_delay=1.0, jitter=0.5, rng=rng)
+        assert base <= j <= base * 1.5
+
+
+def test_retry_call_recovers_and_exhausts():
+    calls = {"n": 0}
+    slept = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry_call(flaky, retries=3, sleep=slept.append) == "ok"
+    assert calls["n"] == 3 and len(slept) == 2
+
+    def always():
+        raise OSError("permanent")
+
+    with pytest.raises(OSError, match="permanent"):
+        retry_call(always, retries=2, sleep=lambda s: None)
+    # non-retryable exception types pass straight through on attempt 1
+    calls["n"] = 0
+
+    def wrong_type():
+        calls["n"] += 1
+        raise ValueError("nope")
+
+    with pytest.raises(ValueError):
+        retry_call(wrong_type, retries=3, sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+# ---- resilience.faults ----------------------------------------------------
+
+
+def test_fault_window_and_restore():
+    spec = faults.FaultSpec("p", "error", after=1, times=2)
+    with faults.injected(spec) as plan:
+        assert faults.inject("p") is None  # hit 0: before the window
+        with pytest.raises(OSError, match="injected fault at p"):
+            faults.inject("p")  # hit 1
+        with pytest.raises(OSError):
+            faults.inject("p")  # hit 2
+        assert faults.inject("p") is None  # window exhausted
+        assert plan.stats() == {"p": 2} and plan.all_fired()
+    assert faults.active_plan() is None  # restored
+    assert faults.inject("p") is None  # no plan: pure no-op
+
+
+def test_fault_context_match_and_kinds():
+    with faults.injected(
+        faults.FaultSpec("q", "nan", match={"replica": 1}, times=1),
+        faults.FaultSpec("q", "stall", stall_s=0.01, match={"replica": 2}),
+    ):
+        assert faults.inject("q", replica=0) is None  # no match
+        spec = faults.inject("q", replica=1)
+        assert spec is not None and spec.kind == "nan"
+        t0 = time.monotonic()
+        spec = faults.inject("q", replica=2)
+        assert spec.kind == "stall" and time.monotonic() - t0 >= 0.01
+
+
+def test_fault_probability_seeded_deterministic():
+    def run(seed):
+        with faults.injected(
+            faults.FaultSpec("r", "nan", p=0.5, times=1000), seed=seed
+        ) as plan:
+            fired = [faults.inject("r") is not None for _ in range(64)]
+        return fired, plan.stats()["r"]
+
+    a, na = run(3)
+    b, nb = run(3)
+    assert a == b and na == nb  # same seed → identical schedule
+    assert 0 < na < 64
+
+
+# ---- resilience.circuit ---------------------------------------------------
+
+
+def test_circuit_breaker_state_machine_fake_clock():
+    now = [0.0]
+    br = CircuitBreaker(
+        failure_threshold=2, cooldown_s=1.0, max_cooldown_s=8.0,
+        jitter=0.0, clock=lambda: now[0],
+    )
+    assert br.state == CLOSED and br.allow()
+    assert not br.record_failure()
+    assert br.record_failure()  # second consecutive → trips
+    assert br.state == OPEN and not br.allow() and br.trips_total == 1
+    assert br.retry_in() == pytest.approx(1.0)
+
+    now[0] = 1.1
+    assert br.allow()  # cooldown elapsed: this call takes the probe token
+    assert br.state == HALF_OPEN
+    assert not br.allow()  # only ONE probe in flight
+    assert br.record_failure()  # probe failed → re-open, longer cooldown
+    assert br.state == OPEN and br.retry_in() == pytest.approx(2.0)
+
+    now[0] = 3.2
+    assert br.allow()
+    assert br.record_success()  # probe succeeded → recovered
+    assert br.state == CLOSED and br.recoveries_total == 1
+    # recovery reset the backoff: next trip starts at the base cooldown
+    br.record_failure()
+    br.record_failure()
+    assert br.retry_in() == pytest.approx(1.0)
+
+
+def test_circuit_breaker_force_allow_degraded_mode():
+    br = CircuitBreaker(failure_threshold=1, cooldown_s=60.0, jitter=0.0)
+    br.record_failure()
+    assert br.state == OPEN and not br.allow()
+    br.force_allow()  # every target open: probe NOW instead of failing all
+    assert br.state == HALF_OPEN
+    assert br.record_success()
+
+
+# ---- resilience.watchdog --------------------------------------------------
+
+
+def test_step_watchdog_dumps_on_stall_only():
+    stalls = []
+    wd = StepWatchdog(timeout_s=0.1, on_stall=lambda tag, el: stalls.append(tag))
+    try:
+        with wd.watch("fast"):
+            pass
+        time.sleep(0.25)
+        assert wd.stalls == 0 and stalls == []  # disarmed regions never fire
+        with wd.watch("slow step"):
+            time.sleep(0.4)
+        assert wd.stalls == 1 and stalls == ["slow step"]
+        with wd.watch("slow2"):
+            time.sleep(0.4)
+        assert wd.stalls == 2  # one dump per stalled region
+    finally:
+        wd.close()
+
+
+# ---- checkpoint integrity -------------------------------------------------
+
+
+def _save_serials(root, n=3):
+    tree = {"w": np.arange(6, dtype=np.float32), "b": np.float32(1.0)}
+    for step in range(n):
+        tree["w"] = tree["w"] + 1
+        ckpt_mod.save_checkpoint(root, tree, step=step, max_num_checkpoints=10)
+    return tree
+
+
+def test_checkpoint_crc_fallback_and_quarantine(tmp_path):
+    root = str(tmp_path / "ckpt")
+    tree = _save_serials(root, n=3)
+    latest = ckpt_mod.latest_checkpoint(root)
+    npz = glob.glob(os.path.join(latest, "*.npz"))[0]
+    with open(npz, "r+b") as f:  # flip bytes mid-file: CRC must catch it
+        f.seek(os.path.getsize(npz) // 2)
+        f.write(b"\xde\xad\xbe\xef")
+
+    loaded, meta = ckpt_mod.load_checkpoint(root, tree)
+    assert meta["step"] == 1  # fell back to the previous good serial
+    np.testing.assert_allclose(np.asarray(loaded["w"]), np.arange(6) + 2)
+    # the corrupt serial was quarantined, not deleted (post-mortem evidence)
+    assert any(".corrupt" in d for d in os.listdir(root))
+    # quarantined dirs are invisible to serial scans
+    assert ckpt_mod.latest_checkpoint(root).endswith("checkpoint_1")
+
+
+def test_checkpoint_truncated_npz_detected(tmp_path):
+    root = str(tmp_path / "ckpt")
+    tree = _save_serials(root, n=2)
+    latest = ckpt_mod.latest_checkpoint(root)
+    npz = glob.glob(os.path.join(latest, "*.npz"))[0]
+    with open(npz, "r+b") as f:
+        f.truncate(os.path.getsize(npz) // 2)
+    loaded, meta = ckpt_mod.load_checkpoint(root, tree)
+    assert meta["step"] == 0
+
+
+def test_checkpoint_all_corrupt_raises(tmp_path):
+    root = str(tmp_path / "ckpt")
+    tree = _save_serials(root, n=2)
+    for npz in glob.glob(os.path.join(root, "checkpoint_*", "*.npz")):
+        with open(npz, "wb") as f:
+            f.write(b"garbage")
+    with pytest.raises(EnforceError, match="all candidates corrupt"):
+        ckpt_mod.load_checkpoint(root, tree)
+
+
+def test_checkpoint_save_retries_injected_io_error(tmp_path):
+    root = str(tmp_path / "ckpt")
+    tree = {"w": np.ones(4, np.float32)}
+    with faults.injected(
+        faults.FaultSpec(faults.CHECKPOINT_SAVE, "error", times=1)
+    ) as plan:
+        path = ckpt_mod.save_checkpoint(root, tree, step=0)
+    assert plan.stats()[faults.CHECKPOINT_SAVE] == 1  # it DID fail once
+    loaded, meta = ckpt_mod.load_checkpoint(path, tree)  # and published anyway
+    np.testing.assert_allclose(np.asarray(loaded["w"]), 1.0)
+
+
+def test_sharded_checkpoint_crc_fallback(tmp_path):
+    root = str(tmp_path / "sharded")
+    tree = {"w": np.arange(8, dtype=np.float32)}
+    cks.save_sharded(root, tree, step=1, max_num_checkpoints=10)
+    tree2 = {"w": np.arange(8, dtype=np.float32) * 2}
+    cks.save_sharded(root, tree2, step=2, max_num_checkpoints=10)
+
+    latest = cks.latest_sharded_checkpoint(root)
+    npz = glob.glob(os.path.join(latest, "shards_p*.npz"))[0]
+    with open(npz, "r+b") as f:
+        f.seek(os.path.getsize(npz) // 2)
+        f.write(b"\xde\xad\xbe\xef")
+
+    loaded, manifest = cks.load_sharded(root, tree)
+    assert manifest["step"] == 1  # previous good step
+    np.testing.assert_allclose(np.asarray(loaded["w"]), np.arange(8))
+    assert any(".corrupt" in d for d in os.listdir(root))
+
+
+def test_sharded_checkpoint_explicit_corrupt_path_raises(tmp_path):
+    root = str(tmp_path / "sharded")
+    tree = {"w": np.ones(4, np.float32)}
+    path = cks.save_sharded(root, tree, step=1)
+    npz = glob.glob(os.path.join(path, "shards_p*.npz"))[0]
+    with open(npz, "wb") as f:
+        f.write(b"garbage")
+    with pytest.raises(EnforceError, match="all candidates corrupt"):
+        cks.load_sharded(path, tree)
+
+
+def test_integrity_verify_crc_roundtrip(tmp_path):
+    from paddle_tpu.resilience import integrity
+
+    p = str(tmp_path / "blob.bin")
+    with open(p, "wb") as f:
+        f.write(b"x" * 100_000)
+    crc = integrity.crc32_file(p)
+    integrity.verify_crc(p, crc, what="blob")  # no raise
+    with pytest.raises(CheckpointCorruptError, match="crc32 mismatch"):
+        integrity.verify_crc(p, crc ^ 1, what="blob")
+    q = integrity.quarantine(p)
+    assert q.endswith(".corrupt") and not os.path.exists(p)
+
+
+# ---- self-healing trainer -------------------------------------------------
+
+
+def test_trainer_skip_step_policy_drops_bad_updates():
+    metrics = []
+    trainer = Trainer(
+        _linreg_model, lambda: pt.optimizer.SGD(learning_rate=0.1),
+        resilience=ResilienceConfig(nan_policy="skip_step"),
+    )
+    with faults.injected(
+        faults.FaultSpec(faults.TRAINER_STEP, "nan", after=2, times=2)
+    ):
+        trainer.train(
+            num_epochs=1, reader=_reader(n_batches=6),
+            event_handler=lambda ev: metrics.append(ev.metrics)
+            if isinstance(ev, EndStepEvent) else None,
+        )
+    assert trainer.bad_steps == 2
+    assert trainer.global_step == 4  # bad steps never advanced the counter
+    # the two bad steps surfaced as NaN metrics; the rest stayed finite
+    assert sum(1 for m in metrics if not np.isfinite(m)) == 2
+    assert all(np.isfinite(np.asarray(trainer.variables.params["fc/w"])))
+
+
+def test_trainer_default_policy_still_raises():
+    trainer = Trainer(_linreg_model, lambda: pt.optimizer.SGD(learning_rate=0.1))
+    with faults.injected(faults.FaultSpec(faults.TRAINER_STEP, "nan")):
+        with pytest.raises(EnforceError, match="check_nan_inf"):
+            trainer.train(num_epochs=1, reader=_reader())
+
+
+def test_trainer_rollback_restores_last_good_checkpoint(tmp_path):
+    root = str(tmp_path / "ckpt")
+    trainer = Trainer(
+        _linreg_model, lambda: pt.optimizer.SGD(learning_rate=0.1),
+        checkpoint_config=CheckpointConfig(root, step_interval=1,
+                                           max_num_checkpoints=8),
+        resilience=ResilienceConfig(nan_policy="rollback", rollback_after=2,
+                                    max_rollbacks=2),
+    )
+    with faults.injected(
+        # steps 2+3 go bad → rollback_after=2 restores the step-2 checkpoint
+        faults.FaultSpec(faults.TRAINER_STEP, "nan", after=2, times=2)
+    ):
+        trainer.train(num_epochs=1, reader=_reader(n_batches=6))
+    assert trainer.bad_steps == 2
+    assert trainer.rollbacks == 1
+    assert trainer.global_step == 4  # 2 good + rollback to 2 + 2 more good
+    assert all(np.isfinite(np.asarray(trainer.variables.params["fc/w"])))
+
+
+def test_trainer_rollback_gives_up_after_max_rollbacks(tmp_path):
+    root = str(tmp_path / "ckpt")
+    trainer = Trainer(
+        _linreg_model, lambda: pt.optimizer.SGD(learning_rate=0.1),
+        checkpoint_config=CheckpointConfig(root, step_interval=1),
+        resilience=ResilienceConfig(nan_policy="rollback", rollback_after=1,
+                                    max_rollbacks=1),
+    )
+    with faults.injected(
+        # EVERY step after the first goes bad: restore once, then give up
+        faults.FaultSpec(faults.TRAINER_STEP, "nan", after=1, times=1000)
+    ):
+        with pytest.raises(EnforceError, match="giving up"):
+            trainer.train(num_epochs=1, reader=_reader(n_batches=6))
+    assert trainer.rollbacks == 1
+
+
+def test_trainer_step_watchdog_flags_stall():
+    trainer = Trainer(
+        _linreg_model, lambda: pt.optimizer.SGD(learning_rate=0.1),
+        resilience=ResilienceConfig(stall_timeout_s=0.05),
+    )
+    with faults.injected(
+        faults.FaultSpec(faults.TRAINER_STEP, "stall", after=1, times=1,
+                         stall_s=0.4)
+    ):
+        trainer.train(num_epochs=1, reader=_reader(n_batches=3))
+    # close() ran in train()'s finally; the stall was counted before that
+    assert trainer._watchdog is None
+
+
+def test_resilience_config_validation_and_flags():
+    with pytest.raises(EnforceError):
+        ResilienceConfig(nan_policy="explode")
+    with pytest.raises(EnforceError):
+        ResilienceConfig(rollback_after=0)
+    from paddle_tpu.core.config import set_flags
+
+    set_flags(check_nan_inf_policy="skip_step", nan_rollback_after=5)
+    try:
+        res = ResilienceConfig.from_flags()
+        assert res.nan_policy == "skip_step" and res.rollback_after == 5
+    finally:
+        set_flags(check_nan_inf_policy="raise", nan_rollback_after=3)
+
+
+# ---- preemption round-trip under fault injection (ISSUE 3 satellite) ------
+
+
+def test_preemption_save_resume_with_flaky_checkpoint_io(tmp_path):
+    """SIGTERM mid-epoch + the emergency checkpoint write failing ONCE:
+    the save retries, the trainer exits preempted, and a fresh trainer
+    resumes at the exact step with identical params."""
+    root = str(tmp_path / "ckpt")
+    trainer = Trainer(
+        _linreg_model, lambda: pt.optimizer.SGD(learning_rate=0.1),
+        # huge step_interval: the ONLY save is the preemption save
+        checkpoint_config=CheckpointConfig(root, step_interval=10_000),
+    )
+    with faults.injected(
+        # the real signal, delivered mid-epoch at step 2...
+        faults.FaultSpec(faults.TRAINER_STEP, "preempt", after=2, times=1),
+        # ...and the emergency save's first write attempt fails
+        faults.FaultSpec(faults.CHECKPOINT_SAVE, "error", times=1),
+    ) as plan:
+        trainer.train(num_epochs=2, reader=_reader(n_batches=6))
+        assert plan.all_fired(), plan.stats()
+    assert trainer.preempted
+    assert 0 < trainer.global_step < 12  # stopped mid-run
+    saved_step = trainer.global_step
+    saved_w = np.asarray(trainer.variables.params["fc/w"]).copy()
+
+    # the emergency save (published on retry) holds exactly the preempted state
+    loaded, meta = ckpt_mod.load_checkpoint(
+        root, (trainer.variables, trainer.opt_state))
+    assert meta["step"] == saved_step
+    np.testing.assert_array_equal(
+        saved_w, np.asarray(loaded[0].params["fc/w"]))
+
+    resumed = Trainer(
+        _linreg_model, lambda: pt.optimizer.SGD(learning_rate=0.1),
+        checkpoint_config=CheckpointConfig(root, step_interval=10_000),
+    )
+    steps = []
+    resumed.train(
+        num_epochs=2, reader=_reader(n_batches=6),
+        event_handler=lambda ev: steps.append(ev.step)
+        if isinstance(ev, EndStepEvent) else None,
+    )
+    assert not resumed.preempted
+    # resumed at the preempted step, then finished the remaining work
+    assert resumed.global_step == saved_step + len(steps)
+    # mid-epoch resume restarts the interrupted epoch (reference semantics),
+    # so both epochs run in full on top of the preempted state
+    assert len(steps) == 12
+
+
+# ---- multiprocess reader error attribution --------------------------------
+
+
+def test_multiprocess_reader_poison_pill_not_retryable():
+    from paddle_tpu.reader import ReaderWorkerError, multiprocess_reader
+
+    def poison():
+        yield (np.zeros(2),)
+        raise ValueError("bad sample 1")
+
+    r = multiprocess_reader([poison])
+    with pytest.raises(ReaderWorkerError) as ei:
+        list(r())
+    assert ei.value.retryable is False
+    assert isinstance(ei.value.pid, int) and ei.value.pid > 0
+    assert "ValueError: bad sample 1" in str(ei.value)
+
+
+def test_multiprocess_reader_hard_death_retryable():
+    from paddle_tpu.reader import ReaderWorkerError, multiprocess_reader
+
+    def crasher():
+        yield (np.zeros(2),)
+        os.kill(os.getpid(), signal.SIGKILL)  # simulated OOM kill
+        yield (np.zeros(2),)
+
+    r = multiprocess_reader([crasher])
+    with pytest.raises(ReaderWorkerError) as ei:
+        list(r())
+    assert ei.value.retryable is True
+    assert "died without finishing" in str(ei.value)
+
+
+# ---- the chaos gate itself ------------------------------------------------
+
+
+def test_chaos_smoke_tool_passes(tmp_path):
+    """tools/chaos_smoke.py is the CI gate next to lint_program --verify:
+    it must exit 0 against the current tree."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_smoke",
+        os.path.join(os.path.dirname(__file__), "..", "tools", "chaos_smoke.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["--dir", str(tmp_path / "chaos"), "--keep"]) == 0

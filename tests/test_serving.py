@@ -18,10 +18,12 @@ import pytest
 
 import paddle_tpu as pt
 from paddle_tpu.reader.feeder import FeedSpec
+from paddle_tpu.resilience import faults
 from paddle_tpu.serving import (
     DeadlineExceeded,
     EngineClosedError,
     MicroBatcher,
+    ReplicaDied,
     ServingConfig,
     ServingEngine,
     ShapeBuckets,
@@ -267,6 +269,92 @@ def test_serving_rejects_oversized_and_mismatched_requests(served):
         engine.submit({"x": np.zeros((9, D_IN), np.float32)})  # > max_batch
     with pytest.raises(pt.EnforceError):
         engine.submit({"x": np.zeros((1, D_IN + 1), np.float32)})  # bad dim
+
+
+# ---- resilience: circuit breaker and worker death ------------------------
+
+
+def _small_engine(seed, **cfg_kwargs):
+    rng = np.random.RandomState(seed)
+    model = pt.build(_net)
+    x0 = rng.randn(1, D_IN).astype(np.float32)
+    variables = model.init(0, x0)
+    engine = ServingEngine(
+        model, variables, [FeedSpec("x", (D_IN,), "float32")],
+        config=ServingConfig(
+            max_batch_size=4, max_queue_delay_s=0.001, num_replicas=2,
+            **cfg_kwargs,
+        ),
+    )
+    return engine, x0
+
+
+def test_serving_circuit_breaker_ejects_redispatches_recovers():
+    """One persistently failing replica (the ISSUE acceptance fault): the
+    breaker ejects it, its batches redispatch to the healthy replica so NO
+    caller fails, and the half-open probe re-admits it once it heals."""
+    engine, x0 = _small_engine(
+        4, replica_failure_threshold=2, replica_cooldown_s=0.05,
+        replica_max_cooldown_s=0.2,
+    )
+    try:
+        with faults.injected(
+            faults.FaultSpec(faults.SERVING_DISPATCH, "error",
+                             times=10_000, match={"replica": 0})
+        ):
+            for _ in range(12):
+                assert np.asarray(engine.infer({"x": x0})).shape == (1, 3)
+            snap = engine.metrics.snapshot()
+            assert snap["replica_ejections_total"] >= 1, snap
+            assert snap["redispatches_total"] >= 1, snap
+            assert snap["errors_total"] == 0, snap  # nobody saw the fault
+            assert any(
+                h["state"] != "closed" for h in engine.replica_health()
+            ), engine.replica_health()
+        # fault gone: traffic drives the half-open probe until re-admission
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            engine.infer({"x": x0})
+            if engine.metrics.replica_recoveries_total >= 1:
+                break
+            time.sleep(0.02)
+        assert engine.metrics.replica_recoveries_total >= 1
+        assert all(h["state"] == "closed" for h in engine.replica_health())
+    finally:
+        faults.clear()
+        unjoined = engine.close(timeout=30)
+    assert unjoined == []
+
+
+def test_serving_worker_death_fails_fast_and_survivor_serves():
+    """A replica worker dying with a BaseException (simulated runtime
+    abort) must fail its in-flight callers immediately — never hang them —
+    and the engine degrades to the surviving replica."""
+    engine, x0 = _small_engine(5)
+    try:
+
+        def bomb(*a, **k):
+            raise SystemExit("simulated runtime abort")
+
+        engine._replicas[0].compiled = bomb
+        died = ok = 0
+        for _ in range(10):
+            try:
+                assert np.asarray(engine.infer({"x": x0})).shape == (1, 3)
+                ok += 1
+            except ReplicaDied:
+                died += 1
+        assert died >= 1  # in-flight batch failed fast, no hang
+        assert ok >= 1  # the survivor kept serving throughout
+        assert engine.metrics.replica_deaths_total == 1
+        health = engine.replica_health()
+        assert health[0]["dead"] and not health[1]["dead"]
+        # the dead replica is out of rotation: everything routes around it
+        for _ in range(4):
+            assert np.asarray(engine.infer({"x": x0})).shape == (1, 3)
+    finally:
+        unjoined = engine.close(timeout=30)
+    assert unjoined == []
 
 
 # ---- unit level: buckets and batcher ------------------------------------
