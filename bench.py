@@ -43,34 +43,34 @@ LM_LARGE_KWARGS = dict(
 # fluid ResNet-50 run ~= 240-265 img/s/chip; midpoint used for self-grading.
 V100_TARGET_IMG_PER_SEC = 252.0
 
-# peak dense bf16 FLOP/s per chip, keyed by substring of device_kind
-_PEAK_BF16 = [
-    ("v6", 918e12),
-    ("v5p", 459e12),
-    ("v5", 197e12),  # v5e / "TPU v5 lite"
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-]
+_GOODPUT = None
+
+
+def _goodput_tracker():
+    """Process-wide goodput split: _bench_step charges measured train time
+    as good, failed sections as bad (lazy so --cpu children configure jax
+    before any paddle_tpu import)."""
+    global _GOODPUT
+    if _GOODPUT is None:
+        from paddle_tpu.observability.mfu import GoodputTracker
+
+        _GOODPUT = GoodputTracker()
+    return _GOODPUT
 
 
 def _peak_flops(device_kind: str):
-    kind = device_kind.lower()
-    for key, peak in _PEAK_BF16:
-        if key in kind:
-            return peak
-    return None
+    """Peak bf16 FLOP/s for a device kind — single-sourced from
+    observability.mfu (one table for bench, trainer MFU gauge, exporter)."""
+    from paddle_tpu.observability import mfu as obs_mfu
+
+    return obs_mfu.peak_flops_for_kind(device_kind)
 
 
 def _cost_flops(compiled) -> float:
     """Per-step model FLOPs from the compiled executable's cost analysis."""
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        return float(ca.get("flops", 0.0))
-    except Exception:
-        return 0.0
+    from paddle_tpu.observability.mfu import cost_flops
+
+    return cost_flops(compiled)
 
 
 def _mem_stats(compiled):
@@ -95,9 +95,25 @@ def _mem_stats(compiled):
 
 def _bench_step(spec, batch_size: int, warmup: int, iters: int, rng_seed: int = 0):
     """Compile + time one model's train step; returns
-    (sec/step, flops/step, mem_stats_dict_or_None)."""
+    (sec/step, flops/step, mem_stats_dict_or_None). Feeds the metric
+    registry (bench.* families) and the goodput tracker as it goes, so the
+    JSON telemetry fields come from the same source the exporter scrapes."""
+    t_begin = time.perf_counter()
+    try:
+        return _bench_step_inner(spec, batch_size, warmup, iters, rng_seed)
+    except Exception:
+        # the wall time burned by a failing section is badput, not silence
+        _goodput_tracker().record_bad(
+            time.perf_counter() - t_begin, "bench_failure")
+        raise
+
+
+def _bench_step_inner(spec, batch_size: int, warmup: int, iters: int,
+                      rng_seed: int = 0):
     import jax
     import numpy as np
+
+    from paddle_tpu.core import profiler as prof
 
     rng = np.random.RandomState(rng_seed)
     batch = spec.synth_batch(batch_size, rng)
@@ -109,7 +125,12 @@ def _bench_step(spec, batch_size: int, warmup: int, iters: int, rng_seed: int = 
     key = jax.random.PRNGKey(rng_seed)  # dropout etc. in train mode
 
     lowered = step.lower(variables, opt_state, *dev_batch, rng=key)
+    t_c = time.perf_counter()
     compiled = lowered.compile()
+    dt_c = time.perf_counter() - t_c
+    prof.inc_counter("bench.compiles_total")
+    prof.inc_counter("bench.compile_seconds_total", dt_c)
+    prof.observe("bench.compile_seconds", dt_c)
     flops = _cost_flops(compiled)
     mem = _mem_stats(compiled)
 
@@ -130,6 +151,10 @@ def _bench_step(spec, batch_size: int, warmup: int, iters: int, rng_seed: int = 
         v, o = out.variables, out.opt_state
     float(jax.device_get(out.loss))
     dt = (time.perf_counter() - t0) / iters
+    prof.inc_counter("bench.examples_total", batch_size * iters)
+    prof.inc_counter("bench.train_seconds_total", dt * iters)
+    prof.observe("bench.step_seconds", dt)
+    _goodput_tracker().record_good(dt * iters)
     return dt, flops, mem
 
 
@@ -170,9 +195,29 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
     if tiny:
         result["notes"].append("cpu_fallback_tiny_config")
 
+    def refresh_telemetry():
+        """Registry-sourced run accounting (same counters the Prometheus
+        exporter scrapes): aggregate examples/sec over every timed section,
+        total compile seconds, goodput split, and the best model MFU."""
+        from paddle_tpu.core import profiler as prof
+
+        c = prof.counters()
+        train_s = c.get("bench.train_seconds_total", 0.0)
+        if train_s > 0:
+            result["examples_per_sec"] = round(
+                c.get("bench.examples_total", 0.0) / train_s, 2)
+        result["compile_seconds"] = round(
+            c.get("bench.compile_seconds_total", 0.0), 3)
+        result["goodput_frac"] = round(_goodput_tracker().goodput_frac(), 4)
+        mfus = [v for k, v in result.items()
+                if k.endswith("_mfu") and isinstance(v, (int, float))]
+        if mfus:
+            result["mfu"] = max(mfus)
+
     def checkpoint_result():
         """Interim JSON after each section: if the wall-clock budget kills
         this child mid-run, the parent still salvages the newest line."""
+        refresh_telemetry()
         print(json.dumps(result), flush=True)
 
     # --- ResNet-50 (sweep bs; report the best stable throughput) ---
@@ -505,6 +550,7 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
     for k, val in list(result.items()):
         if k.endswith("_mfu") and isinstance(val, float) and val > 1.0:
             result["notes"].append(f"timing_suspect_{k}={val}")
+    refresh_telemetry()
     print(json.dumps(result))
 
 

@@ -73,6 +73,8 @@ from paddle_tpu import serving
 from paddle_tpu.serving import ServingConfig, ServingEngine
 from paddle_tpu import resilience
 from paddle_tpu.resilience import ResilienceConfig
+from paddle_tpu import observability
+from paddle_tpu.observability import ObservabilityConfig
 from paddle_tpu.reader.feeder import DataFeeder, FeedSpec
 from paddle_tpu import transpiler
 from paddle_tpu.transpiler import DistributeTranspiler, memory_optimize, release_memory
@@ -141,6 +143,8 @@ __all__ = [
     "ServingConfig",
     "resilience",
     "ResilienceConfig",
+    "observability",
+    "ObservabilityConfig",
     "CPUPlace",
     "TPUPlace",
 ]

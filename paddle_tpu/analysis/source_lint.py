@@ -15,7 +15,12 @@ rules; this one encodes them:
   value is frozen at trace time and silently reused forever after;
 * ``bare-assert`` — user-facing (public) functions must raise
   ``paddle_tpu.core.enforce.enforce()`` instead of ``assert``: asserts
-  vanish under ``python -O`` and carry no structured context.
+  vanish under ``python -O`` and carry no structured context;
+* ``metric-name`` — metric names at ``inc_counter``/``set_gauge``/
+  ``observe`` call sites must be dotted ``subsystem.snake_case``
+  (``trainer.steps_total``): the observability exporter groups families
+  by subsystem prefix and a flat or CamelCase name silently lands
+  outside every dashboard query.
 
 Runnable as ``python -m paddle_tpu.analysis`` and over the whole tree in
 ``tests/test_source_lint.py`` (so the gate rides tier-1). Suppress a
@@ -26,6 +31,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from paddle_tpu.analysis.diagnostics import ERROR, WARNING, Diagnostic
@@ -48,6 +54,15 @@ _WALLCLOCK_CALLS = {
 # passed around as values, not hidden global state)
 _NP_RANDOM_OK = {"RandomState", "default_rng", "Generator", "SeedSequence",
                  "PCG64", "Philox", "MT19937", "BitGenerator"}
+
+# metric-registry entry points whose first argument is a metric name
+_METRIC_FNS = ("inc_counter", "set_gauge", "observe")
+# dotted subsystem.snake_case with at least one dot: "trainer.steps_total"
+_METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
+# an f-string name must open with a literal "subsystem." prefix and its
+# literal head must stay inside the legal alphabet (no "name:{var}" keys —
+# variable parts belong in labels=, not baked into the family name)
+_METRIC_PREFIX_RE = re.compile(r"^[a-z][a-z0-9_]*\.[a-z0-9_.]*$")
 
 
 def default_roots() -> List[str]:
@@ -237,7 +252,41 @@ class _Linter(ast.NodeVisitor):
                         "np.random.RandomState / jax key instead",
                         node,
                     )
+        self._check_metric_name(node)
         self.generic_visit(node)
+
+    def _check_metric_name(self, node: ast.Call) -> None:
+        """metric-name: inc_counter/set_gauge/observe with a literal name
+        must use dotted subsystem.snake_case. Non-literal names (variables,
+        attribute reads) are out of scope; an f-string must open with a
+        literal ``subsystem.`` prefix so the family is still groupable."""
+        chain = _dotted(node.func)
+        if not chain or chain.rsplit(".", 1)[-1] not in _METRIC_FNS:
+            return
+        if not node.args:
+            return
+        arg0 = node.args[0]
+        if isinstance(arg0, ast.Constant) and isinstance(arg0.value, str):
+            if not _METRIC_NAME_RE.match(arg0.value):
+                self._diag(
+                    "metric-name",
+                    f"metric name {arg0.value!r} is not dotted "
+                    "subsystem.snake_case (e.g. 'trainer.steps_total'); "
+                    "un-prefixed names land outside every dashboard query",
+                    node,
+                )
+        elif isinstance(arg0, ast.JoinedStr):
+            head = arg0.values[0] if arg0.values else None
+            if not (isinstance(head, ast.Constant)
+                    and isinstance(head.value, str)
+                    and _METRIC_PREFIX_RE.match(head.value)):
+                self._diag(
+                    "metric-name",
+                    "f-string metric name must start with a literal "
+                    "'subsystem.' prefix (prefer a fixed name plus labels= "
+                    "for the variable part)",
+                    node,
+                )
 
     def visit_Assert(self, node: ast.Assert) -> None:
         if self._public_context():

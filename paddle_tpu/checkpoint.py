@@ -24,8 +24,10 @@ import jax
 import numpy as np
 
 from paddle_tpu.core import logging as ptlog
+from paddle_tpu.core import profiler as prof
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.core.retry import retry_call
+from paddle_tpu.observability import runlog
 from paddle_tpu.resilience import faults, integrity
 from paddle_tpu.resilience.integrity import CheckpointCorruptError
 
@@ -125,11 +127,17 @@ def save_checkpoint(
         os.rename(tmp_dir, final_dir)  # atomic publish
         integrity.fsync_dir(root)  # make the rename itself durable
 
+    t0 = time.perf_counter()
     retry_call(
         write_and_publish,
         retries=2, base_delay=0.02, max_delay=0.5,
         what=f"checkpoint save (serial {serial})",
     )
+    save_s = time.perf_counter() - t0
+    prof.inc_counter("checkpoint.saves_total")
+    prof.observe("checkpoint.save_seconds", save_s)
+    runlog.emit("checkpoint_save", step=int(step), path=final_dir,
+                serial=serial, seconds=round(save_s, 6), sharded=False)
 
     for old in serials[: max(0, len(serials) + 1 - max_num_checkpoints)]:
         shutil.rmtree(_serial_dir(root, old), ignore_errors=True)
@@ -226,6 +234,9 @@ def load_checkpoint(path_or_root: str, tree_like: Any, trainer_id: int = 0) -> T
     restored = [
         jax.numpy.asarray(l, dtype=np.asarray(ref).dtype) for l, ref in zip(leaves, like_leaves)
     ]
+    prof.inc_counter("checkpoint.restores_total")
+    runlog.emit("checkpoint_restore", step=int(meta.get("step", 0)),
+                path=path, sharded=False)
     return jax.tree_util.tree_unflatten(treedef, restored), meta
 
 

@@ -31,8 +31,10 @@ import jax
 import numpy as np
 
 from paddle_tpu.core import logging as ptlog
+from paddle_tpu.core import profiler as prof
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.core.retry import retry_call
+from paddle_tpu.observability import runlog
 from paddle_tpu.resilience import faults, integrity
 from paddle_tpu.resilience.integrity import CheckpointCorruptError
 
@@ -128,11 +130,17 @@ def _write_publish_local(root: str, step: int, shard_data, manifest, max_num: in
         os.rename(tmp_dir, final_dir)  # atomic publish
         integrity.fsync_dir(root)  # make the rename itself durable
 
+    t0 = time.perf_counter()
     retry_call(
         write_and_publish,
         retries=2, base_delay=0.02, max_delay=0.5,
         what=f"sharded checkpoint save (step {step})",
     )
+    save_s = time.perf_counter() - t0
+    prof.inc_counter("checkpoint.saves_total")
+    prof.observe("checkpoint.save_seconds", save_s)
+    runlog.emit("checkpoint_save", step=int(step), path=final_dir,
+                seconds=round(save_s, 6), sharded=True)
     _prune(root, max_num)
     return final_dir
 
@@ -172,6 +180,9 @@ def save_sharded(
         os.rename(tmp_dir, final_dir)  # atomic publish
         integrity.fsync_dir(root)  # make the rename itself durable
         _prune(root, max_num_checkpoints)
+        prof.inc_counter("checkpoint.saves_total")
+        runlog.emit("checkpoint_save", step=int(step), path=final_dir,
+                    sharded=True)
     _barrier("ckpt_published")
     ptlog.vlog(1, "sharded checkpoint step %d -> %s (process %d)", step, final_dir, pid)
     return final_dir
@@ -407,6 +418,9 @@ def load_sharded(path_or_root: str, tree_like: Any) -> Tuple[Any, dict]:
     finally:
         for z in opened.values():
             z.close()
+    prof.inc_counter("checkpoint.restores_total")
+    runlog.emit("checkpoint_restore", step=int(manifest.get("step", 0)),
+                path=path, sharded=True)
     return jax.tree_util.tree_unflatten(treedef, restored), manifest
 
 

@@ -53,6 +53,15 @@ class Flags:
     # persistent XLA compilation cache (big TPU compile-time win across
     # runs); empty = disabled. Applied at first Executor/jit use.
     compilation_cache_dir: str = ""
+    # observability: Prometheus exporter bind port (-1 = disabled, 0 = pick
+    # an ephemeral port; see paddle_tpu.observability.ObservabilityConfig)
+    metrics_port: int = -1
+    metrics_host: str = "127.0.0.1"
+    # append-only JSONL run-event log path; empty = disabled
+    runlog_path: str = ""
+    # per-device peak FLOP/s override for MFU accounting (0 = use the
+    # device-kind table in observability/mfu.py)
+    peak_flops: float = 0.0
 
     @staticmethod
     def _coerce(value: str, typ):

@@ -26,48 +26,57 @@ import jax
 _events: dict[str, list[float]] = defaultdict(list)
 # correlated spans for the timeline export: (name, start_us, dur_us, tid)
 _spans: list[tuple[str, float, float, int]] = []
+# thread ident -> thread name, captured the first time a span lands on a
+# thread so export_chrome_trace can emit ph:"M" thread_name metadata
+_thread_names: dict[int, str] = {}
 _MAX_SPANS = 1_000_000
 _enabled: bool = False
 
 # -- counters/gauges: monotonically-increasing totals and last-value gauges
 # for long-running services (the serving engine's queue depth, batch
-# occupancy, timeout totals). Unlike record_event these are always on:
-# they are O(1) dict updates, and a serving process wants its counters
-# exported regardless of whether a profiling window is open.
-_metrics_lock = threading.Lock()
-_counters: dict[str, float] = defaultdict(float)
-_gauges: dict[str, float] = {}
+# occupancy, timeout totals). Unlike record_event these are always on,
+# and since paddle_tpu.observability they are thin delegates into the
+# typed labeled registry (observability/metrics.py) that the Prometheus
+# exporter scrapes — the flat counters()/gauges() dicts remain as the
+# legacy aggregate view (labeled children summed / last-write).
 
 
-def inc_counter(name: str, value: float = 1.0) -> None:
+def _registry():
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    return obs_metrics.default_registry()
+
+
+def inc_counter(name: str, value: float = 1.0, labels: dict | None = None) -> None:
     """Add to a named monotonic counter (thread-safe)."""
-    with _metrics_lock:
-        _counters[name] += value
+    _registry().inc(name, value, labels=labels)
 
 
-def set_gauge(name: str, value: float) -> None:
+def set_gauge(name: str, value: float, labels: dict | None = None) -> None:
     """Set a named gauge to its latest value (thread-safe)."""
-    with _metrics_lock:
-        _gauges[name] = value
+    _registry().set(name, value, labels=labels)
+
+
+def observe(name: str, value: float, labels: dict | None = None) -> None:
+    """Record one observation into a named histogram (thread-safe).
+    Declare non-default bucket edges up front via
+    ``observability.default_registry().histogram(name, buckets=...)``."""
+    _registry().observe(name, value, labels=labels)
 
 
 def counters() -> dict[str, float]:
-    """Snapshot of all counters."""
-    with _metrics_lock:
-        return dict(_counters)
+    """Snapshot of all counters (labeled children summed per family)."""
+    return _registry().flat_counters()
 
 
 def gauges() -> dict[str, float]:
-    """Snapshot of all gauges."""
-    with _metrics_lock:
-        return dict(_gauges)
+    """Snapshot of all gauges (most recent write per family)."""
+    return _registry().flat_gauges()
 
 
 def reset_metrics() -> None:
-    """Clear counters and gauges (test isolation)."""
-    with _metrics_lock:
-        _counters.clear()
-        _gauges.clear()
+    """Clear counters, gauges, and histograms (test isolation)."""
+    _registry().reset()
 
 
 @contextlib.contextmanager
@@ -83,7 +92,10 @@ def record_event(name: str) -> Iterator[None]:
     t1 = time.perf_counter()
     _events[name].append(t1 - t0)
     if len(_spans) < _MAX_SPANS:  # bound timeline memory on long runs
-        _spans.append((name, t0 * 1e6, (t1 - t0) * 1e6, threading.get_ident()))
+        tid = threading.get_ident()
+        if tid not in _thread_names:
+            _thread_names[tid] = threading.current_thread().name
+        _spans.append((name, t0 * 1e6, (t1 - t0) * 1e6, tid))
 
 
 def enable_profiler() -> None:
@@ -96,7 +108,9 @@ def enable_profiler() -> None:
 def disable_profiler() -> dict[str, dict[str, float]]:
     """Stop host profiling and return the aggregation table
     (name → {calls, total_s, mean_s, min_s, max_s}), mirroring the sorted
-    summary of reference ``profiler.cc:476``."""
+    summary of reference ``profiler.cc:476``. Clears the recorded events
+    AND spans so the next profiling window starts empty — a second
+    ``export_chrome_trace()`` must not replay this window's spans."""
     global _enabled
     _enabled = False
     table = {}
@@ -108,6 +122,9 @@ def disable_profiler() -> dict[str, dict[str, float]]:
             "min_s": min(times),
             "max_s": max(times),
         }
+    _events.clear()
+    _spans.clear()
+    _thread_names.clear()
     return table
 
 
@@ -135,6 +152,11 @@ def export_chrome_trace(path: str) -> str:
             "name": name, "ph": "X", "cat": "host",
             "ts": start_us, "dur": dur_us,
             "pid": os.getpid(), "tid": tids[tid],
+        })
+    for tid, idx in tids.items():  # ph:"M" so Perfetto labels host threads
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": os.getpid(), "tid": idx,
+            "args": {"name": _thread_names.get(tid, f"thread-{idx}")},
         })
     doc = {
         "traceEvents": events,
@@ -191,9 +213,13 @@ def stop_profiler() -> dict:
 
 
 def reset_profiler() -> None:
-    """Clear recorded host spans (reference ``profiler.py:104`` — works for
-    start/stop/``profiler``, not the CUDA runtime profiler)."""
+    """Clear recorded host events AND timeline spans (reference
+    ``profiler.py:104`` — works for start/stop/``profiler``, not the CUDA
+    runtime profiler). Leaving ``_spans`` behind made a later
+    ``export_chrome_trace()`` replay the previous window."""
     _events.clear()
+    _spans.clear()
+    _thread_names.clear()
 
 
 @contextlib.contextmanager

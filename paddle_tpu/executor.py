@@ -15,6 +15,7 @@ ref-count GC (``executor.cc:336-397``) and the memory_optimize transpiler.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, Dict, Optional, Sequence
 
 import jax
@@ -23,6 +24,40 @@ import numpy as np
 from paddle_tpu.core import config as cfg
 from paddle_tpu.core import profiler as prof
 from paddle_tpu.core.enforce import EnforceError
+from paddle_tpu.observability import runlog
+
+
+class _InstrumentedCompiled:
+    """Wraps a ``jax.jit`` callable to detect executable-cache growth — a
+    growth across one call means XLA compiled for a new (shape, dtype)
+    signature, so that call's wall time is (approximately) trace + compile
+    + first run. Emits ``executor.compiles_total`` / the
+    ``executor.compile_seconds`` histogram and a ``compile`` runlog event.
+    Transparent otherwise: attribute access (``lower``, ``_cache_size``,
+    ...) delegates to the wrapped jit object."""
+
+    __slots__ = ("_fn", "_label", "_tracked")
+
+    def __init__(self, fn: Callable, label: str):
+        self._fn = fn
+        self._label = label
+        self._tracked = hasattr(fn, "_cache_size")
+
+    def __call__(self, *args, **kwargs):
+        if not self._tracked:
+            return self._fn(*args, **kwargs)
+        before = self._fn._cache_size()
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        if self._fn._cache_size() > before:
+            dt = time.perf_counter() - t0
+            prof.inc_counter("executor.compiles_total")
+            prof.observe("executor.compile_seconds", dt)
+            runlog.emit("compile", target=self._label, seconds=round(dt, 6))
+        return out
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_fn"), name)
 
 
 class Executor:
@@ -66,12 +101,19 @@ class Executor:
                 # LRU eviction: callers passing fresh closures per step would
                 # otherwise leak a compiled executable per call
                 self._cache.pop(next(iter(self._cache)))
-            self._cache[cache_key] = jax.jit(
-                fn,
-                donate_argnums=tuple(donate_argnums),
-                static_argnums=tuple(static_argnums),
-                device=self._device,
+            prof.inc_counter("executor.cache_misses_total")
+            label = (str(key[0]) if isinstance(key, tuple) and key
+                     else getattr(fn, "__name__", "fn"))
+            self._cache[cache_key] = _InstrumentedCompiled(
+                jax.jit(
+                    fn,
+                    donate_argnums=tuple(donate_argnums),
+                    static_argnums=tuple(static_argnums),
+                    device=self._device,
+                ),
+                label,
             )
+            prof.set_gauge("executor.cache_size", len(self._cache))
         return self._cache[cache_key]
 
     def run(

@@ -1,0 +1,125 @@
+"""paddle_tpu.observability — process-wide telemetry.
+
+Four pieces, layered on the counter/gauge bridge in ``core.profiler``:
+
+- :mod:`~paddle_tpu.observability.metrics` — typed registry of labeled
+  counters, gauges, and fixed/exponential-bucket histograms;
+- :mod:`~paddle_tpu.observability.runlog` — append-only JSONL run-event
+  log (step / compile / checkpoint / resilience events);
+- :mod:`~paddle_tpu.observability.mfu` — MFU from XLA ``cost_analysis()``
+  FLOPs vs. per-device peak, plus goodput/badput accounting;
+- :mod:`~paddle_tpu.observability.exporter` — stdlib Prometheus
+  ``/metrics`` + ``/healthz`` HTTP endpoint.
+
+Enable by flags (``PADDLE_TPU_METRICS_PORT=9100``,
+``PADDLE_TPU_RUNLOG_PATH=run.jsonl``) or explicitly::
+
+    from paddle_tpu.observability import ObservabilityConfig, setup
+    setup(ObservabilityConfig(metrics_port=0, runlog_path="run.jsonl"))
+
+``Trainer`` and ``ServingEngine`` call :func:`setup` on construction
+(idempotent, no-op while disabled), so setting the flags is enough.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+from paddle_tpu.observability import exporter, metrics, mfu, runlog
+from paddle_tpu.observability.exporter import MetricsServer, render_text
+from paddle_tpu.observability.metrics import (
+    MetricRegistry,
+    default_registry,
+    exponential_buckets,
+    linear_buckets,
+)
+from paddle_tpu.observability.mfu import GoodputTracker
+from paddle_tpu.observability.runlog import RunLog, read_runlog
+
+__all__ = [
+    "ObservabilityConfig",
+    "setup",
+    "shutdown",
+    "server",
+    "metrics",
+    "runlog",
+    "mfu",
+    "exporter",
+    "MetricRegistry",
+    "MetricsServer",
+    "GoodputTracker",
+    "RunLog",
+    "default_registry",
+    "render_text",
+    "read_runlog",
+    "exponential_buckets",
+    "linear_buckets",
+]
+
+
+@dataclasses.dataclass
+class ObservabilityConfig:
+    """What telemetry to turn on for this process.
+
+    ``metrics_port``: < 0 disables the exporter, 0 binds an ephemeral port
+    (read it back from ``server().port``), > 0 binds that port.
+    ``runlog_path``: empty disables the run-event log.
+    """
+
+    metrics_port: int = -1
+    metrics_host: str = "127.0.0.1"
+    runlog_path: str = ""
+
+    @staticmethod
+    def from_flags() -> "ObservabilityConfig":
+        from paddle_tpu.core import config
+
+        f = config.flags()
+        return ObservabilityConfig(
+            metrics_port=f.metrics_port,
+            metrics_host=f.metrics_host,
+            runlog_path=f.runlog_path,
+        )
+
+
+_lock = threading.Lock()
+_server: Optional[MetricsServer] = None
+_owned_runlog: Optional[RunLog] = None
+
+
+def setup(config: Optional[ObservabilityConfig] = None) -> Optional[MetricsServer]:
+    """Start the configured telemetry (idempotent; safe to call from every
+    Trainer/ServingEngine constructor). With no argument, reads
+    ``ObservabilityConfig.from_flags()`` — all-default flags make this a
+    no-op. Returns the running exporter, if any."""
+    global _server, _owned_runlog
+    config = config or ObservabilityConfig.from_flags()
+    with _lock:
+        if config.runlog_path and runlog.get_runlog() is None:
+            _owned_runlog = RunLog(config.runlog_path)
+            runlog.set_runlog(_owned_runlog)
+        if config.metrics_port >= 0 and _server is None:
+            _server = MetricsServer(
+                host=config.metrics_host, port=config.metrics_port).start()
+        return _server
+
+
+def server() -> Optional[MetricsServer]:
+    """The process-wide exporter started by :func:`setup`, if any."""
+    return _server
+
+
+def shutdown() -> None:
+    """Stop the exporter and close the runlog that :func:`setup` opened."""
+    global _server, _owned_runlog
+    with _lock:
+        if _server is not None:
+            _server.close()
+            _server = None
+        if _owned_runlog is not None:
+            if runlog.get_runlog() is _owned_runlog:
+                runlog.set_runlog(None)
+            _owned_runlog.close()
+            _owned_runlog = None

@@ -1,0 +1,184 @@
+"""MFU and goodput accounting.
+
+MFU (model FLOPs utilization) = achieved model FLOPs per second divided by
+the hardware's peak — the achieved-vs-peak framing of Tensor Processing
+Primitives (arxiv 2104.05755). Model FLOPs come from XLA's own cost model:
+``jitted.lower(*args).cost_analysis()["flops"]`` (no compile needed), so
+the numerator is the *algorithmic* cost of the step function, not a
+hand-derived 6ND estimate.
+
+Peak FLOPs resolve in priority order:
+
+1. ``PADDLE_TPU_PEAK_FLOPS`` env / ``peak_flops`` flag (per-device override),
+2. the device-kind table below (bf16 peak per chip generation; the ``cpu``
+   entry is a nominal placeholder so CPU-backend runs still report a
+   finite utilization — override it for a real host).
+
+Goodput is the fraction of wall time spent making forward progress:
+:class:`GoodputTracker` charges time lost to NaN-skipped steps, rollbacks,
+retries, and stalls against per-category *badput* counters.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+__all__ = [
+    "PEAK_FLOPS_TABLE",
+    "peak_flops",
+    "peak_flops_for_kind",
+    "set_peak_flops",
+    "cost_flops",
+    "lowered_flops",
+    "mfu",
+    "GoodputTracker",
+]
+
+# bf16 peak FLOP/s per device, matched by substring against the JAX
+# device_kind (e.g. "TPU v4"). Order matters: first hit wins.
+PEAK_FLOPS_TABLE: Tuple[Tuple[str, float], ...] = (
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+    # nominal host fallback (~a few AVX cores) so CPU smoke runs report a
+    # finite MFU; override with PADDLE_TPU_PEAK_FLOPS for a real number
+    ("cpu", 5e10),
+)
+
+_override_lock = threading.Lock()
+_override: Optional[float] = None
+
+
+def set_peak_flops(value: Optional[float]) -> None:
+    """Programmatic per-device peak override (None clears it)."""
+    global _override
+    with _override_lock:
+        _override = float(value) if value else None
+
+
+def _flag_override() -> Optional[float]:
+    from paddle_tpu.core import config
+
+    v = config.flags().peak_flops
+    return float(v) if v and v > 0 else None
+
+
+def peak_flops_for_kind(device_kind: str) -> Optional[float]:
+    """Peak FLOP/s for a device-kind string; override beats the table."""
+    with _override_lock:
+        if _override is not None:
+            return _override
+    flagged = _flag_override()
+    if flagged is not None:
+        return flagged
+    kind = (device_kind or "").lower()
+    for marker, peak in PEAK_FLOPS_TABLE:
+        if marker in kind:
+            return peak
+    return None
+
+
+def peak_flops(device=None) -> Optional[float]:
+    """Peak FLOP/s for one device (default: the first local device)."""
+    import jax
+
+    if device is None:
+        devices = jax.local_devices()
+        if not devices:
+            return None
+        device = devices[0]
+    kind = getattr(device, "device_kind", "") or getattr(device, "platform", "")
+    return peak_flops_for_kind(str(kind))
+
+
+def cost_flops(cost_source) -> float:
+    """Total FLOPs from a Lowered/Compiled computation's cost analysis.
+    ``cost_analysis()`` returns a dict on Lowered and (on some jax
+    versions) a per-computation list on Compiled; handle both. Returns
+    0.0 when the backend exposes no cost model."""
+    try:
+        cost = cost_source.cost_analysis()
+    except Exception:
+        return 0.0
+    if cost is None:
+        return 0.0
+    if isinstance(cost, dict):
+        cost = [cost]
+    total = 0.0
+    for entry in cost:
+        try:
+            total += float(entry.get("flops", 0.0))
+        except (AttributeError, TypeError, ValueError):
+            continue
+    return total
+
+
+def lowered_flops(jitted, *args, **kwargs) -> float:
+    """FLOPs of one call of a jitted function, via ``lower()`` — traces
+    but does not compile. Returns 0.0 if lowering fails."""
+    try:
+        return cost_flops(jitted.lower(*args, **kwargs))
+    except Exception:
+        return 0.0
+
+
+def mfu(flops_per_step: float, step_time_s: float, device_count: int = 1,
+        peak_per_device: Optional[float] = None) -> Optional[float]:
+    """Model FLOPs utilization in [0, ~1]; None when peak is unknown."""
+    if peak_per_device is None:
+        peak_per_device = peak_flops()
+    if not peak_per_device or step_time_s <= 0 or flops_per_step <= 0:
+        return None
+    return flops_per_step / (step_time_s * max(1, device_count) * peak_per_device)
+
+
+class GoodputTracker:
+    """Splits run time into goodput (productive step time) and badput
+    (time charged to a failure category: nan_skip, rollback, stall, ...)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._good_s = 0.0
+        self._bad_s: Dict[str, float] = {}
+
+    def record_good(self, seconds: float) -> None:
+        with self._lock:
+            self._good_s += max(0.0, seconds)
+
+    def record_bad(self, seconds: float, category: str) -> None:
+        with self._lock:
+            self._bad_s[category] = self._bad_s.get(category, 0.0) + max(0.0, seconds)
+
+    def good_seconds(self) -> float:
+        with self._lock:
+            return self._good_s
+
+    def bad_seconds(self) -> float:
+        with self._lock:
+            return sum(self._bad_s.values())
+
+    def badput_by_category(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._bad_s)
+
+    def goodput_frac(self) -> float:
+        """good / (good + bad); 1.0 for an untroubled (or empty) run."""
+        with self._lock:
+            total = self._good_s + sum(self._bad_s.values())
+            return self._good_s / total if total > 0 else 1.0
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            total = self._good_s + sum(self._bad_s.values())
+            snap = {
+                "good_seconds": self._good_s,
+                "bad_seconds": sum(self._bad_s.values()),
+                "goodput_frac": self._good_s / total if total > 0 else 1.0,
+            }
+            for cat, s in self._bad_s.items():
+                snap[f"bad_seconds.{cat}"] = s
+            return snap

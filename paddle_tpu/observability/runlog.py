@@ -1,0 +1,112 @@
+"""Structured run-event log: append-only JSONL of what the run *did*.
+
+One line per event, each a JSON object carrying at least ``ts`` (unix
+seconds), ``kind``, and ``step`` (``null`` when the event is not tied to a
+training step). Producers call the module-level :func:`emit` — a no-op
+until a :class:`RunLog` is installed via :func:`set_runlog` (usually by
+``observability.setup()`` from the ``runlog_path`` flag), so hooks in hot
+paths cost one global read when logging is off.
+
+Event kinds emitted by the framework:
+
+- ``step`` — loss, step_time_s, examples_per_sec, EMA throughput
+  (``trainer.py``)
+- ``compile`` — Executor cache miss + compile seconds (``executor.py``)
+- ``checkpoint_save`` / ``checkpoint_restore`` — publish/restore with
+  path and step (``checkpoint.py``, ``checkpoint_sharded.py``)
+- ``nan_skip`` / ``rollback`` / ``watchdog_stall`` / ``fault_injected`` /
+  ``breaker_open`` / ``breaker_close`` — resilience events
+  (``trainer.py``, ``resilience/``, ``serving/engine.py``)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from paddle_tpu.core import enforce
+
+__all__ = ["RunLog", "set_runlog", "get_runlog", "emit", "read_runlog"]
+
+
+def _json_default(obj):
+    # numpy / jax scalars and anything else non-JSON: degrade gracefully
+    for cast in (float, int):
+        try:
+            return cast(obj)
+        except (TypeError, ValueError):
+            continue
+    return repr(obj)
+
+
+class RunLog:
+    """Append-only JSONL event sink (thread-safe, line-buffered)."""
+
+    def __init__(self, path: str):
+        enforce.enforce(bool(path), "RunLog: path must be non-empty")
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh = open(path, "a", buffering=1)
+        self._closed = False
+
+    def emit(self, kind: str, step: Optional[int] = None, **fields: Any) -> None:
+        record: Dict[str, Any] = {"ts": time.time(), "kind": kind, "step": step}
+        record.update(fields)
+        line = json.dumps(record, default=_json_default)
+        with self._lock:
+            if self._closed:
+                return
+            self._fh.write(line + "\n")
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self._closed = True
+                self._fh.close()
+
+
+_active: Optional[RunLog] = None
+_install_lock = threading.Lock()
+
+
+def set_runlog(runlog: Optional[RunLog]) -> Optional[RunLog]:
+    """Install (or clear, with ``None``) the process-wide run log.
+    Returns the previously installed one (not closed)."""
+    global _active
+    with _install_lock:
+        previous, _active = _active, runlog
+    return previous
+
+
+def get_runlog() -> Optional[RunLog]:
+    return _active
+
+
+def emit(kind: str, step: Optional[int] = None, **fields: Any) -> None:
+    """Emit to the installed run log; no-op when none is installed."""
+    log = _active
+    if log is not None:
+        log.emit(kind, step=step, **fields)
+
+
+def read_runlog(path: str) -> List[Dict[str, Any]]:
+    """Parse a runlog file back into event dicts (skips blank lines;
+    a torn final line from a crashed writer raises ``ValueError`` with
+    the offending line number)."""
+    events = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise ValueError(
+                    f"{path}:{lineno}: invalid runlog line: {e}") from e
+    return events

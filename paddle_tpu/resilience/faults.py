@@ -41,6 +41,7 @@ from typing import Any, Dict, List, Optional
 from paddle_tpu.core import logging as ptlog
 from paddle_tpu.core import profiler as prof
 from paddle_tpu.core.enforce import enforce, enforce_in
+from paddle_tpu.observability import runlog
 
 __all__ = [
     "FaultSpec",
@@ -209,7 +210,8 @@ def inject(point: str, **ctx: Any) -> Optional[FaultSpec]:
                 break
     if fired is None:
         return None
-    prof.inc_counter(f"resilience.faults_fired:{point}")
+    prof.inc_counter("resilience.faults_fired", labels={"point": point})
+    runlog.emit("fault_injected", point=point, fault_kind=fired.kind)
     ptlog.warning(
         "fault injected at %s (%s, fired %d): ctx=%r",
         point, fired.kind, fired.fired, ctx,

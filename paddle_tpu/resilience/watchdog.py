@@ -31,6 +31,7 @@ from typing import Callable, Optional
 from paddle_tpu.core import logging as ptlog
 from paddle_tpu.core import profiler as prof
 from paddle_tpu.core.enforce import enforce
+from paddle_tpu.observability import runlog
 
 __all__ = ["StepWatchdog", "dump_all_stacks"]
 
@@ -122,6 +123,8 @@ class StepWatchdog:
                 self._cond.release()
                 try:  # log + callback outside the lock: they may be slow
                     prof.inc_counter("resilience.watchdog_stalls")
+                    runlog.emit("watchdog_stall", tag=tag,
+                                elapsed_s=round(elapsed, 3))
                     ptlog.error(
                         "watchdog: %s exceeded %.1fs (%.1fs elapsed); "
                         "thread stacks:\n%s",

@@ -50,6 +50,8 @@ from paddle_tpu.core import profiler as prof
 from paddle_tpu.core.enforce import EnforceError, enforce
 from paddle_tpu.executor import Executor
 from paddle_tpu.framework import Model, Variables, build
+from paddle_tpu import observability
+from paddle_tpu.observability import runlog
 from paddle_tpu.reader.feeder import FeedSpec
 from paddle_tpu.resilience import faults
 from paddle_tpu.resilience.circuit import CircuitBreaker
@@ -93,6 +95,9 @@ class ServingConfig:
     batch_buckets: Optional[Sequence[int]] = None
     # padded lengths for ragged FeedSpec dims (required if any are ragged)
     length_buckets: Optional[Sequence[int]] = None
+    # metric label distinguishing this engine's families in the registry /
+    # scrape output; None = auto ("serving0", "serving1", ... per process)
+    engine_label: Optional[str] = None
     # device replicas; None = every local device of the place's platform
     num_replicas: Optional[int] = None
     # compile every (signature, batch bucket) executable at startup
@@ -214,7 +219,8 @@ class ServingEngine:
             batch_buckets=self.config.batch_buckets,
             length_buckets=self.config.length_buckets,
         )
-        self.metrics = ServingMetrics()
+        self.metrics = ServingMetrics(engine_label=self.config.engine_label)
+        observability.setup()  # flags-driven exporter/runlog, idempotent
         self._closed = False
         self._close_lock = threading.Lock()
         self._rr = 0  # round-robin cursor (guarded by _pick_lock)
@@ -509,6 +515,8 @@ class ServingEngine:
                 raise
             if rep.breaker.record_success():
                 self.metrics.record_replica_recovery()
+                runlog.emit("breaker_close", replica=rep.index,
+                            engine=self.metrics.engine_label)
                 ptlog.vlog(
                     0, "serving replica %d recovered (half-open probe ok)",
                     rep.index,
@@ -533,6 +541,8 @@ class ServingEngine:
         callers for real."""
         if rep.breaker.record_failure():
             self.metrics.record_replica_ejection()
+            runlog.emit("breaker_open", replica=rep.index,
+                        engine=self.metrics.engine_label, error=repr(exc))
             ptlog.error(
                 "serving replica %d ejected after %d consecutive failures "
                 "(retry in %.2fs): %s",
@@ -557,6 +567,8 @@ class ServingEngine:
         batcher's next pick — they are failed here to stay bounded)."""
         rep.dead = True
         self.metrics.record_replica_death()
+        runlog.emit("replica_died", replica=rep.index,
+                    engine=self.metrics.engine_label, error=repr(exc))
         self.metrics.set_healthy_replicas(self._count_healthy())
         ptlog.error("serving replica %d worker died: %r", rep.index, exc)
         rep.channel.close()

@@ -1,22 +1,37 @@
 """Serving observability: request/batch counters, queue-depth gauge, and a
 latency reservoir with percentile readout.
 
-Everything mirrors into the framework-wide counter/gauge registry in
-``paddle_tpu.core.profiler`` (``serving.*`` names) so one scrape point sees
-the whole process; :meth:`ServingMetrics.snapshot` returns the same data as
-a plain dict for tests and the bench CLI.
+Everything mirrors into the framework-wide registry
+(``paddle_tpu.observability.metrics`` via ``core.profiler``) under
+``serving.*`` names so one scrape point sees the whole process. Each
+engine gets an ``engine`` label (default ``serving0``, ``serving1``, ...)
+— two engines in one process no longer collide on the same families, and
+``prof.counters()`` still shows the per-name aggregate across engines.
+The latency reservoir additionally mirrors into the
+``serving.request_latency_seconds`` histogram family, so the Prometheus
+scrape carries full latency distributions, not just p50/p99 points.
+:meth:`ServingMetrics.snapshot` returns the same data as a plain dict for
+tests and the bench CLI.
 """
 
 from __future__ import annotations
 
 import collections
+import itertools
 import math
 import threading
 from typing import Dict, Optional
 
 from paddle_tpu.core import profiler as prof
+from paddle_tpu.observability import metrics as obs_metrics
 
 __all__ = ["ServingMetrics"]
+
+# distinct default engine labels for every engine built in this process
+_ENGINE_SEQ = itertools.count()
+
+# sub-millisecond to 10s — serving latencies, finer than the generic default
+_LATENCY_BUCKETS = obs_metrics.exponential_buckets(0.0005, 2.0, 15)
 
 
 def _percentile(sorted_values, q: float) -> float:
@@ -30,8 +45,19 @@ def _percentile(sorted_values, q: float) -> float:
 class ServingMetrics:
     """Thread-safe counters for one engine instance."""
 
-    def __init__(self, latency_window: int = 8192):
+    def __init__(self, latency_window: int = 8192,
+                 engine_label: Optional[str] = None):
         self._lock = threading.Lock()
+        self.engine_label = engine_label or f"serving{next(_ENGINE_SEQ)}"
+        self._labels = {"engine": self.engine_label}
+        obs_metrics.default_registry().histogram(
+            "serving.request_latency_seconds",
+            help="End-to-end request latency (submit to response).",
+            buckets=_LATENCY_BUCKETS)
+        obs_metrics.default_registry().histogram(
+            "serving.batch_occupancy",
+            help="Real rows / bucket rows per dispatched batch.",
+            buckets=obs_metrics.linear_buckets(0.1, 0.1, 10))
         self.requests_total = 0
         self.responses_total = 0
         self.timeouts_total = 0
@@ -54,8 +80,8 @@ class ServingMetrics:
     def record_submit(self, rows: int, queue_depth: int) -> None:
         with self._lock:
             self.requests_total += 1
-        prof.inc_counter("serving.requests_total")
-        prof.set_gauge("serving.queue_depth", queue_depth)
+        prof.inc_counter("serving.requests_total", labels=self._labels)
+        prof.set_gauge("serving.queue_depth", queue_depth, labels=self._labels)
 
     def record_batch(self, rows: int, bucket_rows: int, sig) -> None:
         with self._lock:
@@ -65,56 +91,61 @@ class ServingMetrics:
             if bucket_rows > rows:
                 self.padded_batches_total += 1
             self.dispatch_shapes.add((sig, bucket_rows))
-        prof.inc_counter("serving.batches_total")
-        prof.inc_counter("serving.rows_total", rows)
-        prof.set_gauge("serving.last_batch_occupancy", rows / bucket_rows)
+        prof.inc_counter("serving.batches_total", labels=self._labels)
+        prof.inc_counter("serving.rows_total", rows, labels=self._labels)
+        prof.set_gauge("serving.last_batch_occupancy", rows / bucket_rows,
+                       labels=self._labels)
+        prof.observe("serving.batch_occupancy", rows / bucket_rows,
+                     labels=self._labels)
 
     def record_response(self, latency_s: float) -> None:
         with self._lock:
             self.responses_total += 1
             self._latencies.append(latency_s)
-        prof.inc_counter("serving.responses_total")
+        prof.inc_counter("serving.responses_total", labels=self._labels)
+        prof.observe("serving.request_latency_seconds", latency_s,
+                     labels=self._labels)
 
     def record_timeout(self) -> None:
         with self._lock:
             self.timeouts_total += 1
-        prof.inc_counter("serving.timeouts_total")
+        prof.inc_counter("serving.timeouts_total", labels=self._labels)
 
     def record_error(self, n: int = 1) -> None:
         with self._lock:
             self.errors_total += n
-        prof.inc_counter("serving.errors_total", n)
+        prof.inc_counter("serving.errors_total", n, labels=self._labels)
 
     def record_warmup(self, n: int = 1) -> None:
         with self._lock:
             self.warmup_executables += n
-        prof.inc_counter("serving.warmup_executables", n)
+        prof.inc_counter("serving.warmup_executables", n, labels=self._labels)
 
     def set_queue_depth(self, depth: int) -> None:
-        prof.set_gauge("serving.queue_depth", depth)
+        prof.set_gauge("serving.queue_depth", depth, labels=self._labels)
 
     def record_replica_ejection(self) -> None:
         with self._lock:
             self.replica_ejections_total += 1
-        prof.inc_counter("serving.replica_ejections_total")
+        prof.inc_counter("serving.replica_ejections_total", labels=self._labels)
 
     def record_replica_recovery(self) -> None:
         with self._lock:
             self.replica_recoveries_total += 1
-        prof.inc_counter("serving.replica_recoveries_total")
+        prof.inc_counter("serving.replica_recoveries_total", labels=self._labels)
 
     def record_replica_death(self) -> None:
         with self._lock:
             self.replica_deaths_total += 1
-        prof.inc_counter("serving.replica_deaths_total")
+        prof.inc_counter("serving.replica_deaths_total", labels=self._labels)
 
     def record_redispatch(self) -> None:
         with self._lock:
             self.redispatches_total += 1
-        prof.inc_counter("serving.redispatches_total")
+        prof.inc_counter("serving.redispatches_total", labels=self._labels)
 
     def set_healthy_replicas(self, n: int) -> None:
-        prof.set_gauge("serving.healthy_replicas", n)
+        prof.set_gauge("serving.healthy_replicas", n, labels=self._labels)
 
     # -- readout -----------------------------------------------------------
 
@@ -138,6 +169,7 @@ class ServingMetrics:
         with self._lock:
             vals = sorted(self._latencies)
             snap = {
+                "engine": self.engine_label,
                 "requests_total": self.requests_total,
                 "responses_total": self.responses_total,
                 "timeouts_total": self.timeouts_total,

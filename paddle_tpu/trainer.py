@@ -19,18 +19,22 @@ from __future__ import annotations
 import os
 import signal
 import threading
+import time
 from typing import Any, Callable, Iterable, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
 from paddle_tpu import checkpoint as ckpt_mod
+from paddle_tpu import observability as obs
 from paddle_tpu.checkpoint import CheckpointConfig
 from paddle_tpu.core import logging as ptlog
 from paddle_tpu.core import profiler as prof
 from paddle_tpu.core.enforce import EnforceError, enforce
 from paddle_tpu.executor import Executor
 from paddle_tpu.framework import Model, Variables
+from paddle_tpu.observability import mfu as obs_mfu
+from paddle_tpu.observability import runlog
 from paddle_tpu.optimizer import Optimizer, OptState, StepOutput
 from paddle_tpu.resilience import ResilienceConfig, faults
 from paddle_tpu.resilience.watchdog import StepWatchdog
@@ -90,8 +94,12 @@ class Trainer:
         parallel_kwargs: Optional[dict] = None,
         prefetch: bool = False,
         resilience: Optional[ResilienceConfig] = None,
+        observability: Optional["obs.ObservabilityConfig"] = None,
     ):
         from paddle_tpu.framework import build
+
+        # flags-driven (or explicit) telemetry: exporter + runlog, idempotent
+        obs.setup(observability)
 
         model = train_func()
         self.model = model if isinstance(model, Model) else build(model)
@@ -127,6 +135,10 @@ class Trainer:
         self._consec_bad = 0
         self._rollbacks_since_good = 0
         self._watchdog: Optional[StepWatchdog] = None
+        # -- telemetry (paddle_tpu.observability) --------------------------
+        self.goodput = obs_mfu.GoodputTracker()
+        self._ema_eps: Optional[float] = None  # EMA examples/sec
+        self._step_flops: Optional[float] = None  # XLA cost-model FLOPs/step
 
     # -- init / resume ------------------------------------------------------
     def _ensure_initialized(self, first_batch: Sequence[Any]):
@@ -218,7 +230,8 @@ class Trainer:
         prev_handlers = self._install_preemption_handlers()
         res = self.resilience
         if res is not None and res.stall_timeout_s is not None and self._watchdog is None:
-            self._watchdog = StepWatchdog(res.stall_timeout_s)
+            self._watchdog = StepWatchdog(
+                res.stall_timeout_s, on_stall=self._on_stall)
         try:
             for epoch_id in range(self.epoch, num_epochs):
                 self.epoch = epoch_id
@@ -232,6 +245,7 @@ class Trainer:
                     spec = faults.inject(
                         faults.TRAINER_STEP, epoch=epoch_id, step=step_id
                     )
+                    t_step = time.perf_counter()
                     if self._watchdog is not None:
                         with self._watchdog.watch(f"epoch {epoch_id} step {step_id}"):
                             out = self._run_step(batch)
@@ -241,6 +255,10 @@ class Trainer:
                         spec is not None and spec.kind == "nan"
                     )
                     if bad:
+                        # charge the wasted step to badput even if the policy
+                        # raises below — the accounting outlives the run
+                        self.goodput.record_bad(
+                            time.perf_counter() - t_step, "nan_skip")
                         # may raise (policy "raise", or rollback gave up)
                         self._handle_bad_step(epoch_id, step_id)
                         metrics = float("nan") if begin_ev.fetch_metrics else None
@@ -252,6 +270,9 @@ class Trainer:
                         # honoring fetch_metrics avoids a host sync per step
                         # (reference BeginStepEvent.fetch_metrics, trainer.py:158)
                         metrics = float(out.loss) if begin_ev.fetch_metrics else None
+                        self._record_step(
+                            epoch_id, batch, time.perf_counter() - t_step,
+                            metrics)
                     handler(EndStepEvent(epoch_id, step_id, metrics))
                     if self._preempt_requested:
                         self._preemption_save(next_epoch=epoch_id)
@@ -283,6 +304,69 @@ class Trainer:
                     # log the writer failure instead of masking the cause
                     ptlog.error("async checkpoint writer failed during train() exit: %s", e)
 
+    # -- telemetry (paddle_tpu.observability) -------------------------------
+    def _record_step(self, epoch_id: int, batch, dt: float,
+                     loss: Optional[float]) -> None:
+        """Registry + runlog record for one GOOD step: step-time histogram,
+        throughput gauges (instant + EMA), goodput, and MFU from the step
+        function's XLA cost-model FLOPs."""
+        rows = int(np.shape(batch[0])[0]) if len(batch) else 0
+        eps = rows / dt if dt > 0 else 0.0
+        self._ema_eps = (
+            eps if self._ema_eps is None else 0.9 * self._ema_eps + 0.1 * eps
+        )
+        prof.inc_counter("trainer.steps_total")
+        prof.inc_counter("trainer.examples_total", rows)
+        prof.observe("trainer.step_seconds", dt)
+        prof.set_gauge("trainer.examples_per_sec", eps)
+        prof.set_gauge("trainer.examples_per_sec_ema", self._ema_eps)
+        if loss is not None:
+            prof.set_gauge("trainer.loss", loss)
+        self.goodput.record_good(dt)
+        prof.set_gauge("trainer.goodput_frac", self.goodput.goodput_frac())
+        if self._step_flops is None:
+            self._step_flops = self._compute_step_flops(batch)
+        mfu_val = None
+        if self._step_flops:
+            mfu_val = obs_mfu.mfu(self._step_flops, dt,
+                                  device_count=self._device_count())
+            if mfu_val is not None:
+                prof.set_gauge("trainer.mfu", mfu_val)
+        extra = {"mfu": round(mfu_val, 6)} if mfu_val is not None else {}
+        runlog.emit(
+            "step", step=self.global_step, epoch=epoch_id, loss=loss,
+            step_time_s=round(dt, 6), examples_per_sec=round(eps, 3),
+            ema_examples_per_sec=round(self._ema_eps, 3), **extra)
+
+    def _compute_step_flops(self, batch) -> float:
+        """Model FLOPs of one step from XLA's cost analysis — ``lower()``
+        traces without compiling, so this is cheap and exact for the step
+        actually being run. 0.0 (MFU suppressed) when the path doesn't
+        lower (e.g. step_ragged) or the backend has no cost model."""
+        target = self._dp.step if self.parallel else self._step_fn
+        if target is None or not hasattr(target, "lower"):
+            return 0.0
+        try:
+            args = [jax.numpy.asarray(b) for b in batch]
+            return obs_mfu.lowered_flops(
+                target, self.variables, self.opt_state, *args)
+        except Exception:
+            return 0.0
+
+    def _device_count(self) -> int:
+        if self.parallel and self._dp is not None:
+            mesh = getattr(self._dp, "mesh", None)
+            if mesh is not None:
+                return int(mesh.size)
+            return jax.local_device_count()
+        return 1
+
+    def _on_stall(self, tag: str, elapsed: float) -> None:
+        # the watchdog already logged stacks + runlog'd the stall; charge
+        # the stalled wall time against goodput here (trainer-side policy)
+        self.goodput.record_bad(elapsed, "stall")
+        prof.set_gauge("trainer.goodput_frac", self.goodput.goodput_frac())
+
     # -- self-healing (resilience.ResilienceConfig) -------------------------
     def _handle_bad_step(self, epoch_id: int, step_id: int) -> None:
         """A non-finite step (in-step check_nan_inf, or an injected "nan"
@@ -301,6 +385,8 @@ class Trainer:
         self.bad_steps += 1
         self._consec_bad += 1
         prof.inc_counter("resilience.bad_steps")
+        runlog.emit("nan_skip", step=self.global_step, epoch=epoch_id,
+                    consecutive=self._consec_bad)
         ptlog.warning(
             "%s — policy %r: update dropped (%d consecutive bad)",
             msg, res.nan_policy, self._consec_bad,
@@ -326,6 +412,8 @@ class Trainer:
         cfg = self.checkpoint_cfg
         root = cfg.checkpoint_dir
         tree = (self.variables, self.opt_state)
+        t0 = time.perf_counter()
+        rolled_back_from = self.global_step
         if cfg.use_sharded():
             from paddle_tpu import checkpoint_sharded as cks
 
@@ -348,6 +436,11 @@ class Trainer:
         self._rollbacks_since_good += 1
         self._consec_bad = 0
         prof.inc_counter("resilience.rollbacks")
+        restore_s = time.perf_counter() - t0
+        self.goodput.record_bad(restore_s, "rollback")
+        runlog.emit("rollback", step=self.global_step,
+                    rolled_back_from=rolled_back_from,
+                    restore_seconds=round(restore_s, 6))
         ptlog.error(
             "rolled back to checkpoint step %d (rollback %d this run)",
             self.global_step, self.rollbacks,

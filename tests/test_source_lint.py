@@ -122,6 +122,32 @@ def test_bare_assert_public_only():
     assert all(d.severity == ERROR for d in diags)
 
 
+def test_metric_name_rule():
+    src = """
+    from paddle_tpu.core import profiler as prof
+
+    def record(point):
+        prof.inc_counter("stepsTotal")               # no subsystem prefix
+        prof.set_gauge("loss", 1.0)                  # no dot at all
+        prof.observe(f"{point}.seconds", 0.1)        # variable prefix
+        prof.inc_counter(f"trainer.faults:{point}")  # colon-keyed family
+    """
+    diags = _lint(src)
+    assert _codes(diags).count("metric-name") == 4
+    ok = """
+    from paddle_tpu.core import profiler as prof
+
+    def record(point, depth):
+        prof.inc_counter("trainer.steps_total")
+        prof.inc_counter("resilience.faults_fired", labels={"point": point})
+        prof.set_gauge("serving.queue_depth", depth)
+        prof.observe("executor.compile_seconds", 0.5)
+        prof.observe(f"trainer.{point}_seconds", 0.1)  # literal subsystem head
+        prof.inc_counter(name_var)                     # non-literal: out of scope
+    """
+    assert _lint(ok) == []
+
+
 def test_suppression_comment():
     src = "def f(x):\n    assert x  # lint: allow\n    return x\n"
     assert _lint(src) == []

@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Observability smoke gate: scrape a live run and validate what it tells.
+
+Runs a short CPU training job (with one injected NaN step so a resilience
+event lands in the runlog) and a short serving burst with the Prometheus
+exporter enabled, then:
+
+- GETs ``/metrics`` once and strictly parses the exposition
+  (``observability.exporter.parse_text_exposition``): every sample typed,
+  histogram ``le`` edges monotone with a ``+Inf`` terminal bucket,
+  ``_sum``/``_count`` consistent;
+- checks the core metric families are present and populated — trainer
+  step-time and serving latency histograms, step/response counters,
+  MFU and goodput gauges;
+- checks ``/healthz`` answers;
+- reads the runlog back (``observability.read_runlog``) and checks every
+  event carries ``ts``/``kind``/``step`` and that step, compile,
+  checkpoint, and resilience event kinds all showed up.
+
+Exit code 0 = the scrape parsed and every contract held; 1 = anything
+missing or malformed. CI-registered next to ``tools/chaos_smoke.py``
+(see README "Observability").
+
+Usage:
+    python tools/obs_smoke.py [--seed N] [--dir DIR] [--keep]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+class ObsFailure(AssertionError):
+    """One of the observability contracts did not hold."""
+
+
+def check(cond, msg: str) -> None:
+    if not cond:
+        raise ObsFailure(msg)
+
+
+def _reader(n_batches=8, bs=8, seed=0):
+    def reader():
+        rng = np.random.RandomState(seed)
+        w = np.array([[2.0], [-1.0], [0.5], [3.0]], np.float32)
+        for _ in range(n_batches):
+            x = rng.randn(bs, 4).astype(np.float32)
+            yield x, x @ w + 0.1
+    return reader
+
+
+def _train_phase(work: str, seed: int) -> None:
+    import paddle_tpu as pt
+    from paddle_tpu.resilience import ResilienceConfig, faults
+
+    def net(x, y):
+        pred = pt.layers.fc(x, size=1)
+        return pt.layers.mean((pred - y) ** 2)
+
+    with faults.injected(
+        # one NaN step so nan_skip + fault_injected land in the runlog
+        faults.FaultSpec(faults.TRAINER_STEP, "nan", after=3, times=1),
+        seed=seed,
+    ) as plan:
+        trainer = pt.Trainer(
+            lambda: net, lambda: pt.optimizer.SGD(learning_rate=0.1),
+            checkpoint_config=pt.CheckpointConfig(
+                os.path.join(work, "ckpt"), step_interval=4),
+            resilience=ResilienceConfig(nan_policy="skip_step"),
+            observability=pt.ObservabilityConfig(
+                metrics_port=0,  # ephemeral port, read back from server()
+                runlog_path=os.path.join(work, "run.jsonl")),
+        )
+        trainer.train(num_epochs=1, reader=_reader(seed=seed))
+        check(plan.all_fired(), f"NaN fault never fired: {plan.stats()}")
+    print(f"[obs] train: {trainer.global_step} steps, "
+          f"{trainer.bad_steps} skipped")
+
+
+def _serving_phase(seed: int) -> None:
+    import paddle_tpu as pt
+    from paddle_tpu.reader.feeder import FeedSpec
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+
+    def net(x):
+        return pt.layers.fc(x, size=3)
+
+    rng = np.random.RandomState(seed)
+    model = pt.build(net)
+    variables = model.init(0, rng.randn(2, 5).astype(np.float32))
+    engine = ServingEngine(
+        model, variables, [FeedSpec("x", (5,), "float32")],
+        config=ServingConfig(max_batch_size=4, max_queue_delay_s=0.002),
+    )
+    try:
+        x = rng.randn(1, 5).astype(np.float32)
+        for _ in range(20):
+            out = engine.infer({"x": x})
+            check(np.asarray(out).shape == (1, 3), "bad serving output")
+        print(f"[obs] serving: engine={engine.metrics.engine_label} "
+              f"requests={engine.metrics.requests_total}")
+    finally:
+        unjoined = engine.close(timeout=30)
+        check(not unjoined, f"threads failed to join on close: {unjoined}")
+
+
+def _scrape_phase() -> None:
+    import paddle_tpu as pt
+    from paddle_tpu.observability.exporter import parse_text_exposition
+
+    srv = pt.observability.server()
+    check(srv is not None, "exporter not running after setup(metrics_port=0)")
+
+    health = json.loads(urllib.request.urlopen(
+        srv.url + "/healthz", timeout=10).read().decode("utf-8"))
+    check(health == {"status": "ok"}, f"bad /healthz answer: {health}")
+
+    body = urllib.request.urlopen(
+        srv.url + "/metrics", timeout=10).read().decode("utf-8")
+    families = parse_text_exposition(body)  # raises ExpositionError on bad text
+
+    for fam, kind in (
+        ("trainer_step_seconds", "histogram"),
+        ("serving_request_latency_seconds", "histogram"),
+        ("trainer_steps_total", "counter"),
+        ("serving_responses_total", "counter"),
+        ("executor_compiles_total", "counter"),
+        ("checkpoint_saves_total", "counter"),
+        ("trainer_mfu", "gauge"),
+        ("trainer_goodput_frac", "gauge"),
+    ):
+        check(fam in families, f"family {fam!r} missing from /metrics")
+        check(families[fam]["type"] == kind,
+              f"{fam}: type {families[fam]['type']!r} != {kind!r}")
+        check(families[fam]["samples"], f"{fam}: no samples")
+
+    def _value(fam):
+        return families[fam]["samples"][0][2]
+
+    check(_value("trainer_mfu") > 0, "trainer_mfu not positive")
+    check(0.0 < _value("trainer_goodput_frac") <= 1.0,
+          f"goodput out of range: {_value('trainer_goodput_frac')}")
+    count = [v for (n, _, v) in families["trainer_step_seconds"]["samples"]
+             if n == "trainer_step_seconds_count"]
+    check(count and count[0] > 0, "trainer_step_seconds has no observations")
+    print(f"[obs] scrape: {len(families)} families, "
+          f"mfu={_value('trainer_mfu'):.2e} "
+          f"goodput={_value('trainer_goodput_frac'):.3f}")
+
+
+def _runlog_phase(work: str) -> None:
+    from paddle_tpu.observability import read_runlog
+
+    events = read_runlog(os.path.join(work, "run.jsonl"))
+    check(bool(events), "runlog is empty")
+    for e in events:
+        check("ts" in e and "kind" in e and "step" in e,
+              f"runlog event missing ts/kind/step: {e}")
+    kinds = {e["kind"] for e in events}
+    for want in ("step", "compile", "checkpoint_save", "nan_skip",
+                 "fault_injected"):
+        check(want in kinds, f"runlog missing {want!r} events (have {kinds})")
+    step_ev = next(e for e in events if e["kind"] == "step")
+    for field in ("loss", "step_time_s", "examples_per_sec"):
+        check(field in step_ev, f"step event missing {field!r}: {step_ev}")
+    print(f"[obs] runlog: {len(events)} events, kinds={sorted(kinds)}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--dir", default=None,
+                    help="work dir (default: a fresh temp dir)")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the work dir for inspection")
+    args = ap.parse_args(argv)
+
+    work = args.dir or tempfile.mkdtemp(prefix="paddle_tpu_obs_")
+    try:
+        _train_phase(work, args.seed)
+        _serving_phase(args.seed)
+        _scrape_phase()
+        _runlog_phase(work)
+    except ObsFailure as e:
+        print(f"[obs] FAIL: {e}", file=sys.stderr)
+        return 1
+    finally:
+        import paddle_tpu as pt
+
+        pt.observability.shutdown()
+        if not args.keep and args.dir is None:
+            shutil.rmtree(work, ignore_errors=True)
+    print("[obs] OK: exposition valid, families populated, runlog complete")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
