@@ -113,43 +113,52 @@ def _bench_step_inner(spec, batch_size: int, warmup: int, iters: int,
     import jax
     import numpy as np
 
+    from paddle_tpu import tracing
     from paddle_tpu.core import profiler as prof
 
     rng = np.random.RandomState(rng_seed)
-    batch = spec.synth_batch(batch_size, rng)
+    with tracing.start_span("bench.data_wait", model=spec.name):
+        batch = spec.synth_batch(batch_size, rng)
     variables = spec.model.init(0, *batch)
     opt = spec.optimizer()
     opt_state = opt.create_state(variables.params)
     step = jax.jit(opt.minimize(spec.model), donate_argnums=(0, 1))
-    dev_batch = tuple(jax.device_put(np.asarray(b)) for b in batch)
+    with tracing.start_span("bench.h2d", model=spec.name):
+        dev_batch = tuple(jax.device_put(np.asarray(b)) for b in batch)
     key = jax.random.PRNGKey(rng_seed)  # dropout etc. in train mode
 
     lowered = step.lower(variables, opt_state, *dev_batch, rng=key)
     t_c = time.perf_counter()
-    compiled = lowered.compile()
+    with tracing.start_span("bench.compile", model=spec.name):
+        compiled = lowered.compile()
     dt_c = time.perf_counter() - t_c
     prof.inc_counter("bench.compiles_total")
     prof.inc_counter("bench.compile_seconds_total", dt_c)
     prof.observe("bench.compile_seconds", dt_c)
     flops = _cost_flops(compiled)
     mem = _mem_stats(compiled)
+    # compile-time HBM plan into device.hbm.executable_* gauges
+    tracing.record_executable_memory(compiled, f"bench.{spec.name}")
 
     v, o = variables, opt_state
     out = None
-    for _ in range(warmup):
-        out = compiled(v, o, *dev_batch, rng=key)
-        v, o = out.variables, out.opt_state
-    if out is not None:
-        # device_get forces a real device->host fetch: on the remote-tunnel
-        # ('axon') platform block_until_ready can return before execution
-        # finishes, which inflated throughput ~8x in earlier runs
-        float(jax.device_get(out.loss))
+    with tracing.start_span("bench.step", model=spec.name, warmup=True):
+        for _ in range(warmup):
+            out = compiled(v, o, *dev_batch, rng=key)
+            v, o = out.variables, out.opt_state
+        if out is not None:
+            # device_get forces a real device->host fetch: on the
+            # remote-tunnel ('axon') platform block_until_ready can return
+            # before execution finishes, which inflated throughput ~8x in
+            # earlier runs
+            float(jax.device_get(out.loss))
 
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = compiled(v, o, *dev_batch, rng=key)
-        v, o = out.variables, out.opt_state
-    float(jax.device_get(out.loss))
+    with tracing.start_span("bench.step", model=spec.name):
+        for _ in range(iters):
+            out = compiled(v, o, *dev_batch, rng=key)
+            v, o = out.variables, out.opt_state
+        float(jax.device_get(out.loss))
     dt = (time.perf_counter() - t0) / iters
     prof.inc_counter("bench.examples_total", batch_size * iters)
     prof.inc_counter("bench.train_seconds_total", dt * iters)
@@ -213,6 +222,18 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
                 if k.endswith("_mfu") and isinstance(v, (int, float))]
         if mfus:
             result["mfu"] = max(mfus)
+        # where the wall time went, from the tracing spans the timed
+        # sections open (bench.* phases, cumulative across all models)
+        from paddle_tpu import tracing
+
+        totals = tracing.phase_totals(
+            ("bench.data_wait", "bench.h2d", "bench.compile", "bench.step"))
+        result["phase_breakdown"] = {
+            "data_wait_s": round(totals.get("bench.data_wait", 0.0), 3),
+            "h2d_s": round(totals.get("bench.h2d", 0.0), 3),
+            "compile_s": round(totals.get("bench.compile", 0.0), 3),
+            "step_s": round(totals.get("bench.step", 0.0), 3),
+        }
 
     def checkpoint_result():
         """Interim JSON after each section: if the wall-clock budget kills

@@ -75,6 +75,7 @@ from paddle_tpu import resilience
 from paddle_tpu.resilience import ResilienceConfig
 from paddle_tpu import observability
 from paddle_tpu.observability import ObservabilityConfig
+from paddle_tpu import tracing
 from paddle_tpu.reader.feeder import DataFeeder, FeedSpec
 from paddle_tpu import transpiler
 from paddle_tpu.transpiler import DistributeTranspiler, memory_optimize, release_memory
@@ -145,6 +146,7 @@ __all__ = [
     "ResilienceConfig",
     "observability",
     "ObservabilityConfig",
+    "tracing",
     "CPUPlace",
     "TPUPlace",
 ]

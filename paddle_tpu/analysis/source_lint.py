@@ -20,7 +20,12 @@ rules; this one encodes them:
   ``observe`` call sites must be dotted ``subsystem.snake_case``
   (``trainer.steps_total``): the observability exporter groups families
   by subsystem prefix and a flat or CamelCase name silently lands
-  outside every dashboard query.
+  outside every dashboard query;
+* ``span-name`` — span/event names at ``record_event``/``start_span``/
+  ``start_trace``/``record_span`` call sites follow the same dotted
+  lowercase convention (``serving.execute``): the merged Chrome-trace
+  export and ``phase_totals`` group timeline rows by that prefix, and a
+  free-form name fragments the timeline.
 
 Runnable as ``python -m paddle_tpu.analysis`` and over the whole tree in
 ``tests/test_source_lint.py`` (so the gate rides tier-1). Suppress a
@@ -63,6 +68,11 @@ _METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
 # literal head must stay inside the legal alphabet (no "name:{var}" keys —
 # variable parts belong in labels=, not baked into the family name)
 _METRIC_PREFIX_RE = re.compile(r"^[a-z][a-z0-9_]*\.[a-z0-9_.]*$")
+
+# span/event entry points whose first argument is a timeline name; the
+# naming convention matches metrics (dotted lowercase) so the Chrome-trace
+# export and phase_totals() group rows by subsystem prefix
+_SPAN_FNS = ("record_event", "start_span", "start_trace", "record_span")
 
 
 def default_roots() -> List[str]:
@@ -253,6 +263,7 @@ class _Linter(ast.NodeVisitor):
                         node,
                     )
         self._check_metric_name(node)
+        self._check_span_name(node)
         self.generic_visit(node)
 
     def _check_metric_name(self, node: ast.Call) -> None:
@@ -285,6 +296,39 @@ class _Linter(ast.NodeVisitor):
                     "f-string metric name must start with a literal "
                     "'subsystem.' prefix (prefer a fixed name plus labels= "
                     "for the variable part)",
+                    node,
+                )
+
+    def _check_span_name(self, node: ast.Call) -> None:
+        """span-name: record_event/start_span/start_trace/record_span with a
+        literal name must use dotted lowercase (``serving.execute``). Same
+        f-string rule as metrics: the literal head must carry a dotted
+        prefix so the timeline row still groups by subsystem."""
+        chain = _dotted(node.func)
+        if not chain or chain.rsplit(".", 1)[-1] not in _SPAN_FNS:
+            return
+        if not node.args:
+            return
+        arg0 = node.args[0]
+        if isinstance(arg0, ast.Constant) and isinstance(arg0.value, str):
+            if not _METRIC_NAME_RE.match(arg0.value):
+                self._diag(
+                    "span-name",
+                    f"span name {arg0.value!r} is not dotted lowercase "
+                    "(e.g. 'serving.execute'); free-form names fragment the "
+                    "merged trace timeline and phase_totals() grouping",
+                    node,
+                )
+        elif isinstance(arg0, ast.JoinedStr):
+            head = arg0.values[0] if arg0.values else None
+            if not (isinstance(head, ast.Constant)
+                    and isinstance(head.value, str)
+                    and _METRIC_PREFIX_RE.match(head.value)):
+                self._diag(
+                    "span-name",
+                    "f-string span name must start with a literal "
+                    "'subsystem.' prefix (put the variable part in span "
+                    "attributes, not the name)",
                     node,
                 )
 

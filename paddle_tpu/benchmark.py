@@ -220,7 +220,7 @@ def run_benchmark(args) -> dict:
         ctx = (
             jax.profiler.trace(args.profile_dir)
             if profiled
-            else prof.record_event(f"benchmark_pass_{pass_id}")
+            else prof.record_event(f"benchmark.pass_{pass_id}")
         )
         t0 = time.perf_counter()
         with ctx:
@@ -230,10 +230,10 @@ def run_benchmark(args) -> dict:
                 # device_tracer correlated kernel/memcpy timeline)
                 prof.enable_profiler()
                 for _ in range(args.iterations):
-                    with prof.record_event("step_dispatch"):
+                    with prof.record_event("benchmark.step_dispatch"):
                         out = step(variables, opt_state)
                         variables, opt_state = out.variables, out.opt_state
-                    with prof.record_event("device_wait"):
+                    with prof.record_event("benchmark.device_wait"):
                         float(jax.device_get(out.loss))
             else:
                 for _ in range(args.iterations):

@@ -62,6 +62,12 @@ class Flags:
     # per-device peak FLOP/s override for MFU accounting (0 = use the
     # device-kind table in observability/mfu.py)
     peak_flops: float = 0.0
+    # tracing: bounded in-memory span store size (oldest spans evicted;
+    # evictions counted under tracing.spans_evicted)
+    trace_max_spans: int = 200_000
+    # straggler detector: flag a replica/step whose duration exceeds the
+    # group median by this ratio (see paddle_tpu.tracing.straggler)
+    straggler_ratio: float = 2.5
 
     @staticmethod
     def _coerce(value: str, typ):

@@ -96,6 +96,30 @@ def record_event(name: str) -> Iterator[None]:
         if tid not in _thread_names:
             _thread_names[tid] = threading.current_thread().name
         _spans.append((name, t0 * 1e6, (t1 - t0) * 1e6, tid))
+    else:
+        # the cap protects memory, but a silently truncated timeline is a
+        # debugging trap — count every drop and say so once per window
+        inc_counter("profiler.spans_dropped")
+        from paddle_tpu.core import logging as ptlog
+
+        ptlog.warn_once(
+            "profiler.spans_dropped",
+            "profiler: span buffer full (%d spans); further spans dropped — "
+            "the exported timeline is truncated (reset_profiler() or export "
+            "more often)",
+            _MAX_SPANS,
+        )
+
+
+def spans() -> list[tuple[str, float, float, int]]:
+    """Snapshot of recorded host spans as (name, start_us, dur_us, tid) —
+    consumed by the merged exporter in ``paddle_tpu.tracing.export``."""
+    return list(_spans)
+
+
+def thread_names() -> dict[int, str]:
+    """Snapshot of the tid → thread-name map captured alongside spans."""
+    return dict(_thread_names)
 
 
 def enable_profiler() -> None:

@@ -50,10 +50,16 @@ class _InstrumentedCompiled:
         t0 = time.perf_counter()
         out = self._fn(*args, **kwargs)
         if self._fn._cache_size() > before:
-            dt = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            dt = t1 - t0
             prof.inc_counter("executor.compiles_total")
             prof.observe("executor.compile_seconds", dt)
             runlog.emit("compile", target=self._label, seconds=round(dt, 6))
+            from paddle_tpu import tracing
+
+            # parents under the caller's active span (a trainer step, a
+            # serving warmup), so compiles show up inside the step trace
+            tracing.record_span("executor.compile", t0, t1, target=self._label)
         return out
 
     def __getattr__(self, name):
@@ -132,7 +138,7 @@ class Executor:
         compiled = self.prepare(
             fn, donate_argnums=donate_argnums, static_argnums=static_argnums
         )
-        with prof.record_event(f"executor.run:{getattr(fn, '__name__', 'fn')}"):
+        with prof.record_event(f"executor.run.{getattr(fn, '__name__', 'fn')}"):
             out = compiled(*args, **kwargs)
         if fetch:
             out = jax.device_get(out)

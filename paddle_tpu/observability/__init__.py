@@ -9,7 +9,12 @@ Four pieces, layered on the counter/gauge bridge in ``core.profiler``:
 - :mod:`~paddle_tpu.observability.mfu` — MFU from XLA ``cost_analysis()``
   FLOPs vs. per-device peak, plus goodput/badput accounting;
 - :mod:`~paddle_tpu.observability.exporter` — stdlib Prometheus
-  ``/metrics`` + ``/healthz`` HTTP endpoint.
+  ``/metrics`` + ``/healthz`` HTTP endpoint, plus ``/runlog/tail?n=`` and
+  ``/trace`` debug endpoints (last runlog events / merged Chrome trace).
+
+Cross-cutting: when :mod:`paddle_tpu.tracing` is imported, every runlog
+event emitted inside an active span carries ``trace_id``/``span_id``
+fields, and ``device.hbm.*`` gauge families join the scrape.
 
 Enable by flags (``PADDLE_TPU_METRICS_PORT=9100``,
 ``PADDLE_TPU_RUNLOG_PATH=run.jsonl``) or explicitly::

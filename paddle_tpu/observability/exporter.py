@@ -17,11 +17,13 @@ malformed lines, non-monotone ``le`` edges, and missing ``+Inf`` buckets.
 
 from __future__ import annotations
 
+import json
 import math
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
 
 from paddle_tpu.core import logging as ptlog
 from paddle_tpu.observability import metrics as obs_metrics
@@ -226,7 +228,8 @@ class _Handler(BaseHTTPRequestHandler):
     registry: obs_metrics.MetricRegistry = None  # set per-server subclass
 
     def do_GET(self):  # noqa: N802 (http.server API)
-        path = self.path.split("?", 1)[0]
+        split = urlsplit(self.path)
+        path, query = split.path, parse_qs(split.query)
         if path == "/metrics":
             body = render_text(self.registry).encode("utf-8")
             self.send_response(200)
@@ -234,6 +237,14 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/healthz":
             body = b'{"status":"ok"}\n'
             self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        elif path == "/runlog/tail":
+            body, status = self._runlog_tail(query)
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+        elif path == "/trace":
+            body, status = self._trace()
+            self.send_response(status)
             self.send_header("Content-Type", "application/json")
         else:
             body = b"not found\n"
@@ -243,12 +254,51 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    @staticmethod
+    def _runlog_tail(query) -> Tuple[bytes, int]:
+        """Last ``n`` runlog events (default 50) as a JSON array — the
+        quick "what just happened" debug view next to /metrics."""
+        from paddle_tpu.observability import runlog as _runlog
+
+        try:
+            n = int(query.get("n", ["50"])[0])
+        except ValueError:
+            return (json.dumps({"error": "n must be an integer"}).encode() +
+                    b"\n", 400)
+        if n < 0:
+            return (json.dumps({"error": "n must be >= 0"}).encode() + b"\n",
+                    400)
+        log = _runlog.get_runlog()
+        if log is None:
+            return (json.dumps({"error": "no runlog installed"}).encode() +
+                    b"\n", 404)
+        try:
+            events = _runlog.read_runlog(log.path)
+        except (OSError, ValueError) as e:
+            return (json.dumps({"error": str(e)}).encode() + b"\n", 500)
+        return json.dumps(events[-n:] if n else []).encode() + b"\n", 200
+
+    @staticmethod
+    def _trace() -> Tuple[bytes, int]:
+        """The current merged Chrome-trace document — save the response
+        body and load it straight into chrome://tracing / Perfetto."""
+        from paddle_tpu import tracing
+
+        try:
+            doc = tracing.chrome_trace_doc()
+        except Exception as e:  # never take the exporter down with tracing
+            return (json.dumps({"error": repr(e)}).encode() + b"\n", 500)
+        return json.dumps(doc).encode() + b"\n", 200
+
     def log_message(self, fmt, *args):  # quiet: route through framework log
         ptlog.vlog(2, "metrics exporter: " + fmt, *args)
 
 
 class MetricsServer:
-    """Daemon-thread HTTP server exposing ``/metrics`` and ``/healthz``."""
+    """Daemon-thread HTTP server exposing ``/metrics`` and ``/healthz``,
+    plus two debug endpoints: ``/runlog/tail?n=`` (last n runlog events as
+    JSON) and ``/trace`` (the current merged Chrome-trace document from
+    ``paddle_tpu.tracing``)."""
 
     def __init__(self, registry: Optional[obs_metrics.MetricRegistry] = None,
                  host: str = "127.0.0.1", port: int = 0):

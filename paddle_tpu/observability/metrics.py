@@ -311,3 +311,27 @@ _default = MetricRegistry()
 def default_registry() -> MetricRegistry:
     """The process-wide registry every subsystem writes into."""
     return _default
+
+
+def declare_tracing_families(registry: Optional[MetricRegistry] = None) -> None:
+    """Pre-declare the tracing/device-telemetry counter and gauge families
+    with help text, so the very first scrape shows typed declarations even
+    before a sample lands (histograms are left to declare-on-first-observe:
+    an observation-free histogram family is not renderable). Called by
+    ``paddle_tpu.tracing`` at import."""
+    r = registry or default_registry()
+    r.gauge("device.hbm.bytes_in_use",
+            "Live HBM bytes per device (PJRT memory_stats, or live-array "
+            "accounting on backends without it)")
+    r.gauge("device.hbm.peak_bytes_in_use", "Peak HBM bytes per device")
+    r.gauge("device.hbm.bytes_limit", "HBM capacity per device")
+    r.gauge("device.hbm.executable_peak_bytes",
+            "XLA memory_analysis peak for one compiled executable")
+    r.counter("tracing.straggler.flags_total",
+              "Straggler detections per (group, key)")
+    r.gauge("tracing.straggler.skew_ratio",
+            "Latest observed skew ratio per (group, key)")
+    r.counter("tracing.spans_evicted",
+              "Spans evicted from the bounded in-memory span store")
+    r.counter("profiler.spans_dropped",
+              "Host profiler spans dropped after the span buffer filled")

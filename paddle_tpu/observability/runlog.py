@@ -29,7 +29,23 @@ from typing import Any, Dict, List, Optional
 
 from paddle_tpu.core import enforce
 
-__all__ = ["RunLog", "set_runlog", "get_runlog", "emit", "read_runlog"]
+__all__ = [
+    "RunLog", "set_runlog", "get_runlog", "emit", "read_runlog",
+    "set_context_provider",
+]
+
+# Optional callable returning extra fields to stamp on every event — the
+# tracing package installs one at import that returns the emitting thread's
+# active {trace_id, span_id}, so runlog lines correlate with spans without
+# runlog ever importing tracing (which imports observability).
+_context_provider = None
+
+
+def set_context_provider(provider) -> None:
+    """Install a ``() -> Optional[dict]`` whose fields are merged into
+    every emitted event (explicit fields win). ``None`` clears it."""
+    global _context_provider
+    _context_provider = provider
 
 
 def _json_default(obj):
@@ -56,6 +72,14 @@ class RunLog:
 
     def emit(self, kind: str, step: Optional[int] = None, **fields: Any) -> None:
         record: Dict[str, Any] = {"ts": time.time(), "kind": kind, "step": step}
+        provider = _context_provider
+        if provider is not None:
+            try:
+                ctx_fields = provider()
+            except Exception:
+                ctx_fields = None
+            if ctx_fields:
+                record.update(ctx_fields)
         record.update(fields)
         line = json.dumps(record, default=_json_default)
         with self._lock:

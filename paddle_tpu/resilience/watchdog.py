@@ -123,14 +123,32 @@ class StepWatchdog:
                 self._cond.release()
                 try:  # log + callback outside the lock: they may be slow
                     prof.inc_counter("resilience.watchdog_stalls")
+                    # which spans every thread was inside when it wedged —
+                    # the trace-level complement of the Python stacks below
+                    open_spans = self._active_span_summary()
                     runlog.emit("watchdog_stall", tag=tag,
-                                elapsed_s=round(elapsed, 3))
+                                elapsed_s=round(elapsed, 3),
+                                open_spans=open_spans)
                     ptlog.error(
                         "watchdog: %s exceeded %.1fs (%.1fs elapsed); "
-                        "thread stacks:\n%s",
-                        tag, self.timeout_s, elapsed, dump,
+                        "open spans: %s; thread stacks:\n%s",
+                        tag, self.timeout_s, elapsed,
+                        ", ".join(open_spans) or "none", dump,
                     )
                     if self.on_stall is not None:
                         self.on_stall(tag, elapsed)
                 finally:
                     self._cond.acquire()
+
+    @staticmethod
+    def _active_span_summary() -> list:
+        """Open tracing spans across all threads, as 'name@thread (Xs)'."""
+        try:
+            from paddle_tpu import tracing
+        except Exception:  # pragma: no cover - defensive
+            return []
+        now_us = time.perf_counter() * 1e6
+        return [
+            f"{sp.name}@{sp.thread_name} ({(now_us - sp.t0_us) / 1e6:.1f}s)"
+            for sp in tracing.active_spans()
+        ]

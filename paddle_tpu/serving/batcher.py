@@ -81,6 +81,13 @@ class MicroBatcher:
                         group = groups.setdefault(req.sig, Group(req.sig, now))
                     group.requests.append(req)
                     group.rows += req.n
+                    try:
+                        # tracing mark: end of the request's queue wait.
+                        # perf_counter (the span timebase), NOT self._clock —
+                        # tests inject fake clocks for the delay policy.
+                        req.t_grouped_pc = time.perf_counter()
+                    except AttributeError:
+                        pass  # tests batch plain fake objects with __slots__
                     if group.rows >= self._max_rows:
                         self._flush(groups.pop(req.sig))
             # flush whatever has aged past the delay budget

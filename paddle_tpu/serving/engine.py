@@ -43,6 +43,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 import jax
 import numpy as np
 
+from paddle_tpu import tracing
 from paddle_tpu.concurrency import Channel, ChannelClosedError, go
 from paddle_tpu.core import config as cfg
 from paddle_tpu.core import logging as ptlog
@@ -115,15 +116,23 @@ class ServingConfig:
     # successive re-trips back off exponentially up to the max
     replica_cooldown_s: float = 1.0
     replica_max_cooldown_s: float = 30.0
+    # flag a replica whose execute durations exceed the cross-replica
+    # baseline by this ratio (None = the straggler_ratio flag; see
+    # paddle_tpu.tracing.straggler)
+    straggler_ratio: Optional[float] = None
 
 
 class PendingResult:
-    """Future-like handle for one submitted request."""
+    """Future-like handle for one submitted request. ``trace`` carries the
+    request's root :class:`~paddle_tpu.tracing.SpanContext` so callers can
+    reconstruct the request's span tree (``tracing.spans_for_trace``) or
+    propagate it onward (``trace.to_traceparent()``)."""
 
     def __init__(self):
         self._event = threading.Event()
         self._value: Any = None
         self._error: Optional[BaseException] = None
+        self.trace: Optional[tracing.SpanContext] = None
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -145,7 +154,8 @@ class PendingResult:
 
 
 class _Request:
-    __slots__ = ("arrays", "n", "sig", "deadline", "t_submit", "pending")
+    __slots__ = ("arrays", "n", "sig", "deadline", "t_submit", "pending",
+                 "trace", "t_enqueue_pc", "t_grouped_pc", "t_dispatch_pc")
 
     def __init__(self, arrays, n, sig, deadline, t_submit):
         self.arrays = arrays
@@ -154,6 +164,15 @@ class _Request:
         self.deadline = deadline
         self.t_submit = t_submit
         self.pending = PendingResult()
+        # tracing: root context + perf_counter marks (t_submit stays on
+        # time.monotonic for deadline math; spans share the profiler
+        # timebase). t_dispatch_pc is stamped by the router BEFORE the
+        # replica-channel send; the worker turns it into the
+        # serving.dispatch span.
+        self.trace: Optional[tracing.SpanContext] = None
+        self.t_enqueue_pc: Optional[float] = None
+        self.t_grouped_pc: Optional[float] = None
+        self.t_dispatch_pc: Optional[float] = None
 
 
 class _ReplicaPlace(cfg.Place):
@@ -221,6 +240,10 @@ class ServingEngine:
         )
         self.metrics = ServingMetrics(engine_label=self.config.engine_label)
         observability.setup()  # flags-driven exporter/runlog, idempotent
+        # cross-replica skew watch over per-batch execute durations
+        self._straggler = tracing.StragglerDetector(
+            "serving.execute", ratio=self.config.straggler_ratio
+        )
         self._closed = False
         self._close_lock = threading.Lock()
         self._rr = 0  # round-robin cursor (guarded by _pick_lock)
@@ -388,10 +411,21 @@ class ServingEngine:
             deadline_s = self.config.default_deadline_s
         deadline = None if deadline_s is None else now + deadline_s
         req = _Request(arrays, n, sig, deadline, now)
+        if tracing.tracing_enabled():
+            req.trace = tracing.SpanContext.new_trace()
+            req.pending.trace = req.trace
+            req.t_enqueue_pc = time.perf_counter()
         try:
             self._queue.send(req, timeout=timeout)
         except ChannelClosedError:
             raise EngineClosedError("engine is closed") from None
+        if req.trace is not None:
+            # the enqueue span covers any backpressure wait on the bounded
+            # channel — visible queue-pressure in the request's own trace
+            tracing.record_span(
+                "serving.enqueue", req.t_enqueue_pc, time.perf_counter(),
+                parent=req.trace, rows=n,
+            )
         # counted only once accepted: a backpressure rejection (TimeoutError
         # above) never shows up as a request that went missing
         self.metrics.record_submit(n, self._queue.qsize())
@@ -404,8 +438,28 @@ class ServingEngine:
 
     # -- batching / dispatch (batcher thread) ------------------------------
 
+    def _finish_trace(self, req: _Request, t1_pc: float, **attrs) -> None:
+        """Record the request's ROOT span (serving.request) — every
+        completion path runs through exactly one of the three callers
+        (worker success, _expire, _fail_requests), always before the
+        PendingResult is released so a caller that checks the trace right
+        after result() finds it complete."""
+        if req.trace is None:
+            return
+        tracing.record_span(
+            "serving.request", req.t_enqueue_pc, t1_pc, context=req.trace,
+            rows=req.n, engine=self.metrics.engine_label, **attrs,
+        )
+
     def _expire(self, req: _Request) -> None:
         self.metrics.record_timeout()
+        if req.trace is not None:
+            now_pc = time.perf_counter()
+            tracing.record_span(
+                "serving.queue_wait", req.t_enqueue_pc, now_pc,
+                parent=req.trace,
+            )
+            self._finish_trace(req, now_pc, status="deadline_exceeded")
         req.pending._fail(
             DeadlineExceeded(
                 f"request expired after {time.monotonic() - req.t_submit:.3f}s in queue"
@@ -425,6 +479,15 @@ class ServingEngine:
                 live.append(req)
         if not live:
             return
+        t_pad0 = time.perf_counter()
+        for req in live:
+            if req.trace is not None:
+                # queue wait = submit → the moment the batcher grouped it
+                tracing.record_span(
+                    "serving.queue_wait", req.t_enqueue_pc,
+                    req.t_grouped_pc if req.t_grouped_pc is not None else t_pad0,
+                    parent=req.trace,
+                )
         rows = sum(r.n for r in live)
         bucket_b = self.buckets.batch_bucket(rows)
         slots = []
@@ -436,6 +499,13 @@ class ServingEngine:
             col = per_req[0] if len(per_req) == 1 else np.concatenate(per_req, axis=0)
             slots.append(col)
         slots = self.buckets.pad_rows(slots, bucket_b)
+        t_pad1 = time.perf_counter()
+        for req in live:
+            if req.trace is not None:
+                tracing.record_span(
+                    "serving.pad", t_pad0, t_pad1, parent=req.trace,
+                    bucket_rows=bucket_b,
+                )
         self.metrics.record_batch(rows, bucket_b, group.sig)
         self.metrics.set_queue_depth(self._queue.qsize())
         self._send_to_replica(live, slots, bucket_b, attempt=0)
@@ -465,6 +535,13 @@ class ServingEngine:
         """Route one padded batch to a healthy replica; a replica dying
         between pick and send is retried against the others. With no live
         replica left, the callers fail instead of hanging."""
+        t0 = time.perf_counter()
+        for req in live:
+            # stamped BEFORE the send: the send wakes the worker, which can
+            # complete the request before this thread runs again, so the
+            # worker itself records serving.dispatch (see _worker_loop) to
+            # keep every span committed ahead of the result release
+            req.t_dispatch_pc = t0
         exclude = None
         for _ in range(len(self._replicas)):
             rep = self._pick_replica(exclude=exclude)
@@ -479,7 +556,10 @@ class ServingEngine:
 
     def _fail_requests(self, live, exc: BaseException) -> None:
         self.metrics.record_error(len(live))
+        now_pc = time.perf_counter()
         for req in live:
+            self._finish_trace(req, now_pc, status="error",
+                               error=type(exc).__name__)
             req.pending._fail(exc)
 
     # -- execution (replica worker threads) --------------------------------
@@ -496,11 +576,21 @@ class ServingEngine:
 
     def _worker_loop(self, rep: _Replica) -> None:
         for live, slots, bucket_b, attempt in rep.channel:
+            t_exec0 = time.perf_counter()
+            for req in live:
+                if req.trace is not None and req.t_dispatch_pc is not None:
+                    # covers replica pick + the wait on this worker's
+                    # channel; recorded here rather than by the router so
+                    # it cannot land after the request's result is released
+                    tracing.record_span(
+                        "serving.dispatch", req.t_dispatch_pc, t_exec0,
+                        parent=req.trace, replica=rep.index, attempt=attempt,
+                    )
             try:
                 # fault point: a seeded "error" here exercises the breaker
                 # exactly like a real device failure would
                 faults.inject(faults.SERVING_DISPATCH, replica=rep.index)
-                with prof.record_event(f"serving.batch:replica{rep.index}"):
+                with prof.record_event(f"serving.batch.replica{rep.index}"):
                     out = rep.compiled(rep.variables, *slots)
                     out = jax.device_get(out)
             except Exception as e:  # complete, never hang the callers
@@ -522,12 +612,29 @@ class ServingEngine:
                     rep.index,
                 )
                 self.metrics.set_healthy_replicas(self._count_healthy())
+            t_exec1 = time.perf_counter()
+            for req in live:
+                if req.trace is not None:
+                    tracing.record_span(
+                        "serving.execute", t_exec0, t_exec1, parent=req.trace,
+                        replica=rep.index, attempt=attempt,
+                        bucket_rows=bucket_b,
+                    )
+            self._straggler.record(f"replica{rep.index}", t_exec1 - t_exec0)
             offset = 0
             now = time.monotonic()
             for req in live:
-                req.pending._complete(
-                    self._slice_out(out, bucket_b, offset, req.n)
-                )
+                sliced = self._slice_out(out, bucket_b, offset, req.n)
+                t_reply = time.perf_counter()
+                if req.trace is not None:
+                    tracing.record_span(
+                        "serving.reply", t_exec1, t_reply, parent=req.trace,
+                    )
+                # root span lands BEFORE the result is released: a caller
+                # inspecting the trace right after result() sees it complete
+                self._finish_trace(req, t_reply, status="ok",
+                                   replica=rep.index)
+                req.pending._complete(sliced)
                 self.metrics.record_response(now - req.t_submit)
                 offset += req.n
 
@@ -553,8 +660,20 @@ class ServingEngine:
         if attempt == 0:
             target = self._pick_replica(exclude=rep)
             if target is not None:
+                t0 = time.perf_counter()
+                for req in live:
+                    req.t_dispatch_pc = t0  # target worker records the span
                 try:
                     target.channel.send((live, slots, bucket_b, 1), timeout=5.0)
+                    t1 = time.perf_counter()
+                    for req in live:
+                        if req.trace is not None:
+                            tracing.record_span(
+                                "serving.redispatch", t0, t1,
+                                parent=req.trace, from_replica=rep.index,
+                                to_replica=target.index,
+                                error=type(exc).__name__,
+                            )
                     self.metrics.record_redispatch()
                     return
                 except (ChannelClosedError, TimeoutError):

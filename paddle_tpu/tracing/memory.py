@@ -1,0 +1,170 @@
+"""Per-device HBM telemetry: live/peak gauges + compiled-executable peaks.
+
+The reference surfaced GPU memory through ``FLAGS_benchmark`` prints in the
+executor (executor.cc:399-401) and CUPTI counters; on TPU the equivalents
+are PJRT's per-device ``memory_stats()`` (live/peak/limit HBM bytes) and
+XLA's per-executable ``memory_analysis()`` (what one compiled program will
+need at peak). Both are sampled here into ``device.hbm.*`` gauge families
+so an impending OOM is visible on the ``/metrics`` scrape *before* the
+allocator raises, and a bounded history of samples feeds counter tracks in
+the merged Chrome-trace export.
+
+CPU backends (tests, laptops) return no ``memory_stats()``; the sampler
+falls back to summing ``nbytes`` over ``jax.live_arrays()`` per device and
+tracks its own running peak, so the gauge families exist — with honest
+``source`` labels — on every platform.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from paddle_tpu.core import profiler as prof
+
+__all__ = [
+    "device_label",
+    "sample_device_memory",
+    "record_executable_memory",
+    "memory_history",
+    "reset_memory_telemetry",
+]
+
+_lock = threading.Lock()
+# live-arrays fallback needs its own running peak — PJRT tracks the real
+# one only when memory_stats() exists
+_live_peak: Dict[str, int] = {}
+# bounded (t_pc_us, device_label, bytes_in_use) history for the trace
+# export's counter track
+_history: "deque[tuple]" = deque(maxlen=4096)
+
+
+def device_label(dev) -> str:
+    """Stable metric label for one jax device, e.g. ``tpu:0``."""
+    return f"{dev.platform}:{dev.id}"
+
+
+def _live_bytes_by_device(devices) -> Dict[str, int]:
+    """Fallback accounting: sum nbytes of every live jax array per device."""
+    import jax
+
+    want = {device_label(d): 0 for d in devices}
+    try:
+        arrays = jax.live_arrays()
+    except Exception:
+        return want
+    for a in arrays:
+        try:
+            for d in a.devices():
+                lbl = device_label(d)
+                if lbl in want:
+                    # sharded arrays: attribute an even split per device
+                    want[lbl] += int(a.nbytes) // max(1, len(a.devices()))
+        except Exception:
+            continue
+    return want
+
+
+def sample_device_memory(devices=None) -> List[dict]:
+    """Sample live/peak/limit HBM bytes for each device into the
+    ``device.hbm.*`` gauge families (labeled ``device=...``). Returns the
+    per-device samples. Called per training step and by the smoke gate."""
+    import jax
+
+    devices = list(devices) if devices is not None else jax.local_devices()
+    now_us = time.perf_counter() * 1e6
+    fallback: Optional[Dict[str, int]] = None
+    samples = []
+    for dev in devices:
+        lbl = device_label(dev)
+        stats = None
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            in_use = int(stats.get("bytes_in_use", 0))
+            peak = int(stats.get("peak_bytes_in_use", in_use))
+            limit = stats.get("bytes_limit")
+            source = "memory_stats"
+        else:
+            if fallback is None:
+                fallback = _live_bytes_by_device(devices)
+            in_use = fallback.get(lbl, 0)
+            with _lock:
+                peak = max(_live_peak.get(lbl, 0), in_use)
+                _live_peak[lbl] = peak
+            limit = None
+            source = "live_arrays"
+        labels = {"device": lbl}
+        prof.set_gauge("device.hbm.bytes_in_use", float(in_use), labels=labels)
+        prof.set_gauge("device.hbm.peak_bytes_in_use", float(peak), labels=labels)
+        if limit is not None:
+            prof.set_gauge("device.hbm.bytes_limit", float(limit), labels=labels)
+        with _lock:
+            _history.append((now_us, lbl, in_use))
+        samples.append({
+            "device": lbl,
+            "bytes_in_use": in_use,
+            "peak_bytes_in_use": peak,
+            "bytes_limit": limit,
+            "source": source,
+        })
+    return samples
+
+
+def record_executable_memory(compiled, target: str) -> Optional[dict]:
+    """Record one compiled executable's memory footprint from XLA's
+    ``memory_analysis()`` into ``device.hbm.executable_*`` gauges (labeled
+    ``target=...``). On backends that report no peak (CPU), the peak is
+    reconstructed as argument + output + temp sizes. Returns the breakdown,
+    or None when the executable exposes no analysis."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return None
+    if mem is None:
+        return None
+
+    def _get(attr):
+        v = getattr(mem, attr, None)
+        try:
+            return int(v) if v is not None else None
+        except (TypeError, ValueError):
+            return None
+
+    arg = _get("argument_size_in_bytes") or 0
+    out = _get("output_size_in_bytes") or 0
+    tmp = _get("temp_size_in_bytes") or 0
+    gen = _get("generated_code_size_in_bytes") or 0
+    peak = _get("peak_memory_in_bytes")
+    if not peak:
+        peak = arg + out + tmp
+    labels = {"target": target}
+    prof.set_gauge("device.hbm.executable_peak_bytes", float(peak), labels=labels)
+    prof.set_gauge("device.hbm.executable_temp_bytes", float(tmp), labels=labels)
+    prof.set_gauge("device.hbm.executable_argument_bytes", float(arg), labels=labels)
+    prof.set_gauge("device.hbm.executable_output_bytes", float(out), labels=labels)
+    return {
+        "target": target,
+        "peak_bytes": peak,
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "temp_bytes": tmp,
+        "generated_code_bytes": gen,
+    }
+
+
+def memory_history() -> List[tuple]:
+    """Snapshot of (t_pc_us, device_label, bytes_in_use) samples for the
+    merged trace export's per-device counter track."""
+    with _lock:
+        return list(_history)
+
+
+def reset_memory_telemetry() -> None:
+    with _lock:
+        _live_peak.clear()
+        _history.clear()

@@ -1,0 +1,140 @@
+"""Straggler detection over per-replica / per-step durations.
+
+On a TPU pod one slow participant sets the pace for everyone: a serving
+replica with a flaky host drags every batch routed to it, a device whose
+steps degrade throttles the whole data-parallel step (GDP, arxiv
+1910.01578, builds its placement decisions on exactly this per-device
+timing attribution). The detector consumes the same durations the tracing
+spans measure and flags two shapes of skew:
+
+* **spatial** — several keys report the same kind of duration (one per
+  serving replica): a key whose recent mean exceeds the median of all key
+  means by ``ratio`` is a straggler relative to its peers.
+* **temporal** — only one key reports (a single-host trainer's step time):
+  an observation exceeding the key's own recent median by ``ratio`` is a
+  straggler relative to its past.
+
+Flags are exported three ways so every consumer sees them: a
+``tracing.straggler.flags_total`` counter and ``tracing.straggler.skew_ratio``
+gauge (labeled group/key), a runlog ``straggler`` event (which carries the
+active trace ids when flagged inside a span), and a ``warn_once`` log line
+per (group, key).
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+from paddle_tpu.core import logging as ptlog
+from paddle_tpu.core import profiler as prof
+from paddle_tpu.core.config import flags
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.observability import runlog
+
+__all__ = ["StragglerDetector"]
+
+
+class StragglerDetector:
+    """Sliding-window skew detector. ``record(key, seconds)`` returns True
+    when that observation was flagged. Thread-safe — serving worker threads
+    record concurrently."""
+
+    def __init__(
+        self,
+        group: str,
+        ratio: Optional[float] = None,
+        window: int = 32,
+        min_samples: int = 5,
+    ):
+        enforce(window >= 2, f"window must be >= 2, got {window}")
+        enforce(min_samples >= 2, f"min_samples must be >= 2, got {min_samples}")
+        self.group = group
+        self.ratio = float(ratio if ratio is not None else flags().straggler_ratio)
+        enforce(self.ratio > 1.0, f"straggler ratio must be > 1.0, got {self.ratio}")
+        self.window = int(window)
+        self.min_samples = int(min_samples)
+        self.flagged: Dict[str, int] = {}  # key -> flag count
+        self._lock = threading.Lock()
+        self._series: Dict[str, deque] = {}
+
+    def record(self, key: str, seconds: float) -> bool:
+        """Record one duration for ``key``; returns True if it was flagged
+        as a straggler."""
+        if seconds < 0:
+            return False
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = self._series[key] = deque(maxlen=self.window)
+            series.append(float(seconds))
+            skew, mode = self._skew_locked(key, float(seconds))
+        if skew is None or skew <= self.ratio:
+            return False
+        self._flag(key, seconds, skew, mode)
+        return True
+
+    def _skew_locked(self, key: str, latest: float):
+        """Skew ratio for the latest observation of ``key``, or (None, _)
+        when there is not enough signal yet."""
+        peers = {
+            k: s for k, s in self._series.items() if len(s) >= self.min_samples
+        }
+        if len(peers) >= 2 and key in peers:
+            # spatial: this key's recent mean against the median of all
+            # keys' means — median (not mean) so one straggler cannot drag
+            # the baseline up and hide itself.
+            means = {k: sum(s) / len(s) for k, s in peers.items()}
+            baseline = statistics.median(means.values())
+            if baseline <= 0:
+                return None, "spatial"
+            return means[key] / baseline, "spatial"
+        series = self._series[key]
+        if len(series) < self.min_samples:
+            return None, "temporal"
+        # temporal: the latest observation against this key's own recent
+        # median (excluding the latest, so a spike cannot inflate its own
+        # baseline).
+        history = list(series)[:-1]
+        baseline = statistics.median(history)
+        if baseline <= 0:
+            return None, "temporal"
+        return latest / baseline, "temporal"
+
+    def _flag(self, key: str, seconds: float, skew: float, mode: str) -> None:
+        with self._lock:
+            self.flagged[key] = self.flagged.get(key, 0) + 1
+        labels = {"group": self.group, "key": key}
+        prof.inc_counter("tracing.straggler.flags_total", labels=labels)
+        prof.set_gauge("tracing.straggler.skew_ratio", round(skew, 4), labels=labels)
+        runlog.emit(
+            "straggler",
+            group=self.group,
+            key=key,
+            mode=mode,
+            seconds=round(seconds, 6),
+            skew_ratio=round(skew, 4),
+            threshold=self.ratio,
+        )
+        ptlog.warn_once(
+            f"straggler[{self.group}/{key}]",
+            "straggler detected: %s %s took %.4fs — %.2fx the %s baseline "
+            "(threshold %.2fx)",
+            self.group, key, seconds, skew, mode, self.ratio,
+        )
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Per-key window stats (count/mean/max) plus flag counts."""
+        with self._lock:
+            out = {}
+            for k, s in self._series.items():
+                vals = list(s)
+                out[k] = {
+                    "count": len(vals),
+                    "mean_s": sum(vals) / len(vals) if vals else 0.0,
+                    "max_s": max(vals) if vals else 0.0,
+                    "flags": self.flagged.get(k, 0),
+                }
+            return out

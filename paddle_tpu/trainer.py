@@ -27,6 +27,7 @@ import numpy as np
 
 from paddle_tpu import checkpoint as ckpt_mod
 from paddle_tpu import observability as obs
+from paddle_tpu import tracing
 from paddle_tpu.checkpoint import CheckpointConfig
 from paddle_tpu.core import logging as ptlog
 from paddle_tpu.core import profiler as prof
@@ -135,10 +136,16 @@ class Trainer:
         self._consec_bad = 0
         self._rollbacks_since_good = 0
         self._watchdog: Optional[StepWatchdog] = None
-        # -- telemetry (paddle_tpu.observability) --------------------------
+        # -- telemetry (paddle_tpu.observability / paddle_tpu.tracing) -----
         self.goodput = obs_mfu.GoodputTracker()
         self._ema_eps: Optional[float] = None  # EMA examples/sec
         self._step_flops: Optional[float] = None  # XLA cost-model FLOPs/step
+        # temporal skew watch over step durations: a step that blows past
+        # this trainer's own recent median gets flagged (per-device spatial
+        # attribution needs one timing per device, which a single-host
+        # pjit step does not expose — the detector accepts external
+        # per-device keys when a multi-host launcher has them)
+        self._straggler = tracing.StragglerDetector("trainer.step")
 
     # -- init / resume ------------------------------------------------------
     def _ensure_initialized(self, first_batch: Sequence[Any]):
@@ -236,50 +243,72 @@ class Trainer:
             for epoch_id in range(self.epoch, num_epochs):
                 self.epoch = epoch_id
                 handler(BeginEpochEvent(epoch_id))
-                for step_id, batch in enumerate(self._batches(reader)):
-                    begin_ev = BeginStepEvent(epoch_id, step_id)
-                    handler(begin_ev)
-                    # fault point: "error" raises here (a crashing step),
-                    # "nan" forces this step to count as non-finite,
-                    # "preempt" delivers SIGTERM (handled at the boundary below)
-                    spec = faults.inject(
-                        faults.TRAINER_STEP, epoch=epoch_id, step=step_id
-                    )
-                    t_step = time.perf_counter()
-                    if self._watchdog is not None:
-                        with self._watchdog.watch(f"epoch {epoch_id} step {step_id}"):
+                # manual next() instead of a for-loop: the wait for the
+                # reader is measured and belongs INSIDE the step's trace
+                batches = iter(self._batches(reader))
+                step_id = -1
+                while True:
+                    t_wait0 = time.perf_counter()
+                    batch = next(batches, None)
+                    t_wait1 = time.perf_counter()
+                    if batch is None:
+                        break
+                    step_id += 1
+                    with tracing.start_trace(
+                        "trainer.step", epoch=epoch_id,
+                    ) as step_span:
+                        # the step trace begins where the data wait began
+                        step_span.t0_us = t_wait0 * 1e6
+                        step_span.set(step=self.global_step)
+                        tracing.record_span("trainer.data_wait", t_wait0, t_wait1)
+                        begin_ev = BeginStepEvent(epoch_id, step_id)
+                        handler(begin_ev)
+                        # fault point: "error" raises here (a crashing step),
+                        # "nan" forces this step to count as non-finite,
+                        # "preempt" delivers SIGTERM (handled at the boundary below)
+                        spec = faults.inject(
+                            faults.TRAINER_STEP, epoch=epoch_id, step=step_id
+                        )
+                        t_step = time.perf_counter()
+                        if self._watchdog is not None:
+                            with self._watchdog.watch(f"epoch {epoch_id} step {step_id}"):
+                                out = self._run_step(batch)
+                        else:
                             out = self._run_step(batch)
-                    else:
-                        out = self._run_step(batch)
-                    bad = (out.finite is not None and not bool(out.finite)) or (
-                        spec is not None and spec.kind == "nan"
-                    )
-                    if bad:
-                        # charge the wasted step to badput even if the policy
-                        # raises below — the accounting outlives the run
-                        self.goodput.record_bad(
-                            time.perf_counter() - t_step, "nan_skip")
-                        # may raise (policy "raise", or rollback gave up)
-                        self._handle_bad_step(epoch_id, step_id)
-                        metrics = float("nan") if begin_ev.fetch_metrics else None
-                    else:
-                        self._consec_bad = 0
-                        self._rollbacks_since_good = 0
-                        self.variables, self.opt_state = out.variables, out.opt_state
-                        self.global_step += 1
-                        # honoring fetch_metrics avoids a host sync per step
-                        # (reference BeginStepEvent.fetch_metrics, trainer.py:158)
-                        metrics = float(out.loss) if begin_ev.fetch_metrics else None
-                        self._record_step(
-                            epoch_id, batch, time.perf_counter() - t_step,
-                            metrics)
-                    handler(EndStepEvent(epoch_id, step_id, metrics))
-                    if self._preempt_requested:
-                        self._preemption_save(next_epoch=epoch_id)
-                        return
-                    self._maybe_checkpoint(epoch_id, step=True)
+                        bad = (out.finite is not None and not bool(out.finite)) or (
+                            spec is not None and spec.kind == "nan"
+                        )
+                        if bad:
+                            step_span.set(status="bad_step")
+                            # charge the wasted step to badput even if the policy
+                            # raises below — the accounting outlives the run
+                            self.goodput.record_bad(
+                                time.perf_counter() - t_step, "nan_skip")
+                            # may raise (policy "raise", or rollback gave up)
+                            self._handle_bad_step(epoch_id, step_id)
+                            metrics = float("nan") if begin_ev.fetch_metrics else None
+                        else:
+                            self._consec_bad = 0
+                            self._rollbacks_since_good = 0
+                            self.variables, self.opt_state = out.variables, out.opt_state
+                            self.global_step += 1
+                            # honoring fetch_metrics avoids a host sync per step
+                            # (reference BeginStepEvent.fetch_metrics, trainer.py:158)
+                            metrics = float(out.loss) if begin_ev.fetch_metrics else None
+                            self._record_step(
+                                epoch_id, batch, time.perf_counter() - t_step,
+                                metrics)
+                        handler(EndStepEvent(epoch_id, step_id, metrics))
+                        if self._preempt_requested:
+                            with tracing.start_span("trainer.checkpoint",
+                                                    reason="preempt"):
+                                self._preemption_save(next_epoch=epoch_id)
+                            return
+                        with tracing.start_span("trainer.checkpoint"):
+                            self._maybe_checkpoint(epoch_id, step=True)
                 handler(EndEpochEvent(epoch_id))
-                self._maybe_checkpoint(epoch_id, step=False)
+                with tracing.start_span("trainer.checkpoint", boundary="epoch"):
+                    self._maybe_checkpoint(epoch_id, step=False)
                 if self._preempt_requested:
                     # the epoch just COMPLETED — resume must not re-train it
                     self._preemption_save(next_epoch=epoch_id + 1)
@@ -337,6 +366,18 @@ class Trainer:
             "step", step=self.global_step, epoch=epoch_id, loss=loss,
             step_time_s=round(dt, 6), examples_per_sec=round(eps, 3),
             ema_examples_per_sec=round(self._ema_eps, 3), **extra)
+        # per-device HBM gauges (device.hbm.*) + temporal straggler watch:
+        # a step far above this trainer's own recent median gets flagged
+        tracing.sample_device_memory(self._devices_in_use())
+        self._straggler.record("step", dt)
+
+    def _devices_in_use(self):
+        if self.parallel and self._dp is not None:
+            mesh = getattr(self._dp, "mesh", None)
+            if mesh is not None:
+                return list(np.ravel(mesh.devices))
+            return jax.local_devices()
+        return [self.exe.device]
 
     def _compute_step_flops(self, batch) -> float:
         """Model FLOPs of one step from XLA's cost analysis — ``lower()``
@@ -542,14 +583,21 @@ class Trainer:
         if self.parallel:
             if getattr(self, "_allow_ragged", False) and \
                     not self._dp.batch_divisible(*batch):
-                return self._dp.step_ragged(
-                    self.variables, self.opt_state,
-                    *[jax.numpy.asarray(b) for b in batch],
-                )
-            dev_batch = self._dp.put_batch(*batch)
-            return self._dp.step(self.variables, self.opt_state, *dev_batch)
+                with tracing.start_span("trainer.h2d"):
+                    args = [jax.numpy.asarray(b) for b in batch]
+                with tracing.start_span("trainer.step_compute", ragged=True):
+                    return self._dp.step_ragged(
+                        self.variables, self.opt_state, *args,
+                    )
+            with tracing.start_span("trainer.h2d"):
+                dev_batch = self._dp.put_batch(*batch)
+            with tracing.start_span("trainer.step_compute"):
+                return self._dp.step(self.variables, self.opt_state, *dev_batch)
         step_fn = self._compiled_step()
-        return step_fn(self.variables, self.opt_state, *[jax.numpy.asarray(b) for b in batch])
+        with tracing.start_span("trainer.h2d"):
+            args = [jax.numpy.asarray(b) for b in batch]
+        with tracing.start_span("trainer.step_compute"):
+            return step_fn(self.variables, self.opt_state, *args)
 
     def _maybe_checkpoint(self, epoch_id: int, step: bool):
         cfg = self.checkpoint_cfg

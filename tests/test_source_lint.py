@@ -148,6 +148,42 @@ def test_metric_name_rule():
     assert _lint(ok) == []
 
 
+def test_span_name_rule():
+    src = """
+    from paddle_tpu.core import profiler as prof
+    from paddle_tpu import tracing
+
+    def run(pass_id):
+        with prof.record_event("step_dispatch"):     # no subsystem prefix
+            pass
+        with tracing.start_span("H2D"):              # CamelCase, no dot
+            pass
+        with tracing.start_trace(f"{pass_id}.step"): # variable prefix
+            pass
+        tracing.record_span(f"bench:pass{pass_id}", 0.0, 1.0)  # colon key
+    """
+    diags = _lint(src)
+    assert _codes(diags).count("span-name") == 4
+    ok = """
+    from paddle_tpu.core import profiler as prof
+    from paddle_tpu import tracing
+
+    def run(pass_id, t0, t1):
+        with prof.record_event("benchmark.step_dispatch"):
+            pass
+        with tracing.start_span("trainer.h2d"):
+            pass
+        with tracing.start_trace("trainer.step", step=pass_id):
+            pass
+        tracing.record_span("serving.execute", t0, t1)
+        with prof.record_event(f"benchmark.pass_{pass_id}"):  # literal head
+            pass
+        with tracing.start_span(name_var):  # non-literal: out of scope
+            pass
+    """
+    assert _lint(ok) == []
+
+
 def test_suppression_comment():
     src = "def f(x):\n    assert x  # lint: allow\n    return x\n"
     assert _lint(src) == []

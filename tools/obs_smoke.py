@@ -15,7 +15,14 @@ exporter enabled, then:
 - checks ``/healthz`` answers;
 - reads the runlog back (``observability.read_runlog``) and checks every
   event carries ``ts``/``kind``/``step`` and that step, compile,
-  checkpoint, and resilience event kinds all showed up.
+  checkpoint, and resilience event kinds all showed up;
+- exports the merged Chrome trace (``tracing.export_chrome_trace``) and
+  reconstructs complete parented span trees from it — one serving request
+  (enqueue → queue_wait → dispatch → execute → reply under a
+  ``serving.request`` root) and one training step (data_wait / h2d /
+  step_compute under ``trainer.step``) — with ``device.hbm.*`` gauges in
+  the scrape and the ``/trace`` + ``/runlog/tail?n=`` debug endpoints
+  answering.
 
 Exit code 0 = the scrape parsed and every contract held; 1 = anything
 missing or malformed. CI-registered next to ``tools/chaos_smoke.py``
@@ -89,7 +96,7 @@ def _train_phase(work: str, seed: int) -> None:
           f"{trainer.bad_steps} skipped")
 
 
-def _serving_phase(seed: int) -> None:
+def _serving_phase(seed: int) -> list:
     import paddle_tpu as pt
     from paddle_tpu.reader.feeder import FeedSpec
     from paddle_tpu.serving import ServingConfig, ServingEngine
@@ -104,16 +111,21 @@ def _serving_phase(seed: int) -> None:
         model, variables, [FeedSpec("x", (5,), "float32")],
         config=ServingConfig(max_batch_size=4, max_queue_delay_s=0.002),
     )
+    trace_ids = []
     try:
         x = rng.randn(1, 5).astype(np.float32)
         for _ in range(20):
-            out = engine.infer({"x": x})
+            pending = engine.submit({"x": x})
+            out = pending.result()
             check(np.asarray(out).shape == (1, 3), "bad serving output")
+            check(pending.trace is not None, "completed request has no trace")
+            trace_ids.append(pending.trace.trace_id)
         print(f"[obs] serving: engine={engine.metrics.engine_label} "
               f"requests={engine.metrics.requests_total}")
     finally:
         unjoined = engine.close(timeout=30)
         check(not unjoined, f"threads failed to join on close: {unjoined}")
+    return trace_ids
 
 
 def _scrape_phase() -> None:
@@ -140,6 +152,8 @@ def _scrape_phase() -> None:
         ("checkpoint_saves_total", "counter"),
         ("trainer_mfu", "gauge"),
         ("trainer_goodput_frac", "gauge"),
+        ("device_hbm_bytes_in_use", "gauge"),
+        ("device_hbm_peak_bytes_in_use", "gauge"),
     ):
         check(fam in families, f"family {fam!r} missing from /metrics")
         check(families[fam]["type"] == kind,
@@ -178,6 +192,95 @@ def _runlog_phase(work: str) -> None:
     print(f"[obs] runlog: {len(events)} events, kinds={sorted(kinds)}")
 
 
+def _trace_phase(work: str, serving_traces: list) -> None:
+    """Reconstruct full span trees — one serving request and one training
+    step — from the MERGED Chrome-trace export (not the in-memory store):
+    the export is what an engineer actually opens in Perfetto, so the
+    contract is checked on that artifact."""
+    import paddle_tpu as pt
+    from paddle_tpu import tracing
+
+    check(bool(serving_traces), "serving phase produced no trace ids")
+
+    # in-memory trees must be structurally valid before export
+    for tid in serving_traces:
+        tree = tracing.spans_for_trace(tid)
+        problems = tracing.validate_trace(tree)
+        check(not problems, f"serving trace {tid} invalid: {problems}")
+
+    path = os.path.join(work, "trace.json")
+    tracing.export_chrome_trace(path)
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)  # must be valid JSON straight off disk
+    counts = tracing.validate_chrome_trace(doc)
+
+    def _tree(trace_id):
+        """span_id -> event for one trace, from the exported doc."""
+        return {
+            ev["args"]["span_id"]: ev
+            for ev in doc["traceEvents"]
+            if ev.get("cat") == "tracing"
+            and ev.get("args", {}).get("trace_id") == trace_id
+        }
+
+    def _check_tree(trace_id, root_name, want_names, label):
+        by_id = _tree(trace_id)
+        check(by_id, f"{label}: trace {trace_id} absent from export")
+        roots = [e for e in by_id.values() if not e["args"].get("parent_id")]
+        check(len(roots) == 1,
+              f"{label}: expected 1 root, got {[e['name'] for e in roots]}")
+        root = roots[0]
+        check(root["name"] == root_name,
+              f"{label}: root is {root['name']!r}, want {root_name!r}")
+        names = {e["name"] for e in by_id.values()}
+        missing = want_names - names
+        check(not missing, f"{label}: spans missing from export: {missing}")
+        for ev in by_id.values():
+            parent = ev["args"].get("parent_id")
+            check(parent is None or parent in by_id,
+                  f"{label}: {ev['name']} has dangling parent {parent}")
+            # monotonic + contained in the root's window
+            check(ev["dur"] >= 0, f"{label}: {ev['name']} negative duration")
+            check(ev["ts"] >= root["ts"] - 1
+                  and ev["ts"] + ev["dur"] <= root["ts"] + root["dur"] + 1000,
+                  f"{label}: {ev['name']} outside root window")
+        return by_id
+
+    # ≥1 serving request reconstructs end-to-end: enqueue → … → reply
+    by_id = _check_tree(
+        serving_traces[0], "serving.request",
+        {"serving.enqueue", "serving.queue_wait", "serving.dispatch",
+         "serving.execute", "serving.reply"},
+        "serving",
+    )
+    order = {e["name"]: e["ts"] for e in by_id.values()}
+    check(order["serving.enqueue"] <= order["serving.execute"]
+          <= order["serving.reply"],
+          f"serving: span order not monotonic: {order}")
+
+    # ≥1 training step reconstructs with its phase children
+    step_roots = [s for s in tracing.spans() if s.name == "trainer.step"]
+    check(bool(step_roots), "no trainer.step traces recorded")
+    _check_tree(
+        step_roots[0].context.trace_id, "trainer.step",
+        {"trainer.data_wait", "trainer.h2d", "trainer.step_compute"},
+        "trainer",
+    )
+
+    # debug endpoints serve the same artifacts over HTTP
+    srv = pt.observability.server()
+    tail = json.loads(urllib.request.urlopen(
+        srv.url + "/runlog/tail?n=5", timeout=10).read().decode("utf-8"))
+    check(isinstance(tail, list) and 0 < len(tail) <= 5,
+          f"/runlog/tail?n=5 returned {type(tail).__name__} len "
+          f"{len(tail) if isinstance(tail, list) else '?'}")
+    http_doc = json.loads(urllib.request.urlopen(
+        srv.url + "/trace", timeout=30).read().decode("utf-8"))
+    check("traceEvents" in http_doc, "/trace response has no traceEvents")
+    print(f"[obs] trace: export valid ({counts}), serving + trainer trees "
+          f"reconstructed, /trace + /runlog/tail answered")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=0)
@@ -190,9 +293,10 @@ def main(argv=None) -> int:
     work = args.dir or tempfile.mkdtemp(prefix="paddle_tpu_obs_")
     try:
         _train_phase(work, args.seed)
-        _serving_phase(args.seed)
+        serving_traces = _serving_phase(args.seed)
         _scrape_phase()
         _runlog_phase(work)
+        _trace_phase(work, serving_traces)
     except ObsFailure as e:
         print(f"[obs] FAIL: {e}", file=sys.stderr)
         return 1
@@ -202,7 +306,8 @@ def main(argv=None) -> int:
         pt.observability.shutdown()
         if not args.keep and args.dir is None:
             shutil.rmtree(work, ignore_errors=True)
-    print("[obs] OK: exposition valid, families populated, runlog complete")
+    print("[obs] OK: exposition valid, families populated, runlog complete, "
+          "traces reconstruct")
     return 0
 
 
