@@ -641,8 +641,13 @@ def serve_main(duration_s: float = 3.0) -> dict:
         result["value"] = round(sum(counts) / dt, 1)
         result["rows_per_sec"] = round(snap["rows_total"] / dt, 1)
         result["batch_occupancy_mean"] = round(snap["mean_batch_occupancy"], 2)
-        result["p50_ms"] = round(snap["p50_ms"], 3)
-        result["p99_ms"] = round(snap["p99_ms"], 3)
+        # histogram-interpolated quantiles over EVERY response (the same
+        # estimator the SLO engine uses), not the bounded reservoir's
+        # nearest-rank points; fall back to the reservoir if empty
+        p50 = engine.metrics.latency_quantile(0.5)
+        p99 = engine.metrics.latency_quantile(0.99)
+        result["p50_ms"] = round((p50 * 1e3) if p50 is not None else snap["p50_ms"], 3)
+        result["p99_ms"] = round((p99 * 1e3) if p99 is not None else snap["p99_ms"], 3)
         result["batches_total"] = snap["batches_total"]
         result["timeouts_total"] = snap["timeouts_total"]
         result["errors_total"] = snap["errors_total"]

@@ -59,6 +59,11 @@ class Flags:
     metrics_host: str = "127.0.0.1"
     # append-only JSONL run-event log path; empty = disabled
     runlog_path: str = ""
+    # size-based runlog rollover: rotate the active file when it would
+    # exceed this many bytes (0 = never rotate), keeping runlog_keep
+    # rotated segments (path.1 .. path.N, oldest dropped)
+    runlog_max_bytes: int = 0
+    runlog_keep: int = 3
     # per-device peak FLOP/s override for MFU accounting (0 = use the
     # device-kind table in observability/mfu.py)
     peak_flops: float = 0.0

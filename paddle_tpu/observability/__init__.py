@@ -76,6 +76,8 @@ class ObservabilityConfig:
     metrics_port: int = -1
     metrics_host: str = "127.0.0.1"
     runlog_path: str = ""
+    runlog_max_bytes: int = 0
+    runlog_keep: int = 3
 
     @staticmethod
     def from_flags() -> "ObservabilityConfig":
@@ -86,6 +88,8 @@ class ObservabilityConfig:
             metrics_port=f.metrics_port,
             metrics_host=f.metrics_host,
             runlog_path=f.runlog_path,
+            runlog_max_bytes=f.runlog_max_bytes,
+            runlog_keep=f.runlog_keep,
         )
 
 
@@ -103,7 +107,9 @@ def setup(config: Optional[ObservabilityConfig] = None) -> Optional[MetricsServe
     config = config or ObservabilityConfig.from_flags()
     with _lock:
         if config.runlog_path and runlog.get_runlog() is None:
-            _owned_runlog = RunLog(config.runlog_path)
+            _owned_runlog = RunLog(config.runlog_path,
+                                   max_bytes=config.runlog_max_bytes,
+                                   keep=config.runlog_keep)
             runlog.set_runlog(_owned_runlog)
         if config.metrics_port >= 0 and _server is None:
             _server = MetricsServer(

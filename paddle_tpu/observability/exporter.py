@@ -33,9 +33,11 @@ __all__ = [
     "parse_text_exposition",
     "MetricsServer",
     "CONTENT_TYPE",
+    "JSON_CONTENT_TYPE",
 ]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+JSON_CONTENT_TYPE = "application/json; charset=utf-8"
 
 _NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _SAMPLE_LINE = re.compile(
@@ -237,15 +239,23 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/healthz":
             body = b'{"status":"ok"}\n'
             self.send_response(200)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", JSON_CONTENT_TYPE)
         elif path == "/runlog/tail":
             body, status = self._runlog_tail(query)
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", JSON_CONTENT_TYPE)
         elif path == "/trace":
             body, status = self._trace()
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", JSON_CONTENT_TYPE)
+        elif path == "/alerts":
+            body, status = self._alerts(query)
+            self.send_response(status)
+            self.send_header("Content-Type", JSON_CONTENT_TYPE)
+        elif path == "/slo":
+            body, status = self._slo()
+            self.send_response(status)
+            self.send_header("Content-Type", JSON_CONTENT_TYPE)
         else:
             body = b"not found\n"
             self.send_response(404)
@@ -279,6 +289,37 @@ class _Handler(BaseHTTPRequestHandler):
         return json.dumps(events[-n:] if n else []).encode() + b"\n", 200
 
     @staticmethod
+    def _alerts(query) -> Tuple[bytes, int]:
+        """Recent alerts from the default :mod:`paddle_tpu.watch` hub as a
+        JSON array (``?n=`` most recent, ``?source=`` filter)."""
+        from paddle_tpu.watch import alerts as _alerts
+
+        try:
+            n = int(query.get("n", ["50"])[0])
+        except ValueError:
+            return (json.dumps({"error": "n must be an integer"}).encode() +
+                    b"\n", 400)
+        if n < 0:
+            return (json.dumps({"error": "n must be >= 0"}).encode() + b"\n",
+                    400)
+        source = query.get("source", [None])[0]
+        hub = _alerts.default_hub()
+        items = [a.as_dict() for a in hub.alerts(n or None, source=source)]
+        return json.dumps(items).encode() + b"\n", 200
+
+    @staticmethod
+    def _slo() -> Tuple[bytes, int]:
+        """Current status of every installed SLO engine's objectives."""
+        from paddle_tpu.watch import slo as _slo
+
+        try:
+            statuses = [s for engine in _slo.installed_engines()
+                        for s in engine.status()]
+        except Exception as e:  # never take the exporter down with watch
+            return (json.dumps({"error": repr(e)}).encode() + b"\n", 500)
+        return json.dumps(statuses).encode() + b"\n", 200
+
+    @staticmethod
     def _trace() -> Tuple[bytes, int]:
         """The current merged Chrome-trace document — save the response
         body and load it straight into chrome://tracing / Perfetto."""
@@ -296,9 +337,11 @@ class _Handler(BaseHTTPRequestHandler):
 
 class MetricsServer:
     """Daemon-thread HTTP server exposing ``/metrics`` and ``/healthz``,
-    plus two debug endpoints: ``/runlog/tail?n=`` (last n runlog events as
-    JSON) and ``/trace`` (the current merged Chrome-trace document from
-    ``paddle_tpu.tracing``)."""
+    plus debug endpoints: ``/runlog/tail?n=`` (last n runlog events as
+    JSON), ``/trace`` (the current merged Chrome-trace document from
+    ``paddle_tpu.tracing``), ``/alerts?n=&source=`` (recent alerts from
+    the ``paddle_tpu.watch`` hub), and ``/slo`` (installed SLO engines'
+    current compliance/burn-rate status)."""
 
     def __init__(self, registry: Optional[obs_metrics.MetricRegistry] = None,
                  host: str = "127.0.0.1", port: int = 0):

@@ -36,6 +36,7 @@ __all__ = [
     "default_registry",
     "exponential_buckets",
     "linear_buckets",
+    "histogram_quantile",
 ]
 
 COUNTER = "counter"
@@ -68,6 +69,34 @@ def linear_buckets(start: float, width: float, count: int) -> Tuple[float, ...]:
     enforce.enforce(width > 0, "linear_buckets: width must be > 0")
     enforce.enforce(count > 0, "linear_buckets: count must be > 0")
     return tuple(float(start) + float(width) * i for i in range(count))
+
+
+def histogram_quantile(edges: Sequence[float], cumulative: Sequence[int],
+                       count: int, q: float) -> float:
+    """Estimate the ``q``-quantile (0 < q < 1) of a histogram from its
+    cumulative bucket counts, interpolating linearly WITHIN the bucket that
+    holds the target rank — the same estimator as PromQL's
+    ``histogram_quantile``, so the value an SLO engine computes offline
+    matches what a dashboard shows. Ranks landing above the last finite
+    edge (the +Inf bucket) clamp to that edge: the histogram carries no
+    upper bound to interpolate toward. Returns 0.0 for an empty histogram.
+    """
+    enforce.enforce(0.0 < q < 1.0, f"quantile q must be in (0, 1), got {q}")
+    if count <= 0 or not edges:
+        return 0.0
+    rank = q * count
+    prev_cum = 0
+    for i, edge in enumerate(edges):
+        cum = cumulative[i]
+        if cum >= rank:
+            lo = 0.0 if i == 0 else float(edges[i - 1])
+            in_bucket = cum - prev_cum
+            if in_bucket <= 0:
+                return float(edge)
+            frac = (rank - prev_cum) / in_bucket
+            return lo + (float(edge) - lo) * frac
+        prev_cum = cum
+    return float(edges[-1])  # rank in the +Inf overflow bucket: clamp
 
 
 def _canon_labels(labels: Optional[Dict[str, str]]) -> LabelTuple:
@@ -141,6 +170,39 @@ class MetricRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._families: Dict[str, _Family] = {}
+        # write subscribers: called AFTER the lock is released with
+        # (name, kind, value, labels_dict) for every inc/set/observe —
+        # the paddle_tpu.watch online detectors feed from this instead of
+        # polling snapshots. Tuple (not list) so the hot-path read is one
+        # attribute load; swap-on-change under the lock.
+        self._subscribers: Tuple = ()
+
+    # -- subscriptions -----------------------------------------------------
+
+    def subscribe(self, fn) -> None:
+        """Register ``fn(name, kind, value, labels)`` to observe every
+        write. Called OUTSIDE the registry lock — a subscriber may itself
+        write metrics (re-entrancy is the subscriber's concern; see
+        ``paddle_tpu.watch.watcher`` for the guard idiom). Exceptions are
+        swallowed: telemetry consumers must never break producers."""
+        with self._lock:
+            if fn not in self._subscribers:
+                self._subscribers = self._subscribers + (fn,)
+
+    def unsubscribe(self, fn) -> None:
+        # equality, not identity: each ``obj.method`` access builds a fresh
+        # bound-method object, and those compare equal but are never ``is``
+        with self._lock:
+            self._subscribers = tuple(
+                s for s in self._subscribers if s != fn)
+
+    def _notify(self, name: str, kind: str, value: float,
+                labels: Optional[Dict[str, str]]) -> None:
+        for fn in self._subscribers:
+            try:
+                fn(name, kind, value, labels)
+            except Exception:
+                pass  # see subscribe(): consumers never break producers
 
     # -- declaration -------------------------------------------------------
 
@@ -199,6 +261,7 @@ class MetricRegistry:
             fam = self._family(name, COUNTER, help)
             key = self._child_key(fam, labels)
             fam.children[key] = fam.children.get(key, 0.0) + value
+        self._notify(name, COUNTER, value, labels)
 
     def set(self, name: str, value: float,
             labels: Optional[Dict[str, str]] = None, help: str = "") -> None:
@@ -206,6 +269,7 @@ class MetricRegistry:
             fam = self._family(name, GAUGE, help)
             key = self._child_key(fam, labels)
             fam.children[key] = float(value)
+        self._notify(name, GAUGE, float(value), labels)
 
     def observe(self, name: str, value: float,
                 labels: Optional[Dict[str, str]] = None, help: str = "") -> None:
@@ -225,6 +289,7 @@ class MetricRegistry:
                 child = _Hist(len(fam.buckets))
                 fam.children[key] = child
             child.observe(fam.buckets, float(value))
+        self._notify(name, HISTOGRAM, float(value), labels)
 
     # -- reads -------------------------------------------------------------
 
@@ -271,14 +336,19 @@ class MetricRegistry:
                     out[name] = float(fam.children[key])
             return out
 
-    def get(self, name: str, labels: Optional[Dict[str, str]] = None) -> float:
-        """Read one counter/gauge child (0.0 when absent)."""
+    def get(self, name: str, labels: Optional[Dict[str, str]] = None,
+            default: Optional[float] = 0.0) -> Optional[float]:
+        """Read one counter/gauge child. ``default`` (0.0) is returned when
+        the family or child is absent — pass ``default=None`` to tell
+        "never written" apart from a real 0.0 (the SLO engine does, so a
+        gauge-bound objective cannot judge a gauge that does not exist yet)."""
         key = _canon_labels(labels)
         with self._lock:
             fam = self._families.get(name)
             if fam is None or fam.kind == HISTOGRAM:
-                return 0.0
-            return float(fam.children.get(key, 0.0))
+                return default
+            child = fam.children.get(key)
+            return default if child is None else float(child)
 
     def histogram_snapshot(self, name: str,
                            labels: Optional[Dict[str, str]] = None) -> Optional[dict]:
@@ -299,8 +369,21 @@ class MetricRegistry:
                 "count": child.count,
             }
 
+    def quantile(self, name: str, q: float,
+                 labels: Optional[Dict[str, str]] = None) -> Optional[float]:
+        """Estimated ``q``-quantile of one histogram child via linear
+        interpolation within buckets (:func:`histogram_quantile`). ``None``
+        when the family/child is absent or empty — callers distinguish "no
+        data yet" from a real 0.0 observation."""
+        snap = self.histogram_snapshot(name, labels)
+        if snap is None or snap["count"] <= 0:
+            return None
+        return histogram_quantile(
+            snap["edges"], snap["cumulative"], snap["count"], q)
+
     def reset(self) -> None:
-        """Drop every family (test isolation)."""
+        """Drop every family (test isolation; subscriptions survive — the
+        watcher outlives registry resets between test cases)."""
         with self._lock:
             self._families.clear()
 

@@ -31,7 +31,7 @@ from paddle_tpu.core import enforce
 
 __all__ = [
     "RunLog", "set_runlog", "get_runlog", "emit", "read_runlog",
-    "set_context_provider",
+    "rotated_paths", "set_context_provider",
 ]
 
 # Optional callable returning extra fields to stamp on every event — the
@@ -59,16 +59,28 @@ def _json_default(obj):
 
 
 class RunLog:
-    """Append-only JSONL event sink (thread-safe, line-buffered)."""
+    """Append-only JSONL event sink (thread-safe, line-buffered).
 
-    def __init__(self, path: str):
+    With ``max_bytes > 0`` the file rolls over by size: when the next line
+    would push the active file past ``max_bytes``, it is renamed to
+    ``path.1`` (older segments shifting to ``path.2`` … ``path.<keep>``,
+    the oldest dropped) and a fresh file is opened. Lines are never split
+    across segments, so every segment parses standalone and
+    :func:`read_runlog` can stitch them back oldest-first."""
+
+    def __init__(self, path: str, max_bytes: int = 0, keep: int = 3):
         enforce.enforce(bool(path), "RunLog: path must be non-empty")
+        enforce.enforce(keep >= 1, f"RunLog: keep must be >= 1, got {keep}")
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
         self.path = path
+        self.max_bytes = int(max_bytes)
+        self.keep = int(keep)
         self._lock = threading.Lock()
         self._fh = open(path, "a", buffering=1)
+        self._size = self._fh.tell()
         self._closed = False
+        self.rotations = 0
 
     def emit(self, kind: str, step: Optional[int] = None, **fields: Any) -> None:
         record: Dict[str, Any] = {"ts": time.time(), "kind": kind, "step": step}
@@ -81,11 +93,29 @@ class RunLog:
             if ctx_fields:
                 record.update(ctx_fields)
         record.update(fields)
-        line = json.dumps(record, default=_json_default)
+        line = json.dumps(record, default=_json_default) + "\n"
         with self._lock:
             if self._closed:
                 return
-            self._fh.write(line + "\n")
+            if (self.max_bytes > 0 and self._size > 0
+                    and self._size + len(line) > self.max_bytes):
+                self._rotate_locked()
+            self._fh.write(line)
+            self._size += len(line)
+
+    def _rotate_locked(self) -> None:
+        self._fh.close()
+        oldest = f"{self.path}.{self.keep}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self.keep - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        self._fh = open(self.path, "a", buffering=1)
+        self._size = 0
+        self.rotations += 1
 
     def close(self) -> None:
         with self._lock:
@@ -118,19 +148,39 @@ def emit(kind: str, step: Optional[int] = None, **fields: Any) -> None:
         log.emit(kind, step=step, **fields)
 
 
-def read_runlog(path: str) -> List[Dict[str, Any]]:
-    """Parse a runlog file back into event dicts (skips blank lines;
-    a torn final line from a crashed writer raises ``ValueError`` with
-    the offending line number)."""
+def rotated_paths(path: str) -> List[str]:
+    """Existing segments for ``path``, oldest first: ``path.N`` … ``path.1``
+    then ``path`` itself (only the ones present on disk)."""
+    rotated = []
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        rotated.append(f"{path}.{i}")
+        i += 1
+    out = list(reversed(rotated))
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
+def read_runlog(path: str, include_rotated: bool = True) -> List[Dict[str, Any]]:
+    """Parse a runlog back into event dicts, reading rotated segments
+    (``path.N`` … ``path.1``) oldest-first before the active file — a
+    reader at a rotation boundary sees one continuous stream. Skips blank
+    lines; a torn line from a crashed writer raises ``ValueError`` with
+    the offending file and line number."""
+    paths = rotated_paths(path) if include_rotated else [path]
+    if not paths:
+        paths = [path]  # nothing on disk: surface the normal FileNotFoundError
     events = []
-    with open(path) as f:
-        for lineno, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                events.append(json.loads(line))
-            except json.JSONDecodeError as e:
-                raise ValueError(
-                    f"{path}:{lineno}: invalid runlog line: {e}") from e
+    for p in paths:
+        with open(p) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError as e:
+                    raise ValueError(
+                        f"{p}:{lineno}: invalid runlog line: {e}") from e
     return events

@@ -130,6 +130,28 @@ class CircuitBreaker:
                 self.recoveries_total += 1
             return recovered
 
+    def trip(self) -> bool:
+        """Force the breaker OPEN regardless of the failure count — the
+        hook ``paddle_tpu.watch`` alerts use to eject a replica whose
+        *latency* (not error rate) went anomalous. Counted as a trip and
+        subject to the same backoff schedule as failure-driven trips.
+        Returns True when this call performed the CLOSED/HALF_OPEN → OPEN
+        transition (False when already OPEN)."""
+        with self._lock:
+            if self._state == OPEN:
+                return False
+            self._state = OPEN
+            self._retry_at = self._clock() + next_backoff(
+                self._open_count,
+                base_delay=self.cooldown_s,
+                max_delay=self.max_cooldown_s,
+                jitter=self.jitter,
+                rng=self._rng,
+            )
+            self._open_count += 1
+            self.trips_total += 1
+            return True
+
     def record_failure(self) -> bool:
         """A dispatch failed. Returns True when this failure TRIPPED the
         breaker open (threshold reached, or a half-open probe failed)."""

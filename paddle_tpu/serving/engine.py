@@ -120,6 +120,14 @@ class ServingConfig:
     # baseline by this ratio (None = the straggler_ratio flag; see
     # paddle_tpu.tracing.straggler)
     straggler_ratio: Optional[float] = None
+    # -- watch layer (paddle_tpu.watch: anomaly detection + SLOs) ----------
+    # attach a MetricWatcher/SloEngine to this engine's metric streams;
+    # None = no watching (watch.WatchConfig(enabled=True) for defaults)
+    watch: Optional[Any] = None
+    # let a per-replica latency-anomaly alert trip that replica's circuit
+    # breaker (same ejection path as consecutive failures) — requires a
+    # watch config with the per-replica exec rule (on by default)
+    anomaly_eject: bool = False
 
 
 class PendingResult:
@@ -244,6 +252,15 @@ class ServingEngine:
         self._straggler = tracing.StragglerDetector(
             "serving.execute", ratio=self.config.straggler_ratio
         )
+        # watch layer: anomaly detectors / SLOs over this engine's metric
+        # streams, attached via config (paddle_tpu.watch)
+        self._watcher = None
+        if self.config.watch is not None:
+            from paddle_tpu import watch as watch_mod
+
+            self._watcher = watch_mod.build(self.config.watch)
+            if self._watcher is not None and self.config.anomaly_eject:
+                self._watcher.hub.register_action(self._on_alert)
         self._closed = False
         self._close_lock = threading.Lock()
         self._rr = 0  # round-robin cursor (guarded by _pick_lock)
@@ -621,6 +638,7 @@ class ServingEngine:
                         bucket_rows=bucket_b,
                     )
             self._straggler.record(f"replica{rep.index}", t_exec1 - t_exec0)
+            self.metrics.record_exec(rep.index, t_exec1 - t_exec0)
             offset = 0
             now = time.monotonic()
             for req in live:
@@ -699,6 +717,43 @@ class ServingEngine:
                 item[0], ReplicaDied(f"replica {rep.index} worker died: {exc!r}")
             )
 
+    def _on_alert(self, alert) -> None:
+        """Alert-hub action (``anomaly_eject=True``): a per-replica latency
+        anomaly trips that replica's breaker — the same ejection/backoff/
+        half-open-probe path consecutive FAILURES take, but driven by the
+        watch layer's latency detector instead of errors. Never ejects the
+        last healthy replica: degraded-but-slow beats down."""
+        if alert.source != "watch.serving.replica_exec_seconds":
+            return
+        if alert.labels.get("engine") != self.metrics.engine_label:
+            return
+        try:
+            index = int(alert.labels.get("replica", ""))
+        except ValueError:
+            return
+        healthy = [r for r in self._replicas
+                   if not r.dead and r.breaker.state == "closed"]
+        for rep in self._replicas:
+            if rep.index != index or rep.dead:
+                continue
+            if len(healthy) <= 1 and rep in healthy:
+                ptlog.warn_once(
+                    ("anomaly-eject-last", self.metrics.engine_label, index),
+                    "not ejecting replica %d on latency anomaly: it is the "
+                    "last healthy replica", index)
+                return
+            if rep.breaker.trip():
+                self.metrics.record_replica_ejection()
+                runlog.emit("breaker_open", replica=rep.index,
+                            engine=self.metrics.engine_label,
+                            error=f"latency anomaly: {alert.message}")
+                ptlog.error(
+                    "serving replica %d ejected on latency anomaly "
+                    "(retry in %.2fs): %s",
+                    rep.index, rep.breaker.retry_in(), alert.message)
+                self.metrics.set_healthy_replicas(self._count_healthy())
+            return
+
     def _count_healthy(self) -> int:
         return sum(
             1 for r in self._replicas if not r.dead and r.breaker.state == "closed"
@@ -751,6 +806,14 @@ class ServingEngine:
                 len(unjoined), timeout, ", ".join(unjoined),
             )
         self.metrics.set_queue_depth(0)
+        if self._watcher is not None:
+            self._watcher.hub.unregister_action(self._on_alert)
+            if self._watcher.slo_engine is not None:
+                from paddle_tpu.watch import slo as _slo
+
+                _slo.uninstall(self._watcher.slo_engine)
+            self._watcher.close()
+            self._watcher = None
         return unjoined
 
     @property

@@ -58,6 +58,10 @@ class ServingMetrics:
             "serving.batch_occupancy",
             help="Real rows / bucket rows per dispatched batch.",
             buckets=obs_metrics.linear_buckets(0.1, 0.1, 10))
+        obs_metrics.default_registry().histogram(
+            "serving.replica_exec_seconds",
+            help="Per-replica device execute duration per batch.",
+            buckets=_LATENCY_BUCKETS)
         self.requests_total = 0
         self.responses_total = 0
         self.timeouts_total = 0
@@ -124,6 +128,12 @@ class ServingMetrics:
     def set_queue_depth(self, depth: int) -> None:
         prof.set_gauge("serving.queue_depth", depth, labels=self._labels)
 
+    def record_exec(self, replica: int, seconds: float) -> None:
+        """Per-replica device execute duration — the series the watch
+        layer's per-replica latency anomaly rule subscribes to."""
+        prof.observe("serving.replica_exec_seconds", seconds,
+                     labels={**self._labels, "replica": str(replica)})
+
     def record_replica_ejection(self) -> None:
         with self._lock:
             self.replica_ejections_total += 1
@@ -164,6 +174,16 @@ class ServingMetrics:
             "p50_ms": _percentile(vals, 50) * 1e3,
             "p99_ms": _percentile(vals, 99) * 1e3,
         }
+
+    def latency_quantile(self, q: float) -> Optional[float]:
+        """Estimated request-latency ``q``-quantile in SECONDS from the
+        ``serving.request_latency_seconds`` histogram (linear interpolation
+        within buckets — the same estimator the SLO engine uses). Unlike
+        the bounded reservoir behind :meth:`latency_percentiles`, this
+        covers every response since engine start. None before any
+        response."""
+        return obs_metrics.default_registry().quantile(
+            "serving.request_latency_seconds", q, labels=self._labels)
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:

@@ -14,25 +14,26 @@ spans measure and flags two shapes of skew:
   an observation exceeding the key's own recent median by ``ratio`` is a
   straggler relative to its past.
 
-Flags are exported three ways so every consumer sees them: a
-``tracing.straggler.flags_total`` counter and ``tracing.straggler.skew_ratio``
-gauge (labeled group/key), a runlog ``straggler`` event (which carries the
-active trace ids when flagged inside a span), and a ``warn_once`` log line
-per (group, key).
+The decision math lives in the shared
+:class:`paddle_tpu.watch.detectors.SkewDetector` core (so the metric
+watcher, tests, and this shell all agree on what "skewed" means); this
+module keeps the reporting. Flags are exported three ways so every
+consumer sees them: a ``tracing.straggler.flags_total`` counter and
+``tracing.straggler.skew_ratio`` gauge (labeled group/key), a runlog
+``straggler`` event (which carries the active trace ids when flagged
+inside a span), and a ``warn_once`` log line per (group, key).
 """
 
 from __future__ import annotations
 
-import statistics
 import threading
-from collections import deque
 from typing import Dict, Optional
 
 from paddle_tpu.core import logging as ptlog
 from paddle_tpu.core import profiler as prof
 from paddle_tpu.core.config import flags
-from paddle_tpu.core.enforce import enforce
 from paddle_tpu.observability import runlog
+from paddle_tpu.watch.detectors import SkewDetector
 
 __all__ = ["StragglerDetector"]
 
@@ -49,59 +50,35 @@ class StragglerDetector:
         window: int = 32,
         min_samples: int = 5,
     ):
-        enforce(window >= 2, f"window must be >= 2, got {window}")
-        enforce(min_samples >= 2, f"min_samples must be >= 2, got {min_samples}")
         self.group = group
-        self.ratio = float(ratio if ratio is not None else flags().straggler_ratio)
-        enforce(self.ratio > 1.0, f"straggler ratio must be > 1.0, got {self.ratio}")
-        self.window = int(window)
-        self.min_samples = int(min_samples)
+        self._core = SkewDetector(
+            ratio=float(ratio if ratio is not None else flags().straggler_ratio),
+            window=window,
+            min_samples=min_samples,
+        )
         self.flagged: Dict[str, int] = {}  # key -> flag count
         self._lock = threading.Lock()
-        self._series: Dict[str, deque] = {}
+
+    @property
+    def ratio(self) -> float:
+        return self._core.ratio
+
+    @property
+    def window(self) -> int:
+        return self._core.window
+
+    @property
+    def min_samples(self) -> int:
+        return self._core.min_samples
 
     def record(self, key: str, seconds: float) -> bool:
         """Record one duration for ``key``; returns True if it was flagged
         as a straggler."""
-        if seconds < 0:
+        result = self._core.record(key, seconds)
+        if result is None or not result.flagged:
             return False
-        with self._lock:
-            series = self._series.get(key)
-            if series is None:
-                series = self._series[key] = deque(maxlen=self.window)
-            series.append(float(seconds))
-            skew, mode = self._skew_locked(key, float(seconds))
-        if skew is None or skew <= self.ratio:
-            return False
-        self._flag(key, seconds, skew, mode)
+        self._flag(key, seconds, result.score, result.mode)
         return True
-
-    def _skew_locked(self, key: str, latest: float):
-        """Skew ratio for the latest observation of ``key``, or (None, _)
-        when there is not enough signal yet."""
-        peers = {
-            k: s for k, s in self._series.items() if len(s) >= self.min_samples
-        }
-        if len(peers) >= 2 and key in peers:
-            # spatial: this key's recent mean against the median of all
-            # keys' means — median (not mean) so one straggler cannot drag
-            # the baseline up and hide itself.
-            means = {k: sum(s) / len(s) for k, s in peers.items()}
-            baseline = statistics.median(means.values())
-            if baseline <= 0:
-                return None, "spatial"
-            return means[key] / baseline, "spatial"
-        series = self._series[key]
-        if len(series) < self.min_samples:
-            return None, "temporal"
-        # temporal: the latest observation against this key's own recent
-        # median (excluding the latest, so a spike cannot inflate its own
-        # baseline).
-        history = list(series)[:-1]
-        baseline = statistics.median(history)
-        if baseline <= 0:
-            return None, "temporal"
-        return latest / baseline, "temporal"
 
     def _flag(self, key: str, seconds: float, skew: float, mode: str) -> None:
         with self._lock:
@@ -127,14 +104,8 @@ class StragglerDetector:
 
     def snapshot(self) -> Dict[str, dict]:
         """Per-key window stats (count/mean/max) plus flag counts."""
+        out = self._core.window_stats()
         with self._lock:
-            out = {}
-            for k, s in self._series.items():
-                vals = list(s)
-                out[k] = {
-                    "count": len(vals),
-                    "mean_s": sum(vals) / len(vals) if vals else 0.0,
-                    "max_s": max(vals) if vals else 0.0,
-                    "flags": self.flagged.get(k, 0),
-                }
-            return out
+            for k, stats in out.items():
+                stats["flags"] = self.flagged.get(k, 0)
+        return out

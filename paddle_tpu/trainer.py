@@ -96,6 +96,7 @@ class Trainer:
         prefetch: bool = False,
         resilience: Optional[ResilienceConfig] = None,
         observability: Optional["obs.ObservabilityConfig"] = None,
+        watch: Optional[Any] = None,
     ):
         from paddle_tpu.framework import build
 
@@ -146,6 +147,14 @@ class Trainer:
         # pjit step does not expose — the detector accepts external
         # per-device keys when a multi-host launcher has them)
         self._straggler = tracing.StragglerDetector("trainer.step")
+        # watch layer: anomaly detectors / SLOs over this trainer's metric
+        # streams (step time, MFU, goodput), attached via config
+        # (a paddle_tpu.watch.WatchConfig; None = no watching)
+        self._watcher = None
+        if watch is not None:
+            from paddle_tpu import watch as watch_mod
+
+            self._watcher = watch_mod.build(watch)
 
     # -- init / resume ------------------------------------------------------
     def _ensure_initialized(self, first_batch: Sequence[Any]):

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Perf-regression gate: diff a fresh ``bench.py`` JSON line against the
+persistent baseline store and exit non-zero on regression.
+
+The reference framework's answer to "did this change slow us down?" was a
+human reading ``FLAGS_benchmark`` timer logs; here the bench artifact is
+structured (one JSON object) and the baselines are rolling statistics
+(``paddle_tpu.watch.baseline.BaselineStore``), so the comparison is a CI
+gate instead of an eyeball:
+
+- every numeric top-level bench metric is classified by name —
+  throughput-shaped (``*_per_sec*``, ``mfu``, ``goodput_frac``) must not
+  drop, time-shaped (``*_ms*``, ``*_seconds``) must not grow, anything
+  else is informational;
+- the allowed band per metric is ``max(--noise-band, 2 * stddev)`` of the
+  stored rolling stats, so noisy metrics earn wider bands from their own
+  history instead of a hand-tuned global fudge factor;
+- baselines are keyed by ``(metric, "-", "-", device_kind)`` — a CPU
+  fallback run is never judged against TPU numbers;
+- metrics with no stored baseline report ``new`` and never fail;
+  ``--update`` folds the run into the store afterwards (tmp+rename, so a
+  crashed gate never leaves a torn store).
+
+Exit 0: no metric regressed beyond its band. Exit 1: at least one did
+(or the inputs were unreadable). One JSON summary line on stdout either
+way; the per-metric table goes to stderr.
+
+Usage:
+    python tools/perf_gate.py --baseline perf_baseline.json \
+        --bench-json BENCH.json [--update] [--noise-band 0.25]
+    bench.py | python tools/perf_gate.py --baseline perf_baseline.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# metadata / non-judgeable top-level keys in a bench JSON line
+_SKIP_KEYS = {
+    "metric", "unit", "notes", "platform", "device_kind", "phase_breakdown",
+    "vs_baseline", "vs_v100_target", "resnet_batch_size",
+    "decode_scan_layers",
+}
+
+
+def load_bench_line(source: str) -> dict:
+    """Parse the bench JSON object from a file path, a literal JSON string,
+    or stdin (``-``). For multi-line input, the LAST parseable JSON object
+    with a ``metric`` field wins (bench children checkpoint interim lines)."""
+    if source == "-":
+        text = sys.stdin.read()
+    elif source.lstrip().startswith("{"):
+        text = source
+    else:
+        with open(source) as f:
+            text = f.read()
+    found = None
+    for line in text.strip().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(parsed, dict) and "metric" in parsed:
+            found = parsed
+    if found is None:
+        raise ValueError(f"no bench JSON object found in {source!r}")
+    return found
+
+
+def judge(bench: dict, store, noise_band: float) -> list:
+    """One verdict dict per judgeable metric (see BaselineStore.check)."""
+    from paddle_tpu.watch import baseline as bl
+
+    device_kind = str(bench.get("device_kind", "-")) or "-"
+    verdicts = []
+    for key, value in bench.items():
+        if key in _SKIP_KEYS or not isinstance(value, (int, float)):
+            continue
+        if isinstance(value, bool):
+            continue
+        # "value" is the headline metric: judge it under its real name
+        name = str(bench.get("metric", "value")) if key == "value" else key
+        direction = bl.metric_direction(name)
+        verdicts.append(store.check(
+            name, float(value), device_kind=device_kind,
+            noise_band=noise_band, direction=direction))
+    return verdicts
+
+
+def apply_update(bench: dict, store) -> int:
+    from paddle_tpu.watch import baseline as bl  # noqa: F401 (same keying)
+
+    device_kind = str(bench.get("device_kind", "-")) or "-"
+    n = 0
+    for key, value in bench.items():
+        if key in _SKIP_KEYS or not isinstance(value, (int, float)):
+            continue
+        if isinstance(value, bool):
+            continue
+        name = str(bench.get("metric", "value")) if key == "value" else key
+        store.update(name, float(value), device_kind=device_kind)
+        n += 1
+    return n
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", required=True,
+                    help="baseline store JSON (created empty if missing)")
+    ap.add_argument("--bench-json", default="-",
+                    help="bench JSON line: file path, literal JSON, or - "
+                         "for stdin (default)")
+    ap.add_argument("--noise-band", type=float, default=0.25,
+                    help="minimum allowed relative delta before a "
+                         "directional metric counts as changed (default "
+                         "0.25 = 25%%)")
+    ap.add_argument("--update", action="store_true",
+                    help="fold this run into the baseline store (after "
+                         "judging against the PRE-update baselines)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.watch.baseline import BaselineStore
+
+    summary = {"gate": "perf_gate", "baseline": args.baseline,
+               "regressions": [], "improved": [], "new": [], "ok": []}
+    try:
+        bench = load_bench_line(args.bench_json)
+        store = BaselineStore(args.baseline)
+        verdicts = judge(bench, store, args.noise_band)
+    except Exception as e:
+        summary["error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(summary))
+        print(f"perf_gate: FAILED to judge: {e}", file=sys.stderr)
+        return 1
+
+    for v in verdicts:
+        name = v["key"].split("|", 1)[0]
+        bucket = {"regression": "regressions", "improved": "improved",
+                  "new": "new", "ok": "ok"}[v["verdict"]]
+        summary[bucket].append(name)
+        if v["verdict"] == "ok" and v.get("direction") == "info":
+            continue  # keep the stderr table signal-dense
+        base = v.get("baseline")
+        delta = v.get("delta_frac")
+        print(
+            f"perf_gate: {v['verdict']:<10} {name:<40} "
+            f"value={v['value']:.6g}"
+            + (f" baseline={base:.6g}" if base is not None else "")
+            + (f" delta={delta:+.1%}" if delta is not None else "")
+            + (f" band=±{v['tolerance_frac']:.1%}"
+               if v.get("tolerance_frac") is not None else ""),
+            file=sys.stderr)
+
+    if args.update:
+        n = apply_update(bench, store)
+        store.save()
+        summary["updated_metrics"] = n
+        print(f"perf_gate: baseline updated with {n} metrics "
+              f"-> {args.baseline}", file=sys.stderr)
+
+    failed = bool(summary["regressions"])
+    summary["status"] = "fail" if failed else "pass"
+    print(json.dumps(summary))
+    print(f"perf_gate: {summary['status'].upper()} "
+          f"({len(summary['regressions'])} regression(s), "
+          f"{len(summary['improved'])} improved, {len(summary['new'])} new, "
+          f"{len(summary['ok'])} ok)", file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
