@@ -43,6 +43,31 @@ _MANIFEST = "manifest.json"
 # shard file, so the pid-0 manifest cannot carry every shard's checksum)
 _CRC_SUFFIX = ".crc"
 
+# Optional observer of every single-process save's host snapshot — the
+# elastic supervisor registers here so the freshest device->host copy is
+# available in memory for a zero-IO restore after a device loss (see
+# resilience/elastic.py). Called with (shard_data, manifest).
+_snapshot_listener = None
+
+
+def set_snapshot_listener(fn) -> None:
+    """Install a ``(shard_data, manifest) -> None`` observer invoked with
+    the host-side shard blocks of every single-process save (sync and
+    async), BEFORE any file IO. ``None`` clears it. The listener must not
+    mutate the arrays — the async writer thread is still serializing them."""
+    global _snapshot_listener
+    _snapshot_listener = fn
+
+
+def _notify_snapshot(shard_data, manifest) -> None:
+    fn = _snapshot_listener
+    if fn is None:
+        return
+    try:
+        fn(shard_data, manifest)
+    except Exception as e:  # an observer must never break the save
+        ptlog.warning("checkpoint snapshot listener failed: %s", e)
+
 
 def _index_key(leaf_i: int, index: Tuple[slice, ...], shape: Tuple[int, ...]) -> str:
     parts = []
@@ -155,13 +180,16 @@ def save_sharded(
 ) -> str:
     """Save the training pytree with each process writing only its own
     shards. Returns the published checkpoint dir (all processes)."""
-    wait_pending_save()  # never interleave with an in-flight async save
     pid = jax.process_index()
     if jax.process_count() == 1:
-        shard_data, manifest = _snapshot(tree, step, epoch, extra_meta)
-        final_dir = _write_publish_local(root, step, shard_data, manifest, max_num_checkpoints)
+        with _save_lock:
+            _drain_pending_for_save()  # never interleave with an in-flight async save
+            shard_data, manifest = _snapshot(tree, step, epoch, extra_meta)
+            _notify_snapshot(shard_data, manifest)
+            final_dir = _write_publish_local(root, step, shard_data, manifest, max_num_checkpoints)
         ptlog.vlog(1, "sharded checkpoint step %d -> %s", step, final_dir)
         return final_dir
+    wait_pending_save()
 
     final_dir = os.path.join(root, f"checkpoint_{step}")
     tmp_dir = final_dir + ".tmp"
@@ -212,6 +240,11 @@ class AsyncSaveHandle:
 
 
 _pending: Optional[AsyncSaveHandle] = None
+# guards the _pending slot itself (read/clear); cheap, never held across IO
+_pending_lock = threading.Lock()
+# serializes whole save entries: two threads calling save_sharded_async
+# concurrently would otherwise both drain, snapshot, and race the slot
+_save_lock = threading.RLock()
 
 
 def wait_pending_save(timeout: Optional[float] = None) -> Optional[str]:
@@ -221,16 +254,35 @@ def wait_pending_save(timeout: Optional[float] = None) -> Optional[str]:
     not re-raise forever); on TIMEOUT it stays pending — the writer thread
     is still alive and must not be raced by a new save."""
     global _pending
-    if _pending is None:
+    with _pending_lock:
+        pending = _pending
+    if pending is None:
         return None
-    pending = _pending
     if pending._thread is not None:
         pending._thread.join(timeout)
         enforce(not pending._thread.is_alive(), "async checkpoint save timed out")
-    _pending = None  # joined (or never started): done or errored
+    with _pending_lock:
+        if _pending is pending:  # joined (or never started): done or errored
+            _pending = None
     if pending._error is not None:
         raise pending._error
     return pending._dir
+
+
+def _drain_pending_for_save() -> None:
+    """Join any in-flight async save before starting a NEW one. A previous
+    save's writer error must not abort the new save (the new one carries
+    fresher state — exactly what you want durable after a failure), so it
+    is surfaced as a runlog ``alert`` + ``checkpoint.async_errors_total``
+    instead of re-raised. :func:`wait_pending_save` keeps its raising
+    contract for exit-time drains."""
+    try:
+        wait_pending_save()
+    except BaseException as e:
+        prof.inc_counter("checkpoint.async_errors_total")
+        runlog.emit("alert", source="checkpoint", key="async_save_failed",
+                    severity="error", error=str(e))
+        ptlog.error("previous async checkpoint save failed (%s); proceeding with new save", e)
 
 
 def save_sharded_async(
@@ -245,31 +297,50 @@ def save_sharded_async(
     SYNCHRONOUSLY (cheap, and the arrays may be donated/overwritten by the
     next step), then file writing + atomic publish run in a background
     thread so checkpoint IO overlaps training compute. A new save first
-    waits for the previous one (ordering). Single-process path only — with
-    multiple processes the cross-host publish barrier cannot run off the
-    main thread, so it falls back to the synchronous save."""
+    waits for the previous one (ordering; a previous FAILURE is alerted,
+    not re-raised — the new save proceeds). Single-process path only —
+    with multiple processes the cross-host publish barrier cannot run off
+    the main thread, so it falls back to the synchronous save."""
     global _pending
-    wait_pending_save()
     if jax.process_count() > 1:
+        wait_pending_save()
         h = AsyncSaveHandle()
         h._dir = save_sharded(root, tree, step, epoch, max_num_checkpoints, extra_meta)
         return h
 
-    shard_data, manifest = _snapshot(tree, step, epoch, extra_meta)
-    handle = AsyncSaveHandle()
+    with _save_lock:
+        _drain_pending_for_save()
+        shard_data, manifest = _snapshot(tree, step, epoch, extra_meta)
+        _notify_snapshot(shard_data, manifest)
+        handle = AsyncSaveHandle()
 
-    def writer():
-        try:
-            handle._dir = _write_publish_local(
-                root, step, shard_data, manifest, max_num_checkpoints
-            )
-            ptlog.vlog(1, "async sharded checkpoint step %d -> %s", step, handle._dir)
-        except BaseException as e:  # surfaced on result()
-            handle._error = e
+        def writer():
+            t0 = time.perf_counter()
+            try:
+                handle._dir = _write_publish_local(
+                    root, step, shard_data, manifest, max_num_checkpoints
+                )
+                t1 = time.perf_counter()
+                # make the IO-overlap window visible next to trainer.step:
+                # histogram + runlog event + a Chrome-trace span from the
+                # writer thread (record_span is cross-thread safe)
+                prof.observe("checkpoint.async_write_seconds", t1 - t0)
+                runlog.emit("checkpoint_async_write", step=int(step),
+                            path=handle._dir, seconds=round(t1 - t0, 6))
+                try:
+                    from paddle_tpu import tracing
 
-    handle._thread = threading.Thread(target=writer, daemon=True, name=f"ckpt-save-{step}")
-    handle._thread.start()
-    _pending = handle
+                    tracing.record_span("checkpoint.async_write", t0, t1, step=int(step))
+                except Exception:
+                    pass
+                ptlog.vlog(1, "async sharded checkpoint step %d -> %s", step, handle._dir)
+            except BaseException as e:  # surfaced on result()
+                handle._error = e
+
+        handle._thread = threading.Thread(target=writer, daemon=True, name=f"ckpt-save-{step}")
+        handle._thread.start()
+        with _pending_lock:
+            _pending = handle
     return handle
 
 
@@ -341,87 +412,118 @@ def load_sharded(path_or_root: str, tree_like: Any) -> Tuple[Any, dict]:
         f"(all candidates corrupt; last error: {last_err})",
     )
 
-    # shard index: leaf -> [(slices, file, npz_key)]
+    # shard index: leaf -> [(slices, ref)] with ref = (file, npz_key)
     index: Dict[int, list] = {}
     for fn in sorted(glob.glob(os.path.join(path, "shards_p*.npz"))):
         with np.load(fn) as z:
             for key in z.files:
                 leaf_i, slices = _parse_key(key)
-                index.setdefault(leaf_i, []).append((slices, fn, key))
-
-    like_leaves, treedef = jax.tree_util.tree_flatten(tree_like)
-    enforce(
-        len(like_leaves) == manifest["num_leaves"],
-        f"checkpoint has {manifest['num_leaves']} leaves, target has {len(like_leaves)}",
-    )
+                index.setdefault(leaf_i, []).append((slices, (fn, key)))
 
     # cache opened npz files (lazy-loaded members)
     opened: Dict[str, Any] = {}
 
-    def read_block(fn: str, key: str) -> np.ndarray:
+    def read_block(ref) -> np.ndarray:
+        fn, key = ref
         if fn not in opened:
             opened[fn] = np.load(fn)
         return opened[fn][key]
 
-    restored = []
     try:
-        for i, like in enumerate(like_leaves):
-            info = manifest["leaves"][i]
-            shape = tuple(info["shape"])
-            saved_dtype = np.dtype(info["dtype"])
-            target_dtype = np.dtype(like.dtype) if hasattr(like, "dtype") else saved_dtype
-            enforce(
-                not hasattr(like, "shape") or tuple(like.shape) == shape,
-                f"leaf {i}: checkpoint shape {shape} != target {tuple(getattr(like, 'shape', ()))}",
-            )
-            blocks = index.get(i, [])
-            sharding = getattr(like, "sharding", None)
-            if sharding is None or not isinstance(like, jax.Array) and not hasattr(like, "sharding"):
-                sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
-
-            exact = {tuple(sl): (fn, key) for sl, fn, key in blocks}
-
-            def fetch(idx: Tuple[slice, ...], shape=shape, blocks=blocks, exact=exact):
-                want = tuple(
-                    (0 if s.start is None else int(s.start), dim if s.stop is None else int(s.stop))
-                    for s, dim in zip(idx, shape)
-                )
-                hit = exact.get(want)
-                if hit is not None:
-                    return np.asarray(read_block(*hit), dtype=target_dtype)
-                # resharded restore: assemble the requested window
-                out = np.zeros([b - a for a, b in want], dtype=target_dtype)
-                covered = 0
-                for sl, fn, key in blocks:
-                    inter = [
-                        (max(a, c), min(b, d)) for (a, b), (c, d) in zip(want, sl)
-                    ]
-                    if any(a >= b for a, b in inter):
-                        continue
-                    block = read_block(fn, key)
-                    src = tuple(
-                        slice(a - c, b - c) for (a, b), (c, d) in zip(inter, sl)
-                    )
-                    dst = tuple(
-                        slice(a - w[0], b - w[0]) for (a, b), w in zip(inter, want)
-                    )
-                    out[dst] = np.asarray(block[src], dtype=target_dtype)
-                    covered += int(np.prod([b - a for a, b in inter]))
-                enforce(
-                    covered == out.size,
-                    f"leaf {i}: shard window {want} not fully covered by checkpoint",
-                )
-                return out
-
-            arr = jax.make_array_from_callback(shape, sharding, fetch)
-            restored.append(arr)
+        tree = _assemble_tree(index, manifest, tree_like, read_block)
     finally:
         for z in opened.values():
             z.close()
     prof.inc_counter("checkpoint.restores_total")
     runlog.emit("checkpoint_restore", step=int(manifest.get("step", 0)),
                 path=path, sharded=True)
-    return jax.tree_util.tree_unflatten(treedef, restored), manifest
+    return tree, manifest
+
+
+def _assemble_tree(index: Dict[int, list], manifest: dict, tree_like: Any, read_block) -> Any:
+    """Rebuild the global pytree for ``tree_like`` (arrays or
+    ShapeDtypeStructs with ``.sharding``) from indexed shard blocks — the
+    shared core of the disk restore and the in-memory snapshot restore.
+    ``index`` maps leaf -> [(slices, ref)]; ``read_block(ref)`` returns
+    that block's ndarray. Exact slice matches read one block; resharded
+    targets assemble each addressable window from the overlapping blocks."""
+    like_leaves, treedef = jax.tree_util.tree_flatten(tree_like)
+    enforce(
+        len(like_leaves) == manifest["num_leaves"],
+        f"checkpoint has {manifest['num_leaves']} leaves, target has {len(like_leaves)}",
+    )
+
+    restored = []
+    for i, like in enumerate(like_leaves):
+        info = manifest["leaves"][i]
+        shape = tuple(info["shape"])
+        saved_dtype = np.dtype(info["dtype"])
+        target_dtype = np.dtype(like.dtype) if hasattr(like, "dtype") else saved_dtype
+        enforce(
+            not hasattr(like, "shape") or tuple(like.shape) == shape,
+            f"leaf {i}: checkpoint shape {shape} != target {tuple(getattr(like, 'shape', ()))}",
+        )
+        blocks = index.get(i, [])
+        sharding = getattr(like, "sharding", None)
+        if sharding is None or not isinstance(like, jax.Array) and not hasattr(like, "sharding"):
+            sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+
+        exact = {tuple(sl): ref for sl, ref in blocks}
+
+        def fetch(idx: Tuple[slice, ...], shape=shape, blocks=blocks, exact=exact, i=i, target_dtype=target_dtype):
+            want = tuple(
+                (0 if s.start is None else int(s.start), dim if s.stop is None else int(s.stop))
+                for s, dim in zip(idx, shape)
+            )
+            hit = exact.get(want)
+            if hit is not None:
+                return np.asarray(read_block(hit), dtype=target_dtype)
+            # resharded restore: assemble the requested window
+            out = np.zeros([b - a for a, b in want], dtype=target_dtype)
+            covered = 0
+            for sl, ref in blocks:
+                inter = [
+                    (max(a, c), min(b, d)) for (a, b), (c, d) in zip(want, sl)
+                ]
+                if any(a >= b for a, b in inter):
+                    continue
+                block = read_block(ref)
+                src = tuple(
+                    slice(a - c, b - c) for (a, b), (c, d) in zip(inter, sl)
+                )
+                dst = tuple(
+                    slice(a - w[0], b - w[0]) for (a, b), w in zip(inter, want)
+                )
+                out[dst] = np.asarray(block[src], dtype=target_dtype)
+                covered += int(np.prod([b - a for a, b in inter]))
+            enforce(
+                covered == out.size,
+                f"leaf {i}: shard window {want} not fully covered by checkpoint",
+            )
+            return out
+
+        arr = jax.make_array_from_callback(shape, sharding, fetch)
+        restored.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, restored)
+
+
+def restore_from_snapshot(shard_data: Dict[str, np.ndarray], manifest: dict, tree_like: Any) -> Tuple[Any, dict]:
+    """Rebuild the training pytree from an IN-MEMORY snapshot — the
+    device->host shard blocks captured by the save path (see
+    :func:`set_snapshot_listener`) — without touching disk. This is the
+    elastic shrink path's freshest-state restore: the target's shardings
+    may differ from the snapshot's (the mesh just shrank), so blocks are
+    reassembled piecewise exactly like a resharded disk restore. Returns
+    (tree, manifest), same contract as :func:`load_sharded`."""
+    index: Dict[int, list] = {}
+    for key in shard_data:
+        leaf_i, slices = _parse_key(key)
+        index.setdefault(leaf_i, []).append((slices, key))
+    tree = _assemble_tree(index, manifest, tree_like, shard_data.__getitem__)
+    prof.inc_counter("checkpoint.snapshot_restores_total")
+    runlog.emit("checkpoint_restore", step=int(manifest.get("step", 0)),
+                source="snapshot", sharded=True)
+    return tree, manifest
 
 
 def _existing_steps(root: str):
@@ -461,8 +563,9 @@ def update_manifest(path_or_root: str, updates: dict) -> None:
     atomic tmp+rename, same contract as checkpoint.update_meta)."""
     # an in-flight async save is about to publish a NEWER checkpoint —
     # updating "latest" before it lands would write to a stale dir (and
-    # race its prune); wait for the publish first
-    wait_pending_save()
+    # race its prune); wait for the publish first (a previous failure is
+    # alerted, not re-raised — the manifest update must still happen)
+    _drain_pending_for_save()
     if jax.process_index() != 0:
         _barrier("manifest_update")
         return

@@ -73,6 +73,15 @@ class Flags:
     # straggler detector: flag a replica/step whose duration exceeds the
     # group median by this ratio (see paddle_tpu.tracing.straggler)
     straggler_ratio: float = 2.5
+    # elastic training (see paddle_tpu.resilience.elastic): shrink the mesh
+    # past lost devices and keep training instead of crashing
+    elastic: bool = False
+    # refuse to shrink below this many surviving devices
+    elastic_min_devices: int = 1
+    # re-expand the mesh at a checkpoint boundary when lost devices return
+    elastic_regrow: bool = True
+    # consecutive watchdog stalls that escalate to a device-liveness probe
+    elastic_escalate_stalls: int = 2
 
     @staticmethod
     def _coerce(value: str, typ):

@@ -138,7 +138,9 @@ def mfu(flops_per_step: float, step_time_s: float, device_count: int = 1,
 
 class GoodputTracker:
     """Splits run time into goodput (productive step time) and badput
-    (time charged to a failure category: nan_skip, rollback, stall, ...)."""
+    (time charged to a failure category: nan_skip, rollback, stall,
+    elastic_recovery — the mesh shrink + snapshot restore after a device
+    loss — ...)."""
 
     def __init__(self):
         self._lock = threading.Lock()

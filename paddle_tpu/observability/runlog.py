@@ -12,11 +12,19 @@ Event kinds emitted by the framework:
 - ``step`` — loss, step_time_s, examples_per_sec, EMA throughput
   (``trainer.py``)
 - ``compile`` — Executor cache miss + compile seconds (``executor.py``)
-- ``checkpoint_save`` / ``checkpoint_restore`` — publish/restore with
-  path and step (``checkpoint.py``, ``checkpoint_sharded.py``)
+- ``checkpoint_save`` / ``checkpoint_restore`` / ``checkpoint_async_write``
+  — publish/restore with path and step; the async-write event carries the
+  background writer's wall seconds (``checkpoint.py``,
+  ``checkpoint_sharded.py``)
 - ``nan_skip`` / ``rollback`` / ``watchdog_stall`` / ``fault_injected`` /
   ``breaker_open`` / ``breaker_close`` — resilience events
   (``trainer.py``, ``resilience/``, ``serving/engine.py``)
+- ``elastic_shrink`` / ``elastic_regrow`` — mesh resize on device
+  loss/return, with devices_before/after, restore source, and the
+  enclosing ``trainer.elastic_recover`` trace ids
+  (``resilience/elastic.py``)
+- ``alert`` — watch-layer and checkpoint alerts with source/key/severity
+  (``watch/alerts.py``, ``checkpoint_sharded.py``)
 """
 
 from __future__ import annotations

@@ -338,6 +338,51 @@ class DataParallel:
         with self.mesh:
             return self._eval_fn(variables, rng, *batch)
 
+    # -- elastic resize ------------------------------------------------------
+    def resize(self, devices: Sequence) -> Mesh:
+        """Elastic mesh shrink/regrow: rebuild this driver's mesh over
+        ``devices`` — the batch axis absorbs the count change, other axes
+        keep their sizes (``mesh.remesh``) — and drop every compiled step
+        fn: their in/out_shardings are bound to the old mesh, so the next
+        ``step``/``step_ragged``/``eval_step`` re-jits against the new one
+        (batch shardings re-derive from the new mesh automatically). The
+        caller re-places the training state: restore from a snapshot /
+        checkpoint on shrink (the lost device's buffers are gone), or
+        :meth:`place_state` on regrow (every source buffer still lives)."""
+        devices = list(devices)
+        enforce(bool(devices), "resize needs at least one device")
+        self.mesh = mesh_mod.remesh(self.mesh, devices, resize_axis=self.batch_axis)
+        self._step_fn = None
+        self._eval_fn = None
+        self._ragged_step_fns.clear()
+        return self.mesh
+
+    def state_template(self, variables: Variables, opt_state: OptState):
+        """ShapeDtypeStruct pytree of ``(variables, opt_state)`` carrying
+        THIS mesh's shardings — the restore target handed to
+        ``checkpoint_sharded.load_sharded`` / ``restore_from_snapshot``
+        after a :meth:`resize` (the live arrays still carry the OLD mesh's
+        shardings and cannot serve as the template)."""
+        var_sh, opt_sh = self._state_shardings(variables, opt_state)
+
+        def struct(x, s):
+            dtype = getattr(x, "dtype", None)
+            if dtype is None:
+                dtype = jax.numpy.result_type(x)
+            return jax.ShapeDtypeStruct(jax.numpy.shape(x), dtype, sharding=s)
+
+        return jax.tree_util.tree_map(struct, (variables, opt_state), (var_sh, opt_sh))
+
+    def place_state(self, variables: Variables, opt_state: OptState):
+        """Re-place an existing state tree onto the CURRENT mesh (regrow
+        path: the arrays live on the shrunken mesh and every target device
+        is alive, so a direct resharding device_put suffices — no snapshot
+        or disk round-trip)."""
+        var_sh, opt_sh = self._state_shardings(variables, opt_state)
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s), (variables, opt_state), (var_sh, opt_sh)
+        )
+
     @property
     def num_devices(self) -> int:
         return self.mesh.devices.size

@@ -100,3 +100,19 @@ def default_mesh() -> Mesh:
     """All local devices on a single data axis (pure DP — the reference
     ParallelExecutor default)."""
     return make_mesh({DATA_AXIS: -1})
+
+
+def remesh(mesh: Mesh, devices: Sequence, resize_axis: str = DATA_AXIS) -> Mesh:
+    """Rebuild ``mesh`` over a different device set (elastic shrink or
+    regrow): every axis keeps its size except ``resize_axis``, which
+    absorbs the new device count. Axis ORDER is preserved, so existing
+    PartitionSpecs keep their meaning on the new mesh. The non-resized
+    axes' product must divide the new device count (e.g. model=2 survives
+    8 -> 6 devices but not 8 -> 7)."""
+    sizes = {name: int(size) for name, size in zip(mesh.axis_names, mesh.devices.shape)}
+    enforce(
+        resize_axis in sizes,
+        f"remesh: axis {resize_axis!r} not in mesh axes {tuple(sizes)}",
+    )
+    sizes[resize_axis] = -1
+    return make_mesh(sizes, devices=devices)

@@ -72,13 +72,32 @@ class ResilienceConfig:
 
     ``stall_timeout_s`` arms a :class:`StepWatchdog` around every training
     step; a step exceeding it gets an all-thread stack dump in the log
-    (diagnostics only — the step is never killed).
+    (diagnostics only — the step is never killed directly; with elastic
+    training on, repeated stalls escalate to a device probe, see below).
+
+    Elastic training (``elastic=True``, requires ``parallel=True`` and a
+    sharded checkpoint config): an :class:`~paddle_tpu.resilience.elastic.
+    ElasticSupervisor` catches device loss (``faults.DeviceLostError`` /
+    classified runtime errors), shrinks the mesh to the surviving devices
+    (never below ``elastic_min_devices``), restores the freshest state
+    (in-memory async-save snapshot when available, else the last good
+    serial) and resumes. ``elastic_regrow`` re-expands the mesh at a
+    checkpoint boundary when lost devices return (supervisor ``probe``).
+    ``elastic_escalate_stalls`` watchdog stalls without a good step
+    trigger a device probe (stall -> suspected loss escalation). All four
+    are env-settable: ``PADDLE_TPU_ELASTIC=1``,
+    ``PADDLE_TPU_ELASTIC_MIN_DEVICES``, ``PADDLE_TPU_ELASTIC_REGROW``,
+    ``PADDLE_TPU_ELASTIC_ESCALATE_STALLS``.
     """
 
     nan_policy: str = "raise"
     rollback_after: int = 3
     max_rollbacks: int = 2
     stall_timeout_s: Optional[float] = None
+    elastic: bool = False
+    elastic_min_devices: int = 1
+    elastic_regrow: bool = True
+    elastic_escalate_stalls: int = 2
 
     def __post_init__(self):
         from paddle_tpu.core.enforce import enforce, enforce_in
@@ -92,16 +111,25 @@ class ResilienceConfig:
             self.stall_timeout_s is None or self.stall_timeout_s > 0,
             f"stall_timeout_s must be positive, got {self.stall_timeout_s}",
         )
+        enforce(self.elastic_min_devices >= 1,
+                f"elastic_min_devices must be >= 1, got {self.elastic_min_devices}")
+        enforce(self.elastic_escalate_stalls >= 1,
+                f"elastic_escalate_stalls must be >= 1, got {self.elastic_escalate_stalls}")
 
     @classmethod
     def from_flags(cls) -> "ResilienceConfig":
         """Default policy from the global flags (env-settable:
-        ``PADDLE_TPU_CHECK_NAN_INF_POLICY=skip_step`` etc.), mirroring how
-        the reference exposed FLAGS_check_nan_inf process-wide."""
+        ``PADDLE_TPU_CHECK_NAN_INF_POLICY=skip_step``,
+        ``PADDLE_TPU_ELASTIC=1`` etc.), mirroring how the reference exposed
+        FLAGS_check_nan_inf process-wide."""
         from paddle_tpu.core import config as cfg
 
         f = cfg.flags()
         return cls(
             nan_policy=f.check_nan_inf_policy,
             rollback_after=f.nan_rollback_after,
+            elastic=f.elastic,
+            elastic_min_devices=f.elastic_min_devices,
+            elastic_regrow=f.elastic_regrow,
+            elastic_escalate_stalls=f.elastic_escalate_stalls,
         )

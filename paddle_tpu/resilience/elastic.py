@@ -1,0 +1,289 @@
+"""Elastic training: survive device loss by shrinking the mesh, regrow later.
+
+The reference framework's multi-device story was a FIXED world: a
+ParallelExecutor over an NCCL clique whose membership was decided at build
+time (``platform/nccl_helper.h:81-126``) — one dead rank wedged the
+allreduce ring until an operator restarted the job, and PS-mode recovery
+meant restarting pservers against saved shards. On preemptible TPU fleets
+the world is NOT fixed; the production answer (GDP's premise — placement
+must adapt to the devices actually available) is to treat device loss as a
+schedulable event:
+
+1. **Detect** — a classified :class:`~paddle_tpu.resilience.faults.
+   DeviceLostError` out of the step (injectable at ``faults.DEVICE_LOST``
+   for deterministic CPU tests), a runtime error whose text matches known
+   hardware-loss markers, or an escalation: ``elastic_escalate_stalls``
+   consecutive watchdog stalls trigger a device-liveness probe.
+2. **Quiesce + shrink** — drain any in-flight async save, rebuild the mesh
+   over the survivors (``DataParallel.resize``: the batch axis absorbs the
+   change, model axes keep their sizes, compiled steps drop and re-jit).
+3. **Restore** — the freshest state wins: the in-memory device->host
+   snapshot the async-save path captured (zero IO, see
+   ``checkpoint_sharded.set_snapshot_listener``), else the last good
+   serial via ``load_sharded``. Both reassemble piecewise onto the new
+   mesh's shardings, so the shrink IS a resharded restore.
+4. **Resume** — from the restored step; the now-possibly-ragged global
+   batch rides the existing ``step_ragged``/``pad_batch`` machinery.
+5. **Regrow** — when a probe reports lost devices back, re-expand at the
+   next checkpoint boundary (state is durable there) with a direct
+   resharding ``device_put`` (``DataParallel.place_state``).
+
+A scheduler's advance warning rides ``faults.PREEMPT_NOTICE`` -> SIGTERM
+-> the Trainer's existing boundary save (final ``save_sharded_async`` +
+``wait_pending_save`` + clean exit with ``preempted`` metadata), so a
+rescheduled job auto-resumes through ``Trainer.__init__``.
+
+Telemetry: ``elastic.shrinks_total`` / ``elastic.regrows_total`` counters,
+``elastic.devices`` gauge, ``elastic.recovery_seconds`` histogram, runlog
+``elastic_shrink`` / ``elastic_regrow`` events (inside a
+``trainer.elastic_recover`` trace, so they carry trace ids), and the
+recovery wall time lands in GoodputTracker badput as ``elastic_recovery``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable, Optional, Sequence
+
+from paddle_tpu.core import logging as ptlog
+from paddle_tpu.core import profiler as prof
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.observability import runlog
+from paddle_tpu.resilience.faults import DeviceLostError
+
+__all__ = ["ElasticSupervisor", "DeviceLostError", "is_device_loss"]
+
+# lowercase substrings of runtime-error text that mean "a device died", as
+# surfaced by PJRT/XLA (DATA_LOSS / device halt aborts); anything matching
+# is recoverable by shrinking rather than fatal
+_LOSS_MARKERS = ("data_loss", "device halted", "hardware failure", "device lost")
+
+
+def is_device_loss(exc: BaseException) -> bool:
+    """Classify an exception as a recoverable device loss. Explicit
+    :class:`DeviceLostError` always is; other RuntimeErrors (PJRT errors
+    subclass RuntimeError) match on known hardware-loss text markers."""
+    if isinstance(exc, DeviceLostError):
+        return True
+    if isinstance(exc, RuntimeError):
+        msg = str(exc).lower()
+        return any(m in msg for m in _LOSS_MARKERS)
+    return False
+
+
+class ElasticSupervisor:
+    """Device-loss bookkeeping + the shrink/regrow recovery procedure.
+
+    Owned by a :class:`~paddle_tpu.trainer.Trainer` when
+    ``ResilienceConfig(elastic=True)`` (requires ``parallel=True`` and a
+    sharded checkpoint config). ``devices`` is the initial full device
+    list (the mesh's ravel order); lost devices are tracked as indices
+    into it. ``probe`` is an optional zero-arg callable returning the
+    indices currently alive — a cluster launcher wires its health endpoint
+    here; tests wire a lambda. Without a probe, stall escalation and
+    regrow are inert (loss detection via classified errors still works).
+    """
+
+    def __init__(
+        self,
+        config,
+        devices: Sequence,
+        probe: Optional[Callable[[], Iterable[int]]] = None,
+    ):
+        enforce(bool(devices), "ElasticSupervisor needs the initial device list")
+        self.config = config
+        self.all_devices = list(devices)
+        self.probe = probe
+        self.lost: set = set()
+        self.shrinks = 0
+        self.regrows = 0
+        # freshest (shard_data, manifest) captured by the save path — the
+        # zero-IO restore source; registered via set_snapshot_listener
+        self._snapshot = None
+        self._stall_count = 0
+        # summary of the most recent recovery (tests / chaos assertions)
+        self.last_recovery: Optional[dict] = None
+
+    # -- snapshot feed (checkpoint_sharded.set_snapshot_listener) -----------
+    def note_snapshot(self, shard_data, manifest) -> None:
+        self._snapshot = (shard_data, manifest)
+
+    # -- stall escalation (trainer._on_stall -> here) -----------------------
+    def note_stall(self) -> None:
+        self._stall_count += 1
+
+    def escalation_due(self) -> bool:
+        return (
+            self.probe is not None
+            and self._stall_count >= self.config.elastic_escalate_stalls
+        )
+
+    def escalate(self) -> Optional[DeviceLostError]:
+        """Stalls crossed the threshold: probe device liveness. Returns a
+        :class:`DeviceLostError` naming newly-dead devices for the caller
+        to recover from, or None when everything (still tracked as alive)
+        responds — either way the stall counter resets, so a fresh burst
+        of stalls is needed to probe again."""
+        self._stall_count = 0
+        if self.probe is None:
+            return None
+        alive = set(self.probe())
+        dead = [
+            i for i in range(len(self.all_devices))
+            if i not in alive and i not in self.lost
+        ]
+        if not dead:
+            return None
+        ptlog.error("elastic probe after stalls: devices %s unresponsive", dead)
+        return DeviceLostError(
+            f"probe after repeated stalls: devices {dead} unresponsive",
+            device_indices=dead,
+        )
+
+    # -- device accounting --------------------------------------------------
+    def usable_devices(self):
+        return [d for i, d in enumerate(self.all_devices) if i not in self.lost]
+
+    def _attribute_loss(self, error: BaseException):
+        """Which device indices did this loss take? Prefer the error's own
+        attribution, then a probe; with neither, assume the highest-index
+        survivor (deterministic, and matches schedulers reclaiming from
+        the tail of the pool)."""
+        idx = getattr(error, "device_indices", ())
+        if idx:
+            return [i for i in idx if i not in self.lost]
+        if self.probe is not None:
+            alive = set(self.probe())
+            dead = [
+                i for i in range(len(self.all_devices))
+                if i not in alive and i not in self.lost
+            ]
+            if dead:
+                return dead
+        survivors = [i for i in range(len(self.all_devices)) if i not in self.lost]
+        return survivors[-1:]
+
+    # -- shrink -------------------------------------------------------------
+    def recover(self, trainer, error: BaseException) -> None:
+        """The shrink path: quiesce, rebuild the mesh over the survivors,
+        restore the freshest state (in-memory snapshot, else last good
+        serial), and point the trainer at the restored step/epoch. Raises
+        (EnforceError) when fewer than ``elastic_min_devices`` survive —
+        elastic gives up and the original loss becomes fatal."""
+        from paddle_tpu import checkpoint_sharded as cks
+        from paddle_tpu import tracing
+
+        t0 = time.perf_counter()
+        with tracing.start_trace("trainer.elastic_recover") as span:
+            # quiesce: the step loop already stopped; drain the in-flight
+            # async save so its snapshot/serial is the freshest state (a
+            # failed writer is logged — the previous snapshot still stands)
+            try:
+                cks.wait_pending_save()
+            except Exception as e:
+                ptlog.warning("async save failed during elastic recovery: %s", e)
+
+            dead = self._attribute_loss(error)
+            self.lost.update(dead)
+            devices = self.usable_devices()
+            before = int(trainer._dp.num_devices)
+            enforce(
+                len(devices) >= max(1, self.config.elastic_min_devices),
+                f"elastic: only {len(devices)} devices survive "
+                f"(< elastic_min_devices={self.config.elastic_min_devices}); "
+                f"giving up after: {error}",
+            )
+            trainer._dp.resize(devices)
+            # the live arrays still reference the old mesh — restore into a
+            # template carrying the NEW mesh's shardings
+            template = trainer._dp.state_template(trainer.variables, trainer.opt_state)
+            if self._snapshot is not None:
+                source = "snapshot"
+                shard_data, manifest = self._snapshot
+                tree, manifest = cks.restore_from_snapshot(shard_data, manifest, template)
+            else:
+                source = "disk"
+                enforce(
+                    trainer.checkpoint_cfg is not None,
+                    "elastic recovery needs a snapshot or a checkpoint dir",
+                )
+                tree, manifest = cks.load_sharded(
+                    trainer.checkpoint_cfg.checkpoint_dir, template
+                )
+            trainer.variables, trainer.opt_state = tree
+            restored_step = int(manifest.get("step", trainer.global_step))
+            trainer.global_step = restored_step
+            trainer.epoch = int(manifest.get("next_epoch", manifest.get("epoch", trainer.epoch)))
+            trainer._last_saved_step = restored_step
+            # the global batch may no longer divide the shrunken mesh;
+            # ragged batches replicate through the existing step_ragged path
+            trainer._allow_ragged = True
+            trainer._step_flops = None  # re-derive MFU on the new mesh
+            trainer._consec_bad = 0
+            self._stall_count = 0
+            self.shrinks += 1
+
+            recovery_s = time.perf_counter() - t0
+            self.last_recovery = {
+                "restored_step": restored_step,
+                "devices": len(devices),
+                "source": source,
+                "seconds": recovery_s,
+            }
+            span.set(devices_before=before, devices_after=len(devices),
+                     restored_step=restored_step, source=source)
+            prof.inc_counter("elastic.shrinks_total")
+            prof.set_gauge("elastic.devices", len(devices))
+            prof.observe("elastic.recovery_seconds", recovery_s)
+            trainer.goodput.record_bad(recovery_s, "elastic_recovery")
+            prof.set_gauge("trainer.goodput_frac", trainer.goodput.goodput_frac())
+            runlog.emit(
+                "elastic_shrink", step=restored_step,
+                devices_before=before, devices_after=len(devices),
+                source=source, cause=str(error), seconds=round(recovery_s, 6),
+            )
+            ptlog.error(
+                "elastic shrink: %d -> %d devices, resumed from step %d (%s) after: %s",
+                before, len(devices), restored_step, source, error,
+            )
+
+    # -- regrow -------------------------------------------------------------
+    def maybe_regrow(self, trainer) -> bool:
+        """At a checkpoint boundary (state durable — the only place a
+        failed regrow costs nothing), probe for returned devices and
+        re-expand the mesh over them. Returns True when the mesh grew."""
+        if not self.config.elastic_regrow or not self.lost or self.probe is None:
+            return False
+        if trainer.global_step != trainer._last_saved_step:
+            return False  # not at a checkpoint boundary
+        alive = set(self.probe())
+        returned = sorted(i for i in self.lost if i in alive)
+        if not returned:
+            return False
+        from paddle_tpu import tracing
+
+        t0 = time.perf_counter()
+        with tracing.start_span("trainer.elastic_regrow"):
+            self.lost.difference_update(returned)
+            devices = self.usable_devices()
+            before = int(trainer._dp.num_devices)
+            trainer._dp.resize(devices)
+            # every source buffer is on a live device: direct reshard
+            trainer.variables, trainer.opt_state = trainer._dp.place_state(
+                trainer.variables, trainer.opt_state
+            )
+            trainer._step_flops = None
+            self.regrows += 1
+            regrow_s = time.perf_counter() - t0
+            prof.inc_counter("elastic.regrows_total")
+            prof.set_gauge("elastic.devices", len(devices))
+            runlog.emit(
+                "elastic_regrow", step=trainer.global_step,
+                devices_before=before, devices_after=len(devices),
+                seconds=round(regrow_s, 6),
+            )
+            ptlog.vlog(
+                0, "elastic regrow: %d -> %d devices at step %d",
+                before, len(devices), trainer.global_step,
+            )
+        return True

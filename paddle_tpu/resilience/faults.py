@@ -57,6 +57,9 @@ __all__ = [
     "READER_NEXT",
     "TRAINER_STEP",
     "SERVING_DISPATCH",
+    "DEVICE_LOST",
+    "PREEMPT_NOTICE",
+    "DeviceLostError",
 ]
 
 # the named injection points wired into the framework
@@ -65,8 +68,29 @@ CHECKPOINT_LOAD = "checkpoint.load"
 READER_NEXT = "reader.next"
 TRAINER_STEP = "trainer.step"
 SERVING_DISPATCH = "serving.dispatch"
+# elastic-training points (trainer step loop): a replica/device vanishing
+# mid-step, and the scheduler's advance preemption notice — both are
+# hardware/cluster events in production, injectable here so the whole
+# shrink/drain path is deterministically testable on CPU
+DEVICE_LOST = "device.lost"
+PREEMPT_NOTICE = "preempt.notice"
 
 _KINDS = ("error", "nan", "stall", "preempt")
+
+
+class DeviceLostError(RuntimeError):
+    """A device (or its host process) stopped responding mid-training.
+
+    Raised by ``inject(DEVICE_LOST)`` under an ``"error"`` spec with no
+    explicit ``exc``, and by the elastic supervisor's probe escalation.
+    Carries the indices of the lost devices (into the supervisor's initial
+    device list) when known, so the mesh can shrink past exactly them.
+    Defined here (not in ``elastic.py``) so ``inject`` can default to it
+    without a circular import."""
+
+    def __init__(self, message: str = "device lost", device_indices=()):
+        super().__init__(message)
+        self.device_indices = tuple(device_indices)
 
 
 class FaultSpec:
@@ -217,9 +241,11 @@ def inject(point: str, **ctx: Any) -> Optional[FaultSpec]:
         point, fired.kind, fired.fired, ctx,
     )
     if fired.kind == "error":
-        raise fired.exc if fired.exc is not None else OSError(
-            f"injected fault at {point}"
-        )
+        if fired.exc is not None:
+            raise fired.exc
+        if point == DEVICE_LOST:  # the classified hardware-loss error
+            raise DeviceLostError(f"injected fault at {point}")
+        raise OSError(f"injected fault at {point}")
     if fired.kind == "stall":
         time.sleep(fired.stall_s)
         return fired

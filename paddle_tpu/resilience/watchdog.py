@@ -8,7 +8,11 @@ exceeds ``timeout_s`` the watchdog dumps EVERY thread's Python stack to
 the log (the armed thread highlighted), bumps the
 ``resilience.watchdog_stalls`` counter, and invokes ``on_stall`` — it
 never kills the step, because a stall that eventually completes must not
-be turned into a failure by its own diagnostics.
+be turned into a failure by its own diagnostics. Escalation is the
+CALLER's policy: the Trainer's ``on_stall`` counts stalls into the
+elastic supervisor, which after ``elastic_escalate_stalls`` of them
+probes device liveness and shrinks the mesh past any dead device (see
+``resilience/elastic.py``).
 
 Usage::
 

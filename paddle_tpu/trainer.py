@@ -38,6 +38,7 @@ from paddle_tpu.observability import mfu as obs_mfu
 from paddle_tpu.observability import runlog
 from paddle_tpu.optimizer import Optimizer, OptState, StepOutput
 from paddle_tpu.resilience import ResilienceConfig, faults
+from paddle_tpu.resilience import elastic as elastic_mod
 from paddle_tpu.resilience.watchdog import StepWatchdog
 
 __all__ = [
@@ -137,6 +138,9 @@ class Trainer:
         self._consec_bad = 0
         self._rollbacks_since_good = 0
         self._watchdog: Optional[StepWatchdog] = None
+        # elastic supervisor (ResilienceConfig(elastic=True)): created in
+        # _ensure_initialized once the mesh exists
+        self._elastic: Optional[elastic_mod.ElasticSupervisor] = None
         # -- telemetry (paddle_tpu.observability / paddle_tpu.tracing) -----
         self.goodput = obs_mfu.GoodputTracker()
         self._ema_eps: Optional[float] = None  # EMA examples/sec
@@ -171,6 +175,22 @@ class Trainer:
         else:
             self.variables = self.model.init(self.rng, *first_batch)
             self.opt_state = self.optimizer.create_state(self.variables.params)
+
+        if self.resilience is not None and getattr(self.resilience, "elastic", False):
+            enforce(self.parallel, "elastic training requires parallel=True (a mesh to shrink)")
+            enforce(
+                self.checkpoint_cfg is not None and self.checkpoint_cfg.use_sharded(),
+                "elastic training needs CheckpointConfig(sharded=True) — "
+                "snapshots/serials are the recovery source",
+            )
+            from paddle_tpu import checkpoint_sharded as cks
+
+            self._elastic = elastic_mod.ElasticSupervisor(
+                self.resilience, devices=list(np.ravel(self._dp.mesh.devices))
+            )
+            # feed every save's device->host snapshot to the supervisor so
+            # recovery has the freshest state without touching disk
+            cks.set_snapshot_listener(self._elastic.note_snapshot)
 
         # auto-resume (reference Trainer.__init__ -> _load_checkpoint,
         # trainer.py:594-629)
@@ -243,78 +263,123 @@ class Trainer:
             first = next(iter(reader()), None)
             enforce(first is not None, "reader yielded no batches")
             self._ensure_initialized(first)
+        if self._elastic is not None and self._elastic.lost:
+            # re-entered after an elastic shrink: the global batch may not
+            # divide the shrunken mesh — keep the ragged path open
+            self._allow_ragged = True
         prev_handlers = self._install_preemption_handlers()
         res = self.resilience
         if res is not None and res.stall_timeout_s is not None and self._watchdog is None:
             self._watchdog = StepWatchdog(
                 res.stall_timeout_s, on_stall=self._on_stall)
         try:
-            for epoch_id in range(self.epoch, num_epochs):
+            # while (not for-range): elastic recovery rewinds self.epoch to
+            # the restored checkpoint's epoch and restarts it — the same
+            # restart-the-interrupted-epoch semantics a cold resume has
+            epoch_id = self.epoch
+            while epoch_id < num_epochs:
                 self.epoch = epoch_id
                 handler(BeginEpochEvent(epoch_id))
                 # manual next() instead of a for-loop: the wait for the
                 # reader is measured and belongs INSIDE the step's trace
                 batches = iter(self._batches(reader))
                 step_id = -1
+                recovered = False
                 while True:
+                    # stall escalation: between steps (state consistent) ask
+                    # the supervisor to probe device liveness; a dead device
+                    # recovers through the same shrink path as a raised loss
+                    if self._elastic is not None and self._elastic.escalation_due():
+                        probe_err = self._elastic.escalate()
+                        if probe_err is not None:
+                            self._elastic.recover(self, probe_err)
+                            recovered = True
+                            break
                     t_wait0 = time.perf_counter()
                     batch = next(batches, None)
                     t_wait1 = time.perf_counter()
                     if batch is None:
                         break
                     step_id += 1
-                    with tracing.start_trace(
-                        "trainer.step", epoch=epoch_id,
-                    ) as step_span:
-                        # the step trace begins where the data wait began
-                        step_span.t0_us = t_wait0 * 1e6
-                        step_span.set(step=self.global_step)
-                        tracing.record_span("trainer.data_wait", t_wait0, t_wait1)
-                        begin_ev = BeginStepEvent(epoch_id, step_id)
-                        handler(begin_ev)
-                        # fault point: "error" raises here (a crashing step),
-                        # "nan" forces this step to count as non-finite,
-                        # "preempt" delivers SIGTERM (handled at the boundary below)
-                        spec = faults.inject(
-                            faults.TRAINER_STEP, epoch=epoch_id, step=step_id
-                        )
-                        t_step = time.perf_counter()
-                        if self._watchdog is not None:
-                            with self._watchdog.watch(f"epoch {epoch_id} step {step_id}"):
+                    try:
+                        with tracing.start_trace(
+                            "trainer.step", epoch=epoch_id,
+                        ) as step_span:
+                            # the step trace begins where the data wait began
+                            step_span.t0_us = t_wait0 * 1e6
+                            step_span.set(step=self.global_step)
+                            tracing.record_span("trainer.data_wait", t_wait0, t_wait1)
+                            begin_ev = BeginStepEvent(epoch_id, step_id)
+                            handler(begin_ev)
+                            # elastic fault points: a scheduler's advance
+                            # preemption notice ("preempt" -> SIGTERM, handled
+                            # at the boundary below) and a device vanishing
+                            # ("error" -> DeviceLostError, recovered below)
+                            faults.inject(
+                                faults.PREEMPT_NOTICE, epoch=epoch_id, step=step_id
+                            )
+                            faults.inject(
+                                faults.DEVICE_LOST, epoch=epoch_id, step=step_id
+                            )
+                            # fault point: "error" raises here (a crashing step),
+                            # "nan" forces this step to count as non-finite,
+                            # "preempt" delivers SIGTERM (handled at the boundary below)
+                            spec = faults.inject(
+                                faults.TRAINER_STEP, epoch=epoch_id, step=step_id
+                            )
+                            t_step = time.perf_counter()
+                            if self._watchdog is not None:
+                                with self._watchdog.watch(f"epoch {epoch_id} step {step_id}"):
+                                    out = self._run_step(batch)
+                            else:
                                 out = self._run_step(batch)
-                        else:
-                            out = self._run_step(batch)
-                        bad = (out.finite is not None and not bool(out.finite)) or (
-                            spec is not None and spec.kind == "nan"
-                        )
-                        if bad:
-                            step_span.set(status="bad_step")
-                            # charge the wasted step to badput even if the policy
-                            # raises below — the accounting outlives the run
-                            self.goodput.record_bad(
-                                time.perf_counter() - t_step, "nan_skip")
-                            # may raise (policy "raise", or rollback gave up)
-                            self._handle_bad_step(epoch_id, step_id)
-                            metrics = float("nan") if begin_ev.fetch_metrics else None
-                        else:
-                            self._consec_bad = 0
-                            self._rollbacks_since_good = 0
-                            self.variables, self.opt_state = out.variables, out.opt_state
-                            self.global_step += 1
-                            # honoring fetch_metrics avoids a host sync per step
-                            # (reference BeginStepEvent.fetch_metrics, trainer.py:158)
-                            metrics = float(out.loss) if begin_ev.fetch_metrics else None
-                            self._record_step(
-                                epoch_id, batch, time.perf_counter() - t_step,
-                                metrics)
-                        handler(EndStepEvent(epoch_id, step_id, metrics))
-                        if self._preempt_requested:
-                            with tracing.start_span("trainer.checkpoint",
-                                                    reason="preempt"):
-                                self._preemption_save(next_epoch=epoch_id)
-                            return
-                        with tracing.start_span("trainer.checkpoint"):
-                            self._maybe_checkpoint(epoch_id, step=True)
+                            bad = (out.finite is not None and not bool(out.finite)) or (
+                                spec is not None and spec.kind == "nan"
+                            )
+                            if bad:
+                                step_span.set(status="bad_step")
+                                # charge the wasted step to badput even if the policy
+                                # raises below — the accounting outlives the run
+                                self.goodput.record_bad(
+                                    time.perf_counter() - t_step, "nan_skip")
+                                # may raise (policy "raise", or rollback gave up)
+                                self._handle_bad_step(epoch_id, step_id)
+                                metrics = float("nan") if begin_ev.fetch_metrics else None
+                            else:
+                                self._consec_bad = 0
+                                self._rollbacks_since_good = 0
+                                self.variables, self.opt_state = out.variables, out.opt_state
+                                self.global_step += 1
+                                # honoring fetch_metrics avoids a host sync per step
+                                # (reference BeginStepEvent.fetch_metrics, trainer.py:158)
+                                metrics = float(out.loss) if begin_ev.fetch_metrics else None
+                                self._record_step(
+                                    epoch_id, batch, time.perf_counter() - t_step,
+                                    metrics)
+                            handler(EndStepEvent(epoch_id, step_id, metrics))
+                            if self._preempt_requested:
+                                with tracing.start_span("trainer.checkpoint",
+                                                        reason="preempt"):
+                                    self._preemption_save(next_epoch=epoch_id)
+                                return
+                            with tracing.start_span("trainer.checkpoint"):
+                                self._maybe_checkpoint(epoch_id, step=True)
+                            if self._elastic is not None:
+                                # regrow only at a checkpoint boundary (the
+                                # supervisor checks; state is durable there)
+                                self._elastic.maybe_regrow(self)
+                    except Exception as e:
+                        if self._elastic is None or not elastic_mod.is_device_loss(e):
+                            raise
+                        # device loss: shrink the mesh to the survivors,
+                        # restore the freshest snapshot/serial, restart the
+                        # interrupted epoch from the restored step
+                        self._elastic.recover(self, e)
+                        recovered = True
+                        break
+                if recovered:
+                    epoch_id = self.epoch  # the restored manifest's epoch
+                    continue
                 handler(EndEpochEvent(epoch_id))
                 with tracing.start_span("trainer.checkpoint", boundary="epoch"):
                     self._maybe_checkpoint(epoch_id, step=False)
@@ -322,6 +387,7 @@ class Trainer:
                     # the epoch just COMPLETED — resume must not re-train it
                     self._preemption_save(next_epoch=epoch_id + 1)
                     return
+                epoch_id += 1
         finally:
             self._restore_signal_handlers(prev_handlers)
             if self._watchdog is not None:
@@ -416,6 +482,10 @@ class Trainer:
         # the stalled wall time against goodput here (trainer-side policy)
         self.goodput.record_bad(elapsed, "stall")
         prof.set_gauge("trainer.goodput_frac", self.goodput.goodput_frac())
+        if self._elastic is not None:
+            # repeated stalls without recovery escalate to a device-liveness
+            # probe at the next step boundary (supervisor counts them)
+            self._elastic.note_stall()
 
     # -- self-healing (resilience.ResilienceConfig) -------------------------
     def _handle_bad_step(self, epoch_id: int, step_id: int) -> None:
@@ -734,6 +804,11 @@ class Trainer:
     def stop(self):
         from paddle_tpu import checkpoint_sharded as cks
 
+        # detach OUR snapshot listener (== not `is`: bound methods are
+        # recreated per access) so a later trainer's saves don't feed a
+        # dead supervisor
+        if self._elastic is not None and cks._snapshot_listener == self._elastic.note_snapshot:
+            cks.set_snapshot_listener(None)
         try:
             cks.wait_pending_save()  # last async checkpoint must be durable
         finally:

@@ -264,3 +264,110 @@ def test_cross_mesh_resharded_restore_subprocesses(tmp_path):
         assert proc.returncode == 0, f"{mode} failed:\n{proc.stderr[-3000:]}"
         if mode != "save":
             assert f"CROSS_MESH_OK {mode}" in proc.stdout
+
+def _async_tree(scale=1.0):
+    mesh = make_mesh(data=4, model=2)
+    spec = NamedSharding(mesh, P("data", "model"))
+    x = jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4) * scale
+    return {"w": jax.device_put(x, spec)}, x
+
+
+def test_wait_pending_save_timeout_keeps_pending(tmp_path):
+    """A wait that times out must NOT clear the pending slot — the writer
+    thread is still alive and a new save would race it."""
+    from paddle_tpu.resilience import faults
+
+    tree, x = _async_tree()
+    with faults.injected(
+        faults.FaultSpec(faults.CHECKPOINT_SAVE, "stall", stall_s=1.0, times=1)
+    ):
+        cks.save_sharded_async(str(tmp_path), tree, step=1)
+        with pytest.raises(Exception, match="timed out"):
+            cks.wait_pending_save(timeout=0.05)
+        # still pending: a later patient wait drains it and returns the dir
+        path = cks.wait_pending_save(timeout=60)
+    assert path.endswith("checkpoint_1")
+    assert cks.wait_pending_save() is None
+
+
+def test_wait_pending_save_raises_writer_error_once(tmp_path):
+    """Writer errors re-raise from wait_pending_save (exit-time contract),
+    then the slot clears — one failure must not raise forever."""
+    from paddle_tpu.resilience import faults
+
+    tree, _ = _async_tree()
+    # times=3 outlasts retry_call's 3 attempts inside the writer thread
+    with faults.injected(
+        faults.FaultSpec(faults.CHECKPOINT_SAVE, "error", times=3)
+    ):
+        h = cks.save_sharded_async(str(tmp_path), tree, step=1)
+        with pytest.raises(OSError):
+            h.result(timeout=60)
+        with pytest.raises(OSError):
+            cks.wait_pending_save(timeout=60)
+    assert cks.wait_pending_save() is None  # cleared after raising
+
+
+def test_failed_async_save_alerts_and_next_save_proceeds(tmp_path):
+    """A previous save's writer error must not abort the NEXT save (it
+    carries fresher state): the drain surfaces the failure as a runlog
+    alert + checkpoint.async_errors_total and proceeds."""
+    from paddle_tpu.core import profiler as prof
+    from paddle_tpu.observability.runlog import RunLog, read_runlog, set_runlog
+    from paddle_tpu.resilience import faults
+
+    runlog_path = str(tmp_path / "runlog.jsonl")
+    prev = set_runlog(RunLog(runlog_path))
+    try:
+        tree, _ = _async_tree()
+        tree2, x2 = _async_tree(scale=2.0)
+        with faults.injected(
+            faults.FaultSpec(faults.CHECKPOINT_SAVE, "error", times=3)
+        ):
+            h1 = cks.save_sharded_async(str(tmp_path / "ckpt"), tree, step=1)
+            with pytest.raises(OSError):
+                h1.result(timeout=60)
+        # the errored handle is still pending; the next save drains it
+        before = prof.counters().get("checkpoint.async_errors_total", 0)
+        h2 = cks.save_sharded_async(str(tmp_path / "ckpt"), tree2, step=2)
+        assert h2.result(timeout=60).endswith("checkpoint_2")
+        assert prof.counters()["checkpoint.async_errors_total"] == before + 1
+        alerts = [
+            e for e in read_runlog(runlog_path)
+            if e["kind"] == "alert" and e.get("key") == "async_save_failed"
+        ]
+        assert len(alerts) == 1 and alerts[0]["source"] == "checkpoint"
+        assert cks.wait_pending_save(timeout=60).endswith("checkpoint_2")
+        # the published serial is the SECOND save's state
+        like = {"w": jax.ShapeDtypeStruct((8, 4), jnp.float32)}
+        restored, manifest = cks.load_sharded(str(tmp_path / "ckpt"), like)
+        assert manifest["step"] == 2
+        np.testing.assert_allclose(np.asarray(restored["w"]), x2)
+    finally:
+        set_runlog(prev)
+
+
+def test_async_write_telemetry(tmp_path):
+    """The background writer publishes its wall time: a
+    checkpoint.async_write_seconds observation and a
+    checkpoint_async_write runlog event."""
+    from paddle_tpu.observability import default_registry
+    from paddle_tpu.observability.runlog import RunLog, read_runlog, set_runlog
+
+    runlog_path = str(tmp_path / "runlog.jsonl")
+    prev = set_runlog(RunLog(runlog_path))
+    try:
+        snap0 = default_registry().histogram_snapshot("checkpoint.async_write_seconds")
+        count0 = snap0["count"] if snap0 else 0
+        tree, _ = _async_tree()
+        path = cks.save_sharded_async(str(tmp_path / "ckpt"), tree, step=5).result(timeout=60)
+        snap = default_registry().histogram_snapshot("checkpoint.async_write_seconds")
+        assert snap is not None and snap["count"] == count0 + 1
+        writes = [e for e in read_runlog(runlog_path)
+                  if e["kind"] == "checkpoint_async_write"]
+        assert len(writes) == 1
+        assert writes[0]["step"] == 5 and writes[0]["path"] == path
+        assert writes[0]["seconds"] >= 0
+    finally:
+        set_runlog(prev)
+        cks.wait_pending_save()

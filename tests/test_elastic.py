@@ -1,0 +1,391 @@
+"""Elastic training: device loss -> mesh shrink -> snapshot restore -> resume,
+regrow on device return, preemption-notice drain, and the supporting
+parallel/checkpoint primitives (remesh / DataParallel.resize /
+restore_from_snapshot)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu import checkpoint_sharded as cks
+from paddle_tpu.core import profiler as prof
+from paddle_tpu.observability.runlog import RunLog, read_runlog, set_runlog
+from paddle_tpu.parallel import DataParallel
+from paddle_tpu.parallel.mesh import make_mesh, remesh
+from paddle_tpu.resilience import ResilienceConfig, faults
+from paddle_tpu.resilience.elastic import ElasticSupervisor, is_device_loss
+from paddle_tpu.resilience.faults import DeviceLostError
+from paddle_tpu.trainer import BeginStepEvent, CheckpointConfig, EndStepEvent, Trainer
+
+
+@pytest.fixture(autouse=True)
+def _clean_elastic_state():
+    yield
+    cks.set_snapshot_listener(None)
+    faults.clear()
+    set_runlog(None)
+
+
+def _linreg_model():
+    def net(x, y):
+        pred = pt.layers.fc(x, size=1)
+        return jnp.mean(pt.ops.nn.square_error_cost(pred, y))
+
+    return net
+
+
+def _sgd():
+    return pt.optimizer.SGD(learning_rate=0.1)
+
+
+def _reader(n_batches=8, bs=8, seed=7):
+    def reader():
+        rng = np.random.RandomState(seed)
+        w = np.array([[1.0], [2.0], [3.0], [4.0]], np.float32)
+        for _ in range(n_batches):
+            x = rng.randn(bs, 4).astype(np.float32)
+            yield x, x @ w
+
+    return reader
+
+
+def _collect():
+    losses = []
+
+    def handler(ev):
+        if isinstance(ev, EndStepEvent) and ev.metrics is not None:
+            losses.append(ev.metrics)
+
+    return losses, handler
+
+
+def _elastic_trainer(root, **res_kw):
+    return Trainer(
+        _linreg_model, _sgd, parallel=True,
+        checkpoint_config=CheckpointConfig(
+            str(root), step_interval=2, sharded=True, async_save=True),
+        resilience=ResilienceConfig(elastic=True, **res_kw),
+    )
+
+
+def _device_lost_spec(after, lost_index):
+    return faults.FaultSpec(
+        faults.DEVICE_LOST, "error", after=after, times=1,
+        exc=DeviceLostError("injected device loss", device_indices=(lost_index,)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# primitives: remesh / resize / state_template / restore_from_snapshot
+# ---------------------------------------------------------------------------
+
+
+def test_remesh_keeps_non_resized_axis_sizes():
+    mesh = make_mesh(data=4, model=2)
+    smaller = remesh(mesh, jax.devices()[:6])
+    assert smaller.axis_names == ("data", "model")
+    assert dict(zip(smaller.axis_names, smaller.devices.shape)) == {"data": 3, "model": 2}
+    # non-resized axes must still divide the device count
+    with pytest.raises(Exception):
+        remesh(mesh, jax.devices()[:7])
+
+
+def test_dp_resize_drops_compiled_steps_and_restep(rng):
+    dp = DataParallel(pt.build(_linreg_model()), _sgd(),
+                      mesh=make_mesh(data=-1), donate=False)
+    x = rng.randn(8, 4).astype(np.float32)
+    y = rng.randn(8, 1).astype(np.float32)
+    variables, opt_state = dp.init(0, x, y)
+    out = dp.step(variables, opt_state, x, y)
+    assert dp._step_fn is not None
+    variables, opt_state = out.variables, out.opt_state
+
+    dp.resize(jax.devices()[:4])
+    assert dp._step_fn is None and dp._eval_fn is None and not dp._ragged_step_fns
+    assert dp.num_devices == 4
+    # all source devices are still alive: place_state reshards directly
+    variables, opt_state = dp.place_state(variables, opt_state)
+    out2 = dp.step(variables, opt_state, x, y)
+    assert np.isfinite(float(out2.loss))
+
+
+def test_state_template_matches_state_tree(rng):
+    dp = DataParallel(pt.build(_linreg_model()), _sgd(), mesh=make_mesh(data=-1))
+    x = rng.randn(8, 4).astype(np.float32)
+    y = rng.randn(8, 1).astype(np.float32)
+    variables, opt_state = dp.init(0, x, y)
+    template = dp.state_template(variables, opt_state)
+    t_leaves, t_def = jax.tree_util.tree_flatten(template)
+    s_leaves, s_def = jax.tree_util.tree_flatten((variables, opt_state))
+    assert t_def == s_def
+    for t, s in zip(t_leaves, s_leaves):
+        assert isinstance(t, jax.ShapeDtypeStruct)
+        assert t.shape == jnp.shape(s) and t.sharding is not None
+
+
+def test_restore_from_snapshot_onto_shrunken_mesh(tmp_path):
+    mesh = make_mesh(data=-1)
+    spec = NamedSharding(mesh, P("data", None))
+    x = jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4)
+    tree = {"w": jax.device_put(x, spec), "s": jnp.float32(5.0)}
+
+    captured = []
+    cks.set_snapshot_listener(lambda sd, m: captured.append((sd, m)))
+    h = cks.save_sharded_async(str(tmp_path), tree, step=3)
+    h.result(timeout=60)
+    assert captured
+    shard_data, manifest = captured[-1]
+
+    # restore the snapshot onto a 7-device mesh with different layouts
+    small = remesh(mesh, jax.devices()[:7])
+    like = {
+        "w": jax.ShapeDtypeStruct((8, 4), jnp.float32,
+                                  sharding=NamedSharding(small, P(None, None))),
+        "s": jax.ShapeDtypeStruct((), jnp.float32,
+                                  sharding=NamedSharding(small, P())),
+    }
+    restored, meta = cks.restore_from_snapshot(shard_data, manifest, like)
+    assert int(meta["step"]) == 3
+    np.testing.assert_allclose(np.asarray(restored["w"]), np.asarray(x))
+    assert float(restored["s"]) == 5.0
+    assert set(restored["w"].sharding.mesh.devices.ravel()) <= set(jax.devices()[:7])
+
+
+def test_is_device_loss_classification():
+    assert is_device_loss(DeviceLostError("x"))
+    assert is_device_loss(RuntimeError("DATA_LOSS: device halted mid collective"))
+    assert not is_device_loss(RuntimeError("shape mismatch"))
+    assert not is_device_loss(ValueError("data_loss"))  # not a runtime error
+
+
+def test_attribute_loss_prefers_indices_then_probe_then_tail():
+    n = len(jax.devices())
+    sup = ElasticSupervisor(ResilienceConfig(elastic=True), devices=list(jax.devices()))
+    assert sup._attribute_loss(DeviceLostError("x", device_indices=(2, 5))) == [2, 5]
+    # no indices, no probe: blame the highest-index survivor
+    assert sup._attribute_loss(DeviceLostError("who knows")) == [n - 1]
+    # with a probe, the probe's answer wins
+    sup.probe = lambda: [i for i in range(n) if i != 2]
+    assert sup._attribute_loss(DeviceLostError("who knows")) == [2]
+
+
+def test_escalate_resets_counter_when_all_alive():
+    sup = ElasticSupervisor(
+        ResilienceConfig(elastic=True, elastic_escalate_stalls=1),
+        devices=list(jax.devices()),
+        probe=lambda: range(len(jax.devices())),
+    )
+    sup.note_stall()
+    assert sup.escalation_due()
+    assert sup.escalate() is None  # everything alive
+    assert not sup.escalation_due()  # counter reset
+    # a probe that reports a dead device produces an attributed loss
+    sup.probe = lambda: [i for i in range(len(jax.devices())) if i != 3]
+    sup.note_stall()
+    err = sup.escalate()
+    assert isinstance(err, DeviceLostError) and err.device_indices == (3,)
+
+
+# ---------------------------------------------------------------------------
+# tentpole: shrink on device loss, identical trajectory to a cold restart
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_shrink_matches_cold_restart(tmp_path):
+    """Injected device loss mid-training: the mesh rebuilds at N-1, training
+    resumes from the freshest snapshot, and the post-resume loss trajectory
+    is IDENTICAL to killing the job and cold-restarting from the same
+    checkpoint on the surviving devices."""
+    runlog_path = str(tmp_path / "runlog.jsonl")
+    set_runlog(RunLog(runlog_path))
+    n = len(jax.devices())
+    lost = 3
+
+    # elastic run: loss at step 5 recovers from the step-4 snapshot
+    losses_a, handler_a = _collect()
+    with faults.injected(_device_lost_spec(after=5, lost_index=lost)) as plan:
+        ta = _elastic_trainer(tmp_path / "a")
+        ta.train(num_epochs=1, reader=_reader(), event_handler=handler_a)
+        assert plan.all_fired()
+    assert ta._elastic.shrinks == 1
+    assert ta._dp.num_devices == n - 1
+    rec = ta._elastic.last_recovery
+    assert rec["source"] == "snapshot" and rec["restored_step"] == 4
+    # 5 good steps, then the interrupted epoch replays from step 4
+    assert ta.global_step == 12 and len(losses_a) == 13
+    assert "elastic_recovery" in ta.goodput.badput_by_category()
+    assert prof.counters().get("elastic.shrinks_total", 0) >= 1
+
+    # control: the same loss WITHOUT elastic is fatal; a cold restart on
+    # the surviving devices resumes from the same step-4 serial
+    losses_b, handler_b = _collect()
+    with faults.injected(_device_lost_spec(after=5, lost_index=lost)):
+        tb = Trainer(
+            _linreg_model, _sgd, parallel=True,
+            checkpoint_config=CheckpointConfig(
+                str(tmp_path / "b"), step_interval=2, sharded=True, async_save=True),
+        )
+        with pytest.raises(DeviceLostError):
+            tb.train(num_epochs=1, reader=_reader(), event_handler=handler_b)
+    survivors = [d for i, d in enumerate(jax.devices()) if i != lost]
+    losses_c, handler_c = _collect()
+    tc = Trainer(
+        _linreg_model, _sgd, parallel=True,
+        parallel_kwargs={"mesh": make_mesh({"data": -1}, devices=survivors)},
+        checkpoint_config=CheckpointConfig(
+            str(tmp_path / "b"), step_interval=2, sharded=True, async_save=True),
+    )
+    tc.train(num_epochs=1, reader=_reader(), event_handler=handler_c,
+             allow_ragged=True)
+    assert tc.global_step == ta.global_step == 12
+    np.testing.assert_allclose(losses_a[5:], losses_c, rtol=1e-6)
+
+    # telemetry: one elastic_shrink runlog event, trace-correlated
+    events = read_runlog(runlog_path)
+    shrinks = [e for e in events if e["kind"] == "elastic_shrink"]
+    assert len(shrinks) == 1
+    ev = shrinks[0]
+    assert ev["devices_before"] == n and ev["devices_after"] == n - 1
+    assert ev["source"] == "snapshot" and ev["step"] == 4
+    assert ev.get("trace_id")  # emitted inside the trainer.elastic_recover trace
+
+
+def test_elastic_shrink_restores_from_disk_without_snapshot(tmp_path):
+    """With no in-memory snapshot available, recovery falls back to the last
+    good serial on disk (draining the in-flight async save first)."""
+    t = _elastic_trainer(tmp_path)
+
+    def handler(ev):
+        # simulate a supervisor that never captured a snapshot (e.g. the
+        # process that saved is not the one recovering)
+        if isinstance(ev, BeginStepEvent) and t._elastic is not None:
+            t._elastic._snapshot = None
+
+    with faults.injected(_device_lost_spec(after=5, lost_index=1)) as plan:
+        t.train(num_epochs=1, reader=_reader(), event_handler=handler)
+        assert plan.all_fired()
+    assert t._elastic.shrinks == 1
+    assert t._elastic.last_recovery["source"] == "disk"
+    assert t._elastic.last_recovery["restored_step"] == 4
+    assert t.global_step == 12
+
+
+def test_elastic_shrink_below_min_devices_gives_up(tmp_path):
+    with faults.injected(_device_lost_spec(after=3, lost_index=0)):
+        t = _elastic_trainer(tmp_path, elastic_min_devices=len(jax.devices()))
+        with pytest.raises(Exception, match="elastic"):
+            t.train(num_epochs=1, reader=_reader())
+
+
+def test_elastic_regrow_at_checkpoint_boundary(tmp_path):
+    runlog_path = str(tmp_path / "runlog.jsonl")
+    set_runlog(RunLog(runlog_path))
+    n = len(jax.devices())
+    with faults.injected(_device_lost_spec(after=3, lost_index=5)):
+        t = _elastic_trainer(tmp_path / "ckpt")
+        t.train(num_epochs=1, reader=_reader())
+    assert t._dp.num_devices == n - 1 and t._elastic.lost == {5}
+    # the lost device comes back: the next checkpoint boundary regrows
+    t._elastic.probe = lambda: range(n)
+    losses, handler = _collect()
+    t.train(num_epochs=2, reader=_reader(), event_handler=handler)
+    assert t._elastic.regrows == 1
+    assert t._dp.num_devices == n
+    assert not t._elastic.lost
+    assert losses and all(np.isfinite(l) for l in losses)
+    events = read_runlog(runlog_path)
+    regrows = [e for e in events if e["kind"] == "elastic_regrow"]
+    assert len(regrows) == 1
+    assert regrows[0]["devices_after"] == n
+    assert prof.counters().get("elastic.regrows_total", 0) >= 1
+
+
+def test_preempt_notice_drains_final_save_and_resumes(tmp_path):
+    """faults.PREEMPT_NOTICE (kind "preempt") delivers a real SIGTERM: the
+    trainer finishes the step, saves, drains the async writer, and returns
+    cleanly with a resume marker; a fresh Trainer auto-resumes."""
+    root = tmp_path / "ckpt"
+    with faults.injected(
+        faults.FaultSpec(faults.PREEMPT_NOTICE, "preempt", after=3, times=1)
+    ) as plan:
+        t = _elastic_trainer(root)
+        t.train(num_epochs=2, reader=_reader())
+        assert plan.all_fired()
+    assert t.preempted and t.global_step == 4
+    # train() returned => the final save is durable and nothing is pending
+    assert cks.wait_pending_save() is None
+    latest = cks.latest_sharded_checkpoint(str(root))
+    with open(os.path.join(latest, "manifest.json")) as f:
+        meta = json.load(f)
+    assert meta["step"] == 4 and meta["preempted"] is True and meta["next_epoch"] == 0
+
+    t2 = _elastic_trainer(root)
+    t2.train(num_epochs=2, reader=_reader())
+    assert not t2.preempted
+    # resumed at step 4, replayed the interrupted epoch (8) + epoch 1 (8)
+    assert t2.global_step == 20
+
+
+def test_stall_escalation_probes_and_shrinks(tmp_path):
+    """elastic_escalate_stalls watchdog stalls -> device-liveness probe ->
+    the dead device recovers through the same shrink path as a raised
+    loss, at the next step boundary."""
+    n = len(jax.devices())
+    t = _elastic_trainer(tmp_path, elastic_escalate_stalls=2)
+    losses = []
+
+    def handler(ev):
+        if not isinstance(ev, EndStepEvent):
+            return
+        losses.append(ev.metrics)
+        if ev.epoch == 0 and ev.step == 3 and t._elastic.shrinks == 0:
+            t._elastic.probe = lambda: [i for i in range(n) if i != 4]
+            # two stalls, as the watchdog's on_stall would deliver them
+            t._on_stall("epoch 0 step 3", 0.25)
+            t._on_stall("epoch 0 step 3", 0.25)
+
+    t.train(num_epochs=1, reader=_reader(), event_handler=handler)
+    sup = t._elastic
+    assert sup.shrinks == 1 and sup.lost == {4}
+    assert t._dp.num_devices == n - 1
+    # escalation fired between steps: snapshot restore from the step-4
+    # save (checkpointing runs after the EndStepEvent that queued the
+    # stalls), then the epoch replays (4 good steps + 8 replayed)
+    assert sup.last_recovery["restored_step"] == 4
+    assert t.global_step == 12 and len(losses) == 12
+    bad = t.goodput.badput_by_category()
+    assert bad.get("stall") == pytest.approx(0.5)
+    assert "elastic_recovery" in bad
+
+
+def test_elastic_requires_parallel_and_sharded(tmp_path):
+    t = Trainer(_linreg_model, _sgd, parallel=False,
+                resilience=ResilienceConfig(elastic=True))
+    with pytest.raises(Exception, match="parallel"):
+        t.train(num_epochs=1, reader=_reader(n_batches=1))
+    t2 = Trainer(_linreg_model, _sgd, parallel=True,
+                 resilience=ResilienceConfig(elastic=True))
+    with pytest.raises(Exception, match="sharded"):
+        t2.train(num_epochs=1, reader=_reader(n_batches=1))
+
+
+def test_elastic_flags_roundtrip(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_ELASTIC", "1")
+    monkeypatch.setenv("PADDLE_TPU_ELASTIC_MIN_DEVICES", "2")
+    monkeypatch.setenv("PADDLE_TPU_ELASTIC_REGROW", "0")
+    monkeypatch.setenv("PADDLE_TPU_ELASTIC_ESCALATE_STALLS", "5")
+    from paddle_tpu.core.config import Flags
+
+    f = Flags().load_env()
+    assert f.elastic is True and f.elastic_min_devices == 2
+    assert f.elastic_regrow is False and f.elastic_escalate_stalls == 5
+    monkeypatch.setattr("paddle_tpu.core.config._flags", f)
+    cfg = ResilienceConfig.from_flags()
+    assert cfg.elastic and cfg.elastic_min_devices == 2
+    assert not cfg.elastic_regrow and cfg.elastic_escalate_stalls == 5

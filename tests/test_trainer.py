@@ -264,3 +264,60 @@ def test_trainer_prefetch_matches_plain(parallel):
         return losses
 
     np.testing.assert_allclose(run(False), run(True), rtol=1e-6)
+
+
+def test_train_exception_exit_drains_async_save(tmp_path):
+    """train() unwinding with an exception must still drain the in-flight
+    async save — the last queued checkpoint stays durable."""
+    from paddle_tpu import checkpoint_sharded as cks
+
+    root = str(tmp_path / "ckpt")
+
+    def bad_reader():
+        for i, batch in enumerate(_reader(n_batches=8)()):
+            if i == 3:  # steps 1..3 ran; the step-2 async save is queued
+                raise RuntimeError("reader exploded")
+            yield batch
+
+    t = Trainer(
+        _linreg_model, lambda: pt.optimizer.SGD(learning_rate=0.1),
+        parallel=True,
+        checkpoint_config=CheckpointConfig(
+            root, step_interval=2, sharded=True, async_save=True),
+    )
+    with pytest.raises(RuntimeError, match="reader exploded"):
+        t.train(num_epochs=1, reader=lambda: bad_reader())
+    # the finally-block drain already joined the writer: nothing pending,
+    # and the step-2 serial is published
+    assert cks.wait_pending_save() is None
+    assert cks.latest_sharded_checkpoint(root).endswith("checkpoint_2")
+
+
+def test_train_exception_exit_writer_failure_does_not_mask_error(tmp_path):
+    """If the async writer ALSO failed while train() unwinds, the reader's
+    exception (the root cause) must propagate, not the writer's."""
+    from paddle_tpu import checkpoint_sharded as cks
+    from paddle_tpu.resilience import faults
+
+    root = str(tmp_path / "ckpt")
+
+    def bad_reader():
+        for i, batch in enumerate(_reader(n_batches=8)()):
+            if i == 3:
+                raise RuntimeError("reader exploded")
+            yield batch
+
+    t = Trainer(
+        _linreg_model, lambda: pt.optimizer.SGD(learning_rate=0.1),
+        parallel=True,
+        checkpoint_config=CheckpointConfig(
+            root, step_interval=2, sharded=True, async_save=True),
+    )
+    # times=3 outlasts the writer's 3 retry attempts: the step-2 save fails
+    with faults.injected(
+        faults.FaultSpec(faults.CHECKPOINT_SAVE, "error", times=3)
+    ):
+        with pytest.raises(RuntimeError, match="reader exploded"):
+            t.train(num_epochs=1, reader=lambda: bad_reader())
+    assert cks.wait_pending_save() is None  # drained (failure logged)
+    assert cks.latest_sharded_checkpoint(root) is None  # nothing published

@@ -12,6 +12,10 @@ actually recovered:
   still finished with a finite loss;
 - auto-resume fell back past the corrupt serial (quarantined ``*.corrupt``)
   to the previous good one;
+- a device lost mid-training shrank the mesh to the survivors and resumed
+  from the freshest async-save snapshot within one checkpoint interval
+  (``ResilienceConfig(elastic=True)``), and a preemption notice drained a
+  final save and auto-resumed in a fresh trainer;
 - serving ejected the sick replica (circuit breaker), redispatched its
   batches, kept answering every request, and re-admitted the replica after
   the faults stopped.
@@ -143,6 +147,85 @@ def _corrupt_resume_phase(root: str) -> None:
           f"(quarantined {quarantined})")
 
 
+def _elastic_phase(work: str, seed: int) -> None:
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import checkpoint_sharded as cks
+    from paddle_tpu.resilience import ResilienceConfig, faults
+    from paddle_tpu.resilience.faults import DeviceLostError
+    from paddle_tpu.trainer import CheckpointConfig, Trainer
+
+    def net(x, y):
+        pred = pt.layers.fc(x, size=1)
+        return pt.layers.mean((pred - y) ** 2)
+
+    n = jax.device_count()
+    check(n >= 2, f"elastic phase needs >= 2 devices, got {n}")
+
+    def make_trainer(root):
+        return Trainer(
+            lambda: net, lambda: pt.optimizer.SGD(learning_rate=0.1),
+            parallel=True,
+            checkpoint_config=CheckpointConfig(
+                root, step_interval=2, sharded=True, async_save=True),
+            resilience=ResilienceConfig(elastic=True),
+        )
+
+    try:
+        # leg 1: a device vanishes mid-training — the mesh must shrink to
+        # the survivors and resume from the freshest snapshot, losing at
+        # most one checkpoint interval of steps
+        root = os.path.join(work, "elastic_ckpt")
+        with faults.injected(
+            faults.FaultSpec(
+                faults.DEVICE_LOST, "error", after=5, times=1,
+                exc=DeviceLostError("chaos: device reclaimed",
+                                    device_indices=(n - 1,)),
+            ),
+            seed=seed,
+        ) as plan:
+            t = make_trainer(root)
+            t.train(num_epochs=1, reader=_reader())
+            check(plan.all_fired(), f"device-loss fault never fired: {plan.stats()}")
+        sup = t._elastic
+        check(sup is not None and sup.shrinks == 1,
+              f"mesh never shrank: {sup and sup.shrinks}")
+        check(t._dp.num_devices == n - 1,
+              f"expected {n - 1} surviving devices, got {t._dp.num_devices}")
+        rec = sup.last_recovery
+        check(rec is not None and 5 - rec["restored_step"] <= 2,
+              f"resumed outside the checkpoint interval: {rec}")
+        check(t.global_step == 12,
+              f"epoch did not finish after recovery: step {t.global_step}")
+        check(np.isfinite(float(np.asarray(t.variables.params["fc/w"]).sum())),
+              "params not finite after elastic recovery")
+        print(f"[chaos] elastic: shrank {n} -> {t._dp.num_devices} devices, "
+              f"resumed from step {rec['restored_step']} ({rec['source']})")
+
+        # leg 2: a preemption notice (real SIGTERM) — the trainer must
+        # finish the step, drain a final save, exit cleanly with a resume
+        # marker, and a fresh trainer must auto-resume from it
+        root2 = os.path.join(work, "elastic_preempt")
+        with faults.injected(
+            faults.FaultSpec(faults.PREEMPT_NOTICE, "preempt", after=2, times=1),
+            seed=seed,
+        ) as plan:
+            t1 = make_trainer(root2)
+            t1.train(num_epochs=1, reader=_reader())
+            check(plan.all_fired(), f"preempt notice never fired: {plan.stats()}")
+        check(t1.preempted and t1.global_step == 3,
+              f"preemption not honored at the step boundary: {t1.global_step}")
+        check(cks.wait_pending_save() is None, "final save not drained at exit")
+        t2 = make_trainer(root2)
+        t2.train(num_epochs=1, reader=_reader())
+        check(not t2.preempted and t2.global_step == 11,
+              f"auto-resume after preemption failed: step {t2.global_step}")
+        print(f"[chaos] elastic: preempted at step 3 with a drained save, "
+              f"auto-resumed to step {t2.global_step}")
+    finally:
+        cks.set_snapshot_listener(None)
+
+
 def _serving_phase(seed: int) -> None:
     import paddle_tpu as pt
     from paddle_tpu.reader.feeder import FeedSpec
@@ -212,6 +295,7 @@ def main(argv=None) -> int:
     try:
         _train_phase(root, args.seed)
         _corrupt_resume_phase(root)
+        _elastic_phase(work, args.seed)
         _serving_phase(args.seed)
     except ChaosFailure as e:
         print(f"[chaos] FAIL: {e}", file=sys.stderr)
