@@ -575,12 +575,16 @@ def child_main(tiny: bool, force_cpu: bool = False) -> None:
     print(json.dumps(result))
 
 
-def serve_main(duration_s: float = 3.0) -> dict:
+def serve_main(duration_s: float = 3.0, tenant_mix: bool = False) -> dict:
     """Serving-engine benchmark (``bench.py --serve``): closed-loop client
     threads against ``paddle_tpu.serving.ServingEngine`` on CPU JAX.
     Prints ONE JSON line: throughput (req/s), mean batch occupancy, and
     p50/p99 request latency — the three numbers that tell whether dynamic
-    batching is doing its job (occupancy > 1 at sane tail latency)."""
+    batching is doing its job (occupancy > 1 at sane tail latency).
+
+    With ``--tenants`` (or ``PT_BENCH_TENANT_MIX=1``) the run goes through
+    admission control with a 4:1 interactive/batch tenant pair and reports
+    per-tenant throughput plus shed counts — the overload-protection view."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -590,7 +594,12 @@ def serve_main(duration_s: float = 3.0) -> dict:
 
     import paddle_tpu as pt
     from paddle_tpu.reader.feeder import FeedSpec
-    from paddle_tpu.serving import ServingConfig, ServingEngine
+    from paddle_tpu.serving import (
+        AdmissionRejected,
+        ServingConfig,
+        ServingEngine,
+        TenantConfig,
+    )
 
     d_in, n_clients = 32, 8
     result = {
@@ -607,6 +616,13 @@ def serve_main(duration_s: float = 3.0) -> dict:
         model = pt.build(net)
         rng = np.random.RandomState(0)
         variables = model.init(0, rng.randn(4, d_in).astype(np.float32))
+        tenants = None
+        if tenant_mix:
+            tenants = [
+                TenantConfig("interactive", weight=4.0, queue_capacity=64),
+                TenantConfig("batch", weight=1.0, queue_capacity=64,
+                             default_class="batch"),
+            ]
         engine = ServingEngine(
             model,
             variables,
@@ -616,17 +632,32 @@ def serve_main(duration_s: float = 3.0) -> dict:
                 max_queue_delay_s=0.002,
                 queue_capacity=256,
                 num_replicas=2,
+                tenants=tenants,
             ),
         )
         stop = time.monotonic() + duration_s
         counts = [0] * n_clients
+        sheds = [0] * n_clients
+        # 3 of 4 clients drive the interactive tenant: sustained overload
+        # on one side so the fairness/shed numbers mean something
+        tenant_of = [
+            "interactive" if ci % 4 else "batch" for ci in range(n_clients)
+        ]
 
         def client(ci):
             r = np.random.RandomState(ci)
             while time.monotonic() < stop:
                 n = 1 + r.randint(4)  # mixed request sizes keep buckets honest
                 x = r.randn(n, d_in).astype(np.float32)
-                engine.infer({"x": x})
+                if tenant_mix:
+                    try:
+                        engine.infer({"x": x}, tenant=tenant_of[ci],
+                                     retries=2, backoff=0.002)
+                    except AdmissionRejected:
+                        sheds[ci] += 1
+                        continue
+                else:
+                    engine.infer({"x": x})
                 counts[ci] += 1
 
         t0 = time.perf_counter()
@@ -653,6 +684,18 @@ def serve_main(duration_s: float = 3.0) -> dict:
         result["errors_total"] = snap["errors_total"]
         result["warmup_executables"] = snap["warmup_executables"]
         result["distinct_dispatch_shapes"] = snap["distinct_dispatch_shapes"]
+        if tenant_mix:
+            per_tenant = {}
+            for name in ("interactive", "batch"):
+                cis = [ci for ci in range(n_clients) if tenant_of[ci] == name]
+                per_tenant[name] = {
+                    "req_per_sec": round(sum(counts[ci] for ci in cis) / dt, 1),
+                    "shed": sum(sheds[ci] for ci in cis),
+                    "admitted_total": engine.metrics.tenant_admitted(name),
+                    "shed_by_reason": engine.metrics.tenant_shed(name),
+                }
+            result["tenants"] = per_tenant
+            result["retries_total"] = snap["retries_total"]
     except Exception as e:  # same robustness contract as main(): always JSON
         result["notes"].append(f"serve_failed: {type(e).__name__}: {e}"[:300])
     print(json.dumps(result))
@@ -765,6 +808,10 @@ if __name__ == "__main__":
     if "--child" in sys.argv:
         child_main(tiny="--tiny" in sys.argv, force_cpu="--cpu" in sys.argv)
     elif "--serve" in sys.argv:
-        serve_main(duration_s=float(os.environ.get("PT_BENCH_SERVE_S", "3")))
+        serve_main(
+            duration_s=float(os.environ.get("PT_BENCH_SERVE_S", "3")),
+            tenant_mix=("--tenants" in sys.argv
+                        or os.environ.get("PT_BENCH_TENANT_MIX") == "1"),
+        )
     else:
         main()

@@ -40,6 +40,7 @@ from typing import Any, Callable, Iterable, Optional, Sequence
 __all__ = [
     "Channel",
     "ChannelClosedError",
+    "ChannelFull",
     "make_channel",
     "channel_send",
     "channel_recv",
@@ -53,6 +54,12 @@ __all__ = [
 
 class ChannelClosedError(RuntimeError):
     """Raised by ``send`` on a closed channel (Go panics; we raise)."""
+
+
+class ChannelFull(RuntimeError):
+    """Raised by :meth:`Channel.try_send` when the send cannot complete
+    immediately — the typed signal load-shedding paths branch on (Go's
+    ``select { case ch <- v: default: }`` taking the default)."""
 
 
 class _Waiter:
@@ -158,6 +165,37 @@ class Channel:
                 self._movement.wait(remaining)
             if w.closed and not w.taken:
                 raise ChannelClosedError("channel closed while sending")
+
+    def try_send(self, value) -> None:
+        """Non-blocking send: complete immediately or raise
+        :class:`ChannelFull` — never parks the caller (the primitive
+        shedding paths need: reject work you cannot take NOW).
+
+        Buffered: succeeds while buffer space is free. Unbuffered:
+        succeeds only when a receiver is already parked in ``recv`` — the
+        value is committed to the send queue for it to take. (If that
+        receiver then times out before taking it, the value stays queued
+        for the next receiver, exactly as a timed-out ``send`` that was
+        taken mid-removal behaves.) Raises :class:`ChannelClosedError` on
+        a closed channel."""
+        with self._lock:
+            if self._closed:
+                raise ChannelClosedError("send on closed channel")
+            if self.capacity > 0 and len(self._buf) < self.capacity:
+                self._buf.append(value)
+                self._readable.notify()
+                self._movement.notify_all()
+                return
+            if self.capacity == 0 and self._recv_waiting > 0:
+                w = _Waiter(value)
+                w.taken = True  # committed: no sender will wait on it
+                self._senders.append(w)
+                self._readable.notify()
+                self._movement.notify_all()
+                return
+            raise ChannelFull(
+                "channel full" if self.capacity > 0
+                else "no receiver waiting on unbuffered channel")
 
     def _pump_locked(self) -> None:
         """Move parked senders into freed buffer slots (FIFO)."""

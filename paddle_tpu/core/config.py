@@ -82,6 +82,16 @@ class Flags:
     elastic_regrow: bool = True
     # consecutive watchdog stalls that escalate to a device-liveness probe
     elastic_escalate_stalls: int = 2
+    # serving multi-tenancy defaults (paddle_tpu.serving.admission): a
+    # TenantConfig field left None resolves from these
+    # per-tenant queued-request quota
+    tenant_queue_capacity: int = 64
+    # per-tenant queued-payload byte quota (0 = unlimited)
+    tenant_byte_quota: int = 0
+    # priority class for requests that don't specify one
+    tenant_default_class: str = "interactive"
+    # guaranteed batch-class drain share under interactive overload
+    tenant_batch_min_share: float = 0.1
 
     @staticmethod
     def _coerce(value: str, typ):

@@ -256,6 +256,10 @@ class _Handler(BaseHTTPRequestHandler):
             body, status = self._slo()
             self.send_response(status)
             self.send_header("Content-Type", JSON_CONTENT_TYPE)
+        elif path == "/tenants":
+            body, status = self._tenants()
+            self.send_response(status)
+            self.send_header("Content-Type", JSON_CONTENT_TYPE)
         else:
             body = b"not found\n"
             self.send_response(404)
@@ -320,6 +324,20 @@ class _Handler(BaseHTTPRequestHandler):
         return json.dumps(statuses).encode() + b"\n", 200
 
     @staticmethod
+    def _tenants() -> Tuple[bytes, int]:
+        """Per-tenant admission/scheduling state of every installed
+        serving admission controller: quotas, queue depths, admitted/shed
+        counts, brownout level."""
+        from paddle_tpu.serving import admission as _admission
+
+        try:
+            snaps = [c.snapshot()
+                     for c in _admission.installed_controllers()]
+        except Exception as e:  # never take the exporter down with serving
+            return (json.dumps({"error": repr(e)}).encode() + b"\n", 500)
+        return json.dumps(snaps).encode() + b"\n", 200
+
+    @staticmethod
     def _trace() -> Tuple[bytes, int]:
         """The current merged Chrome-trace document — save the response
         body and load it straight into chrome://tracing / Perfetto."""
@@ -340,8 +358,10 @@ class MetricsServer:
     plus debug endpoints: ``/runlog/tail?n=`` (last n runlog events as
     JSON), ``/trace`` (the current merged Chrome-trace document from
     ``paddle_tpu.tracing``), ``/alerts?n=&source=`` (recent alerts from
-    the ``paddle_tpu.watch`` hub), and ``/slo`` (installed SLO engines'
-    current compliance/burn-rate status)."""
+    the ``paddle_tpu.watch`` hub), ``/slo`` (installed SLO engines'
+    current compliance/burn-rate status), and ``/tenants`` (installed
+    serving admission controllers' per-tenant quotas, queue depths, and
+    shed/brownout state)."""
 
     def __init__(self, registry: Optional[obs_metrics.MetricRegistry] = None,
                  host: str = "127.0.0.1", port: int = 0):

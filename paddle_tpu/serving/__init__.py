@@ -1,12 +1,15 @@
 """paddle_tpu.serving — dynamically-batched TPU inference serving.
 
 The production path from "trained model" to "heavy concurrent traffic":
-requests queue on a bounded :class:`paddle_tpu.concurrency.Channel`, a
-dynamic micro-batcher groups them into zero-padded shape buckets (AOT
-compiled at startup via ``Executor.prepare``), and batches round-robin
-across one replica per local device. See ``serving.engine`` for the full
-design; the reference stack's analogue is the Fluid inference engine
-behind the gRPC ``listen_and_serv`` server.
+requests pass multi-tenant admission control (``serving.admission``:
+quotas, deadline-feasibility prediction, SLO-driven brownout shedding),
+queue per tenant under a weighted-fair scheduler (``serving.scheduler``:
+deficit round-robin, interactive/batch priority classes with a guaranteed
+batch share), then a dynamic micro-batcher groups them into zero-padded
+shape buckets (AOT compiled at startup via ``Executor.prepare``) and
+batches round-robin across one replica per local device. See
+``serving.engine`` for the full design; the reference stack's analogue is
+the Fluid inference engine behind the gRPC ``listen_and_serv`` server.
 
 Quickstart::
 
@@ -23,6 +26,12 @@ Quickstart::
     engine.close()                           # graceful drain
 """
 
+from paddle_tpu.serving.admission import (
+    AdmissionController,
+    AdmissionRejected,
+    TenantConfig,
+    TokenBucket,
+)
 from paddle_tpu.serving.batcher import Group, MicroBatcher
 from paddle_tpu.serving.buckets import ShapeBuckets
 from paddle_tpu.serving.engine import (
@@ -34,6 +43,11 @@ from paddle_tpu.serving.engine import (
     ServingEngine,
 )
 from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.serving.scheduler import (
+    BATCH,
+    INTERACTIVE,
+    WeightedFairScheduler,
+)
 
 __all__ = [
     "ServingEngine",
@@ -46,4 +60,11 @@ __all__ = [
     "Group",
     "ShapeBuckets",
     "ServingMetrics",
+    "AdmissionController",
+    "AdmissionRejected",
+    "TenantConfig",
+    "TokenBucket",
+    "WeightedFairScheduler",
+    "INTERACTIVE",
+    "BATCH",
 ]

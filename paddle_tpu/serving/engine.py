@@ -8,7 +8,8 @@ device running batch-1 requests is idle silicon).
 
 Request path::
 
-    submit(feed) ──▶ bounded Channel (backpressure) ──▶ MicroBatcher
+    submit(feed) ──▶ admission control (typed shedding, multi-tenant)
+        ──▶ per-tenant queues / weighted-fair scheduler ──▶ MicroBatcher
         ──▶ shape-bucket groups, padded to (signature, batch bucket)
         ──▶ round-robin replica Channel ──▶ replica worker thread
         ──▶ Executor.prepare-cached executable on that device
@@ -25,9 +26,12 @@ Key properties:
 - **Deadlines**: a request carries an absolute deadline; if it expires in
   the queue it gets a :class:`DeadlineExceeded` response without spending
   device time.
-- **Backpressure**: the request channel is bounded; ``submit`` blocks (or
-  times out) when the engine is saturated instead of growing an unbounded
-  queue.
+- **Backpressure / admission**: the request queue is bounded; without
+  tenants ``submit`` blocks (or times out) when the engine is saturated
+  instead of growing an unbounded queue. With tenants configured,
+  admission control sheds early and typed instead of blocking — per-tenant
+  quotas, deadline-feasibility prediction from observed latencies, and
+  SLO-driven brownout (see ``serving.admission`` / ``serving.scheduler``).
 - **Graceful drain**: ``close()`` stops intake, lets the batcher flush
   everything already accepted, waits for the replica workers, and only
   then returns — no accepted request is dropped.
@@ -52,10 +56,15 @@ from paddle_tpu.core.enforce import EnforceError, enforce
 from paddle_tpu.executor import Executor
 from paddle_tpu.framework import Model, Variables, build
 from paddle_tpu import observability
+from paddle_tpu.core import retry as retry_mod
+from paddle_tpu.observability import metrics as obs_metrics
 from paddle_tpu.observability import runlog
 from paddle_tpu.reader.feeder import FeedSpec
 from paddle_tpu.resilience import faults
 from paddle_tpu.resilience.circuit import CircuitBreaker
+from paddle_tpu.serving import admission as admission_mod
+from paddle_tpu.serving import scheduler as sched_mod
+from paddle_tpu.serving.admission import AdmissionRejected, TenantConfig
 from paddle_tpu.serving.batcher import Group, MicroBatcher
 from paddle_tpu.serving.buckets import ShapeBuckets
 from paddle_tpu.serving.metrics import ServingMetrics
@@ -67,6 +76,8 @@ __all__ = [
     "DeadlineExceeded",
     "EngineClosedError",
     "ReplicaDied",
+    "AdmissionRejected",
+    "TenantConfig",
 ]
 
 
@@ -128,6 +139,23 @@ class ServingConfig:
     # breaker (same ejection path as consecutive failures) — requires a
     # watch config with the per-replica exec rule (on by default)
     anomaly_eject: bool = False
+    # -- multi-tenant admission (serving.admission / serving.scheduler) ----
+    # tenant set (admission.TenantConfig) for weighted-fair scheduling;
+    # None = one implicit "default" tenant with legacy FIFO backpressure
+    tenants: Optional[Sequence[TenantConfig]] = None
+    # early typed shedding at submit() (AdmissionRejected); None = enabled
+    # exactly when tenants are configured
+    admission: Optional[bool] = None
+    # guaranteed batch-class drain share under interactive pressure
+    # (scheduler anti-starvation floor); None = the
+    # PADDLE_TPU_TENANT_BATCH_MIN_SHARE flag
+    batch_min_share: Optional[float] = None
+    # minimum dwell in brownout before the SLO probe may exit it
+    brownout_min_s: float = 1.0
+    # per-engine retry budget for submit(retries=...): a token bucket so
+    # client retry storms cannot amplify overload
+    retry_budget_per_s: float = 8.0
+    retry_budget_burst: float = 16.0
 
 
 class PendingResult:
@@ -163,14 +191,19 @@ class PendingResult:
 
 class _Request:
     __slots__ = ("arrays", "n", "sig", "deadline", "t_submit", "pending",
+                 "tenant", "cls", "bytes",
                  "trace", "t_enqueue_pc", "t_grouped_pc", "t_dispatch_pc")
 
-    def __init__(self, arrays, n, sig, deadline, t_submit):
+    def __init__(self, arrays, n, sig, deadline, t_submit,
+                 tenant="default", cls="interactive"):
         self.arrays = arrays
         self.n = n
         self.sig = sig
         self.deadline = deadline
         self.t_submit = t_submit
+        self.tenant = tenant
+        self.cls = cls
+        self.bytes = sum(int(a.nbytes) for a in arrays)
         self.pending = PendingResult()
         # tracing: root context + perf_counter marks (t_submit stays on
         # time.monotonic for deadline math; spans share the profiler
@@ -304,7 +337,46 @@ class ServingEngine:
         if self.config.warmup:
             self._warmup()
 
-        self._queue: Channel = Channel(capacity=self.config.queue_capacity)
+        # per-tenant queues + weighted-fair drain replace the old global
+        # FIFO Channel; with no tenants configured one implicit "default"
+        # tenant plus legacy_capacity reproduces the bounded-FIFO contract
+        # (submit blocks on a full queue) exactly
+        tenant_cfgs = [t.resolved() for t in (self.config.tenants or ())]
+        if not tenant_cfgs:
+            tenant_cfgs = [TenantConfig(
+                "default", queue_capacity=self.config.queue_capacity,
+            ).resolved()]
+        self._tenants = {t.name: t for t in tenant_cfgs}
+        self._default_tenant = (
+            "default" if "default" in self._tenants else tenant_cfgs[0].name)
+        admission_on = (self.config.admission
+                        if self.config.admission is not None
+                        else self.config.tenants is not None)
+        self._queue = sched_mod.WeightedFairScheduler(
+            self._tenants,
+            quantum_rows=self.config.max_batch_size,
+            batch_min_share=(self.config.batch_min_share
+                             if self.config.batch_min_share is not None
+                             else cfg.flags().tenant_batch_min_share),
+            legacy_capacity=(None if admission_on
+                             else self.config.queue_capacity),
+            on_expired=self._expire,
+        )
+        self._retry_budget = admission_mod.TokenBucket(
+            self.config.retry_budget_per_s, self.config.retry_budget_burst)
+        self._admission: Optional[admission_mod.AdmissionController] = None
+        if admission_on:
+            self._admission = admission_mod.AdmissionController(
+                self._queue, self.metrics, self._tenants,
+                exec_snapshot=self._merged_exec_snapshot,
+                healthy_replicas=self._count_healthy,
+                slo_probe=self._slo_breached,
+                brownout_min_s=self.config.brownout_min_s,
+            )
+            admission_mod.install(self._admission)
+            if self._watcher is not None:
+                # SLO burn-rate breaches drive brownout shedding
+                self._watcher.hub.register_action(self._on_brownout_alert)
         self._batcher = MicroBatcher(
             self._queue,
             max_batch_rows=self.config.max_batch_size,
@@ -407,11 +479,55 @@ class ServingEngine:
         feed,
         deadline_s: Optional[float] = None,
         timeout: Optional[float] = None,
+        tenant: Optional[str] = None,
+        cls: Optional[str] = None,
+        retries: int = 0,
+        backoff: float = 0.01,
     ) -> PendingResult:
         """Enqueue one request (arrays carry a leading batch dim). Returns a
-        :class:`PendingResult`. Blocks while the bounded queue is full;
-        ``timeout`` bounds that wait (TimeoutError = backpressure rejection).
+        :class:`PendingResult`.
+
+        Without admission control the bounded queue applies backpressure:
+        submit blocks while full, ``timeout`` bounds that wait
+        (TimeoutError = backpressure rejection). With tenants configured,
+        submit never blocks — it raises :class:`AdmissionRejected` with a
+        typed reason instead. An already-expired ``deadline_s`` (<= 0) is
+        rejected here as :class:`DeadlineExceeded`, before it can occupy a
+        queue slot.
+
+        ``tenant``/``cls`` attribute the request for scheduling (defaults:
+        the "default" tenant — or the first configured one — and that
+        tenant's default class). ``retries > 0`` retries rejections
+        (AdmissionRejected / backpressure TimeoutError, never
+        DeadlineExceeded) with jittered exponential backoff starting at
+        ``backoff`` seconds, capped by the per-engine retry-budget token
+        bucket so storms cannot amplify overload.
         """
+        enforce(retries >= 0, f"retries must be >= 0, got {retries}")
+        attempt = 0
+        while True:
+            try:
+                return self._submit_once(feed, deadline_s, timeout,
+                                         tenant, cls)
+            except (AdmissionRejected, TimeoutError) as e:
+                if isinstance(e, DeadlineExceeded) or attempt >= retries:
+                    raise
+                if not self._retry_budget.try_take():
+                    self.metrics.record_retry_budget_exhausted()
+                    raise
+                self.metrics.record_retry()
+                time.sleep(retry_mod.next_backoff(
+                    attempt, base_delay=backoff, max_delay=1.0))
+                attempt += 1
+
+    def _submit_once(
+        self,
+        feed,
+        deadline_s: Optional[float],
+        timeout: Optional[float],
+        tenant: Optional[str],
+        cls: Optional[str],
+    ) -> PendingResult:
         if self._closed:
             raise EngineClosedError("engine is closed")
         arrays = self._normalize_feed(feed)
@@ -426,32 +542,62 @@ class ServingEngine:
         now = time.monotonic()
         if deadline_s is None:
             deadline_s = self.config.default_deadline_s
+        if deadline_s is not None and deadline_s <= 0:
+            # already dead on arrival: reject without burning a queue slot
+            self.metrics.record_timeout()
+            raise DeadlineExceeded(
+                f"deadline {deadline_s}s already expired at submit")
         deadline = None if deadline_s is None else now + deadline_s
-        req = _Request(arrays, n, sig, deadline, now)
+        tname = tenant if tenant is not None else self._default_tenant
+        tcfg = self._tenants.get(tname)
+        rcls = cls if cls is not None else (
+            tcfg.default_class if tcfg is not None
+            else cfg.flags().tenant_default_class)
+        enforce(rcls in sched_mod.CLASSES,
+                f"unknown priority class {rcls!r} "
+                f"(expected one of {sched_mod.CLASSES})")
+        if self._admission is None:
+            # admission rejects unknown tenants with a typed reason; the
+            # legacy blocking path has no shed channel, so refuse up front
+            enforce(tcfg is not None,
+                    f"unknown tenant {tname!r} "
+                    f"(configured: {sorted(self._tenants)})")
+        req = _Request(arrays, n, sig, deadline, now, tenant=tname, cls=rcls)
         if tracing.tracing_enabled():
             req.trace = tracing.SpanContext.new_trace()
             req.pending.trace = req.trace
             req.t_enqueue_pc = time.perf_counter()
         try:
-            self._queue.send(req, timeout=timeout)
+            if self._admission is not None:
+                # never blocks: quota/deadline/brownout shedding raises a
+                # typed AdmissionRejected instead of parking the caller
+                self._admission.admit(req)
+            else:
+                self._queue.send(req, timeout=timeout)
         except ChannelClosedError:
             raise EngineClosedError("engine is closed") from None
+        except AdmissionRejected:
+            if req.trace is not None:
+                self._finish_trace(req, time.perf_counter(), status="shed")
+            raise
         if req.trace is not None:
             # the enqueue span covers any backpressure wait on the bounded
             # channel — visible queue-pressure in the request's own trace
             tracing.record_span(
                 "serving.enqueue", req.t_enqueue_pc, time.perf_counter(),
-                parent=req.trace, rows=n,
+                parent=req.trace, rows=n, tenant=tname, cls=rcls,
             )
         # counted only once accepted: a backpressure rejection (TimeoutError
         # above) never shows up as a request that went missing
         self.metrics.record_submit(n, self._queue.qsize())
         return req.pending
 
-    def infer(self, feed, deadline_s: Optional[float] = None):
+    def infer(self, feed, deadline_s: Optional[float] = None, **kwargs):
         """Synchronous request: submit + wait. Raises
-        :class:`DeadlineExceeded` if the deadline expires in the queue."""
-        return self.submit(feed, deadline_s=deadline_s).result()
+        :class:`DeadlineExceeded` if the deadline expires in the queue.
+        Extra kwargs (``tenant``, ``cls``, ``retries``...) pass through to
+        :meth:`submit`."""
+        return self.submit(feed, deadline_s=deadline_s, **kwargs).result()
 
     # -- batching / dispatch (batcher thread) ------------------------------
 
@@ -465,7 +611,8 @@ class ServingEngine:
             return
         tracing.record_span(
             "serving.request", req.t_enqueue_pc, t1_pc, context=req.trace,
-            rows=req.n, engine=self.metrics.engine_label, **attrs,
+            rows=req.n, engine=self.metrics.engine_label,
+            tenant=req.tenant, cls=req.cls, **attrs,
         )
 
     def _expire(self, req: _Request) -> None:
@@ -525,6 +672,7 @@ class ServingEngine:
                 )
         self.metrics.record_batch(rows, bucket_b, group.sig)
         self.metrics.set_queue_depth(self._queue.qsize())
+        self.metrics.set_tenant_depths(self._queue.depths())
         self._send_to_replica(live, slots, bucket_b, attempt=0)
 
     def _pick_replica(self, exclude: Optional[_Replica] = None) -> Optional[_Replica]:
@@ -654,6 +802,8 @@ class ServingEngine:
                                    replica=rep.index)
                 req.pending._complete(sliced)
                 self.metrics.record_response(now - req.t_submit)
+                self.metrics.record_tenant_response(
+                    req.tenant, req.cls, now - req.t_submit)
                 offset += req.n
 
     def _batch_failed(
@@ -759,6 +909,60 @@ class ServingEngine:
             1 for r in self._replicas if not r.dead and r.breaker.state == "closed"
         )
 
+    # -- admission / brownout ----------------------------------------------
+
+    def _merged_exec_snapshot(self) -> Optional[dict]:
+        """All replicas' execute-latency histograms merged into one
+        distribution — the admission controller's deadline-feasibility
+        input (registry.quantile reads a single child; exec latencies are
+        labeled per replica)."""
+        reg = obs_metrics.default_registry()
+        return admission_mod.merge_histogram_snapshots([
+            reg.histogram_snapshot(
+                "serving.replica_exec_seconds",
+                {"engine": self.metrics.engine_label,
+                 "replica": str(rep.index)})
+            for rep in self._replicas
+        ])
+
+    def _slo_breached(self) -> bool:
+        """Brownout exit probe: True while any SLO on this engine's watcher
+        still reports a breach."""
+        if self._watcher is None or self._watcher.slo_engine is None:
+            return False
+        return any(s.get("breached") for s in
+                   self._watcher.slo_engine.status())
+
+    def _on_brownout_alert(self, alert) -> None:
+        """Alert-hub action (admission enabled): an SLO burn-rate breach on
+        this engine enters brownout — warning sheds batch admission,
+        critical sheds everything. Exit happens via the probe path in the
+        admission controller, not here (alerts are edge-triggered)."""
+        if not alert.source.startswith("slo."):
+            return
+        eng = alert.labels.get("engine")
+        if eng is not None and eng != self.metrics.engine_label:
+            return
+        if self._admission is not None:
+            self._admission.enter_brownout(alert.severity,
+                                           reason=alert.source)
+
+    @property
+    def admission(self) -> Optional[admission_mod.AdmissionController]:
+        return self._admission
+
+    def set_brownout(self, severity: str = "warning",
+                     reason: str = "manual") -> None:
+        """Manually enter brownout (operator override / tests / chaos
+        drills) — same shedding path an SLO alert takes."""
+        enforce(self._admission is not None,
+                "set_brownout requires admission control (configure tenants)")
+        self._admission.enter_brownout(severity, reason)
+
+    def clear_brownout(self) -> None:
+        if self._admission is not None:
+            self._admission.exit_brownout()
+
     def replica_health(self) -> List[dict]:
         """Per-replica health readout: breaker state + lifetime counters."""
         return [
@@ -806,6 +1010,10 @@ class ServingEngine:
                 len(unjoined), timeout, ", ".join(unjoined),
             )
         self.metrics.set_queue_depth(0)
+        if self._admission is not None:
+            admission_mod.uninstall(self._admission)
+            if self._watcher is not None:
+                self._watcher.hub.unregister_action(self._on_brownout_alert)
         if self._watcher is not None:
             self._watcher.hub.unregister_action(self._on_alert)
             if self._watcher.slo_engine is not None:

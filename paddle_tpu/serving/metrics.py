@@ -62,6 +62,10 @@ class ServingMetrics:
             "serving.replica_exec_seconds",
             help="Per-replica device execute duration per batch.",
             buckets=_LATENCY_BUCKETS)
+        obs_metrics.default_registry().histogram(
+            "serving.tenant.request_latency_seconds",
+            help="End-to-end request latency per tenant and priority class.",
+            buckets=_LATENCY_BUCKETS)
         self.requests_total = 0
         self.responses_total = 0
         self.timeouts_total = 0
@@ -77,6 +81,11 @@ class ServingMetrics:
         self.replica_recoveries_total = 0  # half-open probes that re-admitted
         self.replica_deaths_total = 0      # worker threads that exited
         self.redispatches_total = 0        # failed batches retried elsewhere
+        # multi-tenant admission accounting (serving.tenant.* families)
+        self._tenant_admitted: collections.Counter = collections.Counter()
+        self._tenant_shed: collections.Counter = collections.Counter()
+        self.retries_total = 0                  # submit() retry attempts
+        self.retry_budget_exhausted_total = 0   # retries refused by budget
         self._latencies = collections.deque(maxlen=latency_window)
 
     # -- recorders (called from engine/batcher/worker threads) -------------
@@ -157,6 +166,75 @@ class ServingMetrics:
     def set_healthy_replicas(self, n: int) -> None:
         prof.set_gauge("serving.healthy_replicas", n, labels=self._labels)
 
+    # -- multi-tenant admission (serving.tenant.* families) -----------------
+
+    def record_admit(self, tenant: str, cls: str) -> None:
+        with self._lock:
+            self._tenant_admitted[(tenant, cls)] += 1
+        prof.inc_counter("serving.tenant.admitted_total",
+                         labels={**self._labels, "tenant": tenant,
+                                 "cls": cls})
+
+    def record_shed(self, tenant: str, cls: str, reason: str) -> None:
+        with self._lock:
+            self._tenant_shed[(tenant, cls, reason)] += 1
+        prof.inc_counter("serving.tenant.shed_total",
+                         labels={**self._labels, "tenant": tenant,
+                                 "cls": cls, "reason": reason})
+
+    def record_tenant_response(self, tenant: str, cls: str,
+                               latency_s: float) -> None:
+        prof.observe("serving.tenant.request_latency_seconds", latency_s,
+                     labels={**self._labels, "tenant": tenant, "cls": cls})
+
+    def set_tenant_depths(self, depths: Dict[str, dict]) -> None:
+        """Refresh the per-tenant queue gauges from a scheduler
+        :meth:`~paddle_tpu.serving.scheduler.WeightedFairScheduler.depths`
+        snapshot."""
+        for tenant, d in depths.items():
+            for cls, depth in d.items():
+                if cls == "bytes":
+                    prof.set_gauge(
+                        "serving.tenant.queued_bytes", depth,
+                        labels={**self._labels, "tenant": tenant})
+                else:
+                    prof.set_gauge(
+                        "serving.tenant.queue_depth", depth,
+                        labels={**self._labels, "tenant": tenant,
+                                "cls": cls})
+
+    def set_brownout_level(self, level: int) -> None:
+        prof.set_gauge("serving.brownout_level", level, labels=self._labels)
+
+    def record_retry(self) -> None:
+        with self._lock:
+            self.retries_total += 1
+        prof.inc_counter("serving.retries_total", labels=self._labels)
+
+    def record_retry_budget_exhausted(self) -> None:
+        with self._lock:
+            self.retry_budget_exhausted_total += 1
+        prof.inc_counter("serving.retry_budget_exhausted",
+                         labels=self._labels)
+
+    def tenant_admitted(self, tenant: str) -> int:
+        with self._lock:
+            return sum(v for (t, _), v in self._tenant_admitted.items()
+                       if t == tenant)
+
+    def tenant_shed(self, tenant: str) -> Dict[str, int]:
+        """Shed counts for one tenant, keyed by rejection reason."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            for (t, _, reason), v in self._tenant_shed.items():
+                if t == tenant:
+                    out[reason] = out.get(reason, 0) + v
+        return out
+
+    def shed_total(self) -> int:
+        with self._lock:
+            return sum(self._tenant_shed.values())
+
     # -- readout -----------------------------------------------------------
 
     def mean_batch_occupancy(self) -> float:
@@ -204,6 +282,11 @@ class ServingMetrics:
                 "replica_recoveries_total": self.replica_recoveries_total,
                 "replica_deaths_total": self.replica_deaths_total,
                 "redispatches_total": self.redispatches_total,
+                "admitted_total": sum(self._tenant_admitted.values()),
+                "shed_total": sum(self._tenant_shed.values()),
+                "retries_total": self.retries_total,
+                "retry_budget_exhausted_total":
+                    self.retry_budget_exhausted_total,
                 "mean_batch_occupancy": (
                     self.rows_total / self.batches_total
                     if self.batches_total

@@ -38,6 +38,7 @@ from paddle_tpu.watch.slo import (  # noqa: F401
     SloEngine,
     install,
     installed_engines,
+    serving_slos,
     uninstall,
 )
 from paddle_tpu.watch.watcher import (  # noqa: F401
@@ -66,6 +67,7 @@ __all__ = [
     "SloEngine",
     "install",
     "installed_engines",
+    "serving_slos",
     "uninstall",
     "MetricWatcher",
     "WatchConfig",

@@ -42,7 +42,8 @@ from paddle_tpu.core.enforce import enforce, enforce_in
 from paddle_tpu.observability import metrics as obs_metrics
 from paddle_tpu.watch import alerts as alerts_mod
 
-__all__ = ["SLO", "SloEngine", "install", "uninstall", "installed_engines"]
+__all__ = ["SLO", "SloEngine", "install", "uninstall", "installed_engines",
+           "serving_slos"]
 
 LATENCY = "latency"
 ERROR_RATE = "error_rate"
@@ -348,6 +349,34 @@ class SloEngine:
             slos = list(self._slos)
         return [self._evaluate(slo, self._rings[slo.name], now)
                 for slo in slos]
+
+
+def serving_slos(
+    engine_label: str,
+    p99_objective_s: float = 0.25,
+    error_rate_objective: float = 0.05,
+    window_s: float = 60.0,
+    severity: str = alerts_mod.WARNING,
+) -> List[SLO]:
+    """The standard serving objectives for one engine, labeled with its
+    ``engine`` tag so the engine's brownout hook (which matches alerts by
+    that label) reacts only to its own breaches: p99 request latency and
+    error rate. Feed the result to ``WatchConfig(slos=...)``::
+
+        ServingConfig(watch=WatchConfig(
+            enabled=True, slos=serving_slos("serving0", 0.25)))
+    """
+    labels = {"engine": engine_label}
+    return [
+        SLO(f"serving_{engine_label}_p99_latency", LATENCY,
+            "serving.request_latency_seconds", p99_objective_s,
+            window_s=window_s, quantile=0.99, labels=labels,
+            severity=severity),
+        SLO(f"serving_{engine_label}_error_rate", ERROR_RATE,
+            "serving.errors_total", error_rate_objective,
+            total_metric="serving.responses_total",
+            window_s=window_s, labels=labels, severity=severity),
+    ]
 
 
 # -- process-wide install (what the exporter's /slo endpoint serves) --------

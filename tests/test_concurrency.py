@@ -471,3 +471,91 @@ def test_qsize_counts_buffer_and_parked_senders():
     assert ch.recv() == (1, True)
     t.join(timeout=5)
     assert ch.qsize() == 2
+
+
+# ---- try_send: non-blocking typed shedding -------------------------------
+
+
+def test_try_send_buffered_fills_then_raises_channel_full():
+    ch = cc.Channel(capacity=2)
+    ch.try_send(1)
+    ch.try_send(2)
+    with pytest.raises(cc.ChannelFull):
+        ch.try_send(3)
+    assert ch.recv() == (1, True)
+    ch.try_send(3)  # space freed: succeeds again
+    assert [ch.recv()[0], ch.recv()[0]] == [2, 3]
+
+
+def test_try_send_closed_raises_channel_closed():
+    ch = cc.Channel(capacity=2)
+    ch.close()
+    with pytest.raises(cc.ChannelClosedError):
+        ch.try_send(1)
+
+
+def test_try_send_unbuffered_needs_parked_receiver():
+    ch = cc.Channel(capacity=0)
+    with pytest.raises(cc.ChannelFull):
+        ch.try_send(1)  # nobody is receiving
+
+    got = []
+    t = cc.go(lambda: got.append(ch.recv()))
+    deadline = time.monotonic() + 10
+    while ch._recv_waiting == 0 and time.monotonic() < deadline:
+        time.sleep(0.001)  # wait for the receiver to park
+    ch.try_send(42)  # receiver waiting: commits without blocking
+    t.join(timeout=10)
+    assert got == [(42, True)]
+
+
+def test_try_send_multithreaded_contention_sheds_exactly_overflow():
+    """8 threads race try_send into capacity 16: exactly 16 values land,
+    every other attempt raises ChannelFull, nothing blocks or is lost —
+    the shedding-path contract under real contention."""
+    ch = cc.Channel(capacity=16)
+    n_threads, per = 8, 50
+    accepted = []
+    rejected = []
+    lock = threading.Lock()
+    start = threading.Barrier(n_threads)
+
+    def worker(tid):
+        start.wait()
+        for i in range(per):
+            v = tid * per + i
+            try:
+                ch.try_send(v)
+                with lock:
+                    accepted.append(v)
+            except cc.ChannelFull:
+                with lock:
+                    rejected.append(v)
+
+    threads = [cc.go(worker, t) for t in range(n_threads)]
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    assert len(accepted) == 16  # exactly the capacity was admitted
+    assert len(rejected) == n_threads * per - 16  # all others shed, typed
+    drained = []
+    ch.close()
+    for v in ch:
+        drained.append(v)
+    assert sorted(drained) == sorted(accepted)  # nothing lost or duplicated
+
+
+def test_try_send_interleaves_with_blocking_senders():
+    """try_send must not jump ahead of parked blocking senders on a full
+    channel: it sheds instead, and the parked sender's value is preserved."""
+    ch = cc.Channel(capacity=1)
+    ch.send("buffered")
+    t = cc.go(lambda: ch.send("parked"))
+    deadline = time.monotonic() + 10
+    while ch.qsize() < 2 and time.monotonic() < deadline:
+        time.sleep(0.001)  # sender parked in the send queue
+    with pytest.raises(cc.ChannelFull):
+        ch.try_send("queue-jumper")
+    assert ch.recv() == ("buffered", True)
+    assert ch.recv() == ("parked", True)
+    t.join(timeout=10)

@@ -1,0 +1,516 @@
+"""Overload-robust serving: multi-tenant admission control + weighted fair
+scheduling.
+
+Covers the robustness contract end to end: deficit-round-robin fairness by
+tenant weight, interactive-over-batch priority with a guaranteed batch
+drain share (starvation-freedom under 10x interactive overload), typed
+``AdmissionRejected`` shedding (quota / deadline-unmeetable / brownout),
+prompt eviction of deadline-expired requests from bounded queues, the
+per-engine retry-budget token bucket, per-tenant telemetry (metrics,
+runlog shed/brownout events, the exporter ``/tenants`` endpoint), and the
+SLO-alert → brownout wiring. CPU mesh, tier-1 fast.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.observability import runlog as runlog_mod
+from paddle_tpu.observability.exporter import MetricsServer
+from paddle_tpu.reader.feeder import FeedSpec
+from paddle_tpu.serving import (
+    BATCH,
+    INTERACTIVE,
+    AdmissionController,
+    AdmissionRejected,
+    DeadlineExceeded,
+    ServingConfig,
+    ServingEngine,
+    TenantConfig,
+    TokenBucket,
+    WeightedFairScheduler,
+)
+from paddle_tpu.serving.admission import merge_histogram_snapshots
+from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.watch import serving_slos
+from paddle_tpu.watch.alerts import Alert
+
+D_IN = 5
+
+
+class FakeReq:
+    """Scheduler-level stand-in for engine._Request."""
+
+    def __init__(self, tenant, cls=INTERACTIVE, n=1, deadline=None,
+                 nbytes=0):
+        self.tenant = tenant
+        self.cls = cls
+        self.n = n
+        self.deadline = deadline
+        self.bytes = nbytes
+
+
+def _tenants(**kw):
+    return {name: TenantConfig(name, **cfg).resolved()
+            for name, cfg in kw.items()}
+
+
+# ---- scheduler: deficit round-robin + priority classes -------------------
+
+
+def test_drr_serves_tenants_proportional_to_weight():
+    """Two backlogged tenants at weight 3:1 drain in ~3:1 row proportion —
+    the weighted-fairness core."""
+    sched = WeightedFairScheduler(
+        _tenants(heavy=dict(weight=3.0, queue_capacity=100),
+                 light=dict(weight=1.0, queue_capacity=100)),
+        quantum_rows=4)
+    for _ in range(60):
+        assert sched.try_put(FakeReq("heavy")) is None
+        assert sched.try_put(FakeReq("light")) is None
+    served = {"heavy": 0, "light": 0}
+    for _ in range(40):
+        req, ok = sched.recv(timeout=1)
+        assert ok
+        served[req.tenant] += req.n
+    ratio = served["heavy"] / max(served["light"], 1)
+    assert 2.0 <= ratio <= 4.5, served  # ~3:1 by weight
+
+
+def test_interactive_preempts_batch_but_batch_keeps_min_share():
+    """With both classes backlogged, interactive goes first — but batch
+    gets exactly its guaranteed share (1 pick per 1/min_share)."""
+    sched = WeightedFairScheduler(
+        _tenants(t=dict(queue_capacity=200)),
+        quantum_rows=4, batch_min_share=0.25)
+    for _ in range(50):
+        assert sched.try_put(FakeReq("t", INTERACTIVE)) is None
+        assert sched.try_put(FakeReq("t", BATCH)) is None
+    picks = [sched.recv(timeout=1)[0].cls for _ in range(20)]
+    assert picks[0] == INTERACTIVE  # priority: interactive first
+    batch_served = picks.count(BATCH)
+    # min_share 0.25 -> one batch pick per 3 interactive: 5 of 20
+    assert batch_served == 5, picks
+
+
+def test_batch_only_traffic_drains_without_interactive():
+    sched = WeightedFairScheduler(_tenants(t=dict(queue_capacity=10)))
+    assert sched.try_put(FakeReq("t", BATCH)) is None
+    req, ok = sched.recv(timeout=1)
+    assert ok and req.cls == BATCH
+
+
+def test_scheduler_quota_rejections_are_typed():
+    sched = WeightedFairScheduler(
+        _tenants(small=dict(queue_capacity=2, byte_quota=100)))
+    assert sched.try_put(FakeReq("small", nbytes=40)) is None
+    assert sched.try_put(FakeReq("small", nbytes=40)) is None
+    assert sched.try_put(FakeReq("small")) == "queue_quota"
+    req, ok = sched.recv(timeout=1)
+    assert ok
+    # queue slot free but byte budget (80/100) blocks a 40-byte request
+    assert sched.try_put(FakeReq("small", nbytes=61)) == "byte_quota"
+    assert sched.try_put(FakeReq("small", nbytes=10)) is None
+
+
+def test_scheduler_evicts_expired_before_rejecting_on_quota():
+    """An expired request buried in a full queue must not cause a live
+    rejection: try_put evicts it, fires on_expired, and admits."""
+    now = [100.0]
+    expired = []
+    sched = WeightedFairScheduler(
+        _tenants(t=dict(queue_capacity=2)),
+        on_expired=expired.append, clock=lambda: now[0])
+    dead = FakeReq("t", deadline=100.5)
+    assert sched.try_put(dead) is None
+    assert sched.try_put(FakeReq("t", deadline=200.0)) is None
+    now[0] = 101.0  # the first request's deadline lapses in-queue
+    assert sched.try_put(FakeReq("t", deadline=200.0)) is None  # evict+admit
+    assert expired == [dead]
+    assert sched.qsize() == 2
+
+
+def test_scheduler_legacy_send_blocks_frees_on_expiry():
+    """Legacy (no-admission) mode: send blocks at capacity like the old
+    bounded Channel, but expired requests free their slots promptly
+    instead of occupying them until dispatch."""
+    now = [0.0]
+    expired = []
+    sched = WeightedFairScheduler(
+        _tenants(default=dict(queue_capacity=64)),
+        legacy_capacity=2, on_expired=expired.append, clock=lambda: now[0])
+    sched.send(FakeReq("default", deadline=1.0))
+    sched.send(FakeReq("default", deadline=1.0))
+    with pytest.raises(TimeoutError):
+        sched.send(FakeReq("default"), timeout=0.05)  # full: backpressure
+    now[0] = 2.0  # both queued requests are now expired
+    sched.send(FakeReq("default"), timeout=0.05)  # evicts, admits promptly
+    assert len(expired) == 2
+    assert sched.qsize() == 1
+
+
+def test_scheduler_close_drains_then_not_ok():
+    sched = WeightedFairScheduler(_tenants(t=dict(queue_capacity=4)))
+    assert sched.try_put(FakeReq("t")) is None
+    sched.close()
+    from paddle_tpu.concurrency import ChannelClosedError
+    with pytest.raises(ChannelClosedError):
+        sched.try_put(FakeReq("t"))
+    req, ok = sched.recv()
+    assert ok and req is not None  # graceful drain after close
+    assert sched.recv() == (None, False)
+
+
+# ---- admission: token bucket, histogram merge, controller policy ---------
+
+
+def test_token_bucket_refills_at_rate():
+    now = [0.0]
+    tb = TokenBucket(rate_per_s=1.0, burst=2.0, clock=lambda: now[0])
+    assert tb.try_take() and tb.try_take()
+    assert not tb.try_take()  # burst spent
+    now[0] = 1.0
+    assert tb.try_take()  # one token refilled
+    assert not tb.try_take()
+    now[0] = 100.0
+    assert tb.available() == pytest.approx(2.0)  # capped at burst
+
+
+def test_merge_histogram_snapshots():
+    a = {"edges": [1.0, 2.0], "cumulative": [1, 3], "sum": 4.0, "count": 3}
+    b = {"edges": [1.0, 2.0], "cumulative": [2, 2], "sum": 2.0, "count": 2}
+    m = merge_histogram_snapshots([a, None, b,
+                                   {"edges": [1.0], "cumulative": [0],
+                                    "sum": 0.0, "count": 0}])
+    assert m == {"edges": [1.0, 2.0], "cumulative": [3, 5],
+                 "sum": 6.0, "count": 5}
+    assert merge_histogram_snapshots([None, None]) is None
+    with pytest.raises(pt.EnforceError):
+        merge_histogram_snapshots([
+            a, {"edges": [9.0], "cumulative": [1], "sum": 1.0, "count": 1}])
+
+
+def _controller(sched, now, exec_snapshot=None, slo_probe=None,
+                brownout_min_s=0.5):
+    m = ServingMetrics(engine_label=f"admtest{id(sched) % 10_000}")
+    tenants = {name: sched._tenants[name].config
+               for name in sched.tenant_names()}
+    return AdmissionController(
+        sched, m, tenants, exec_snapshot=exec_snapshot,
+        healthy_replicas=lambda: 1, slo_probe=slo_probe,
+        brownout_min_s=brownout_min_s, clock=lambda: now[0]), m
+
+
+def test_admission_deadline_unmeetable_predicted_from_histograms():
+    """With observed exec latency and queued depth, a request whose
+    deadline cannot be met is shed before burning a queue slot; a
+    feasible one passes. Cold start (no history) always admits."""
+    now = [100.0]
+    sched = WeightedFairScheduler(
+        _tenants(t=dict(queue_capacity=50)), clock=lambda: now[0])
+    # p90 exec ~= 0.1s, mean 0.1s, one replica -> ~10 batches/s drain
+    snap = {"edges": [0.1, 1.0], "cumulative": [100, 100],
+            "sum": 10.0, "count": 100}
+    ctrl, metrics = _controller(sched, now, exec_snapshot=lambda: snap)
+    for _ in range(10):
+        ctrl.admit(FakeReq("t", deadline=now[0] + 60))
+    # 10 queued at ~10/s -> ~1s predicted wait + 0.1 exec; 0.2s is doomed
+    with pytest.raises(AdmissionRejected) as ei:
+        ctrl.admit(FakeReq("t", deadline=now[0] + 0.2))
+    assert ei.value.reason == "deadline_unmeetable"
+    assert metrics.tenant_shed("t") == {"deadline_unmeetable": 1}
+    ctrl.admit(FakeReq("t", deadline=now[0] + 60))  # feasible: admitted
+    # cold start: no exec history -> admit even tight deadlines
+    ctrl2, _ = _controller(sched, now, exec_snapshot=lambda: None)
+    ctrl2.admit(FakeReq("t", deadline=now[0] + 0.01))
+
+
+def test_admission_brownout_sheds_batch_then_all_and_probes_out():
+    """warning -> level 1 (batch shed, interactive admitted); critical ->
+    level 2 (all shed); once the SLO probe clears and the dwell passes,
+    admission reopens."""
+    now = [0.0]
+    breached = [True]
+    sched = WeightedFairScheduler(
+        _tenants(t=dict(queue_capacity=50)), clock=lambda: now[0])
+    ctrl, metrics = _controller(sched, now, slo_probe=lambda: breached[0],
+                                brownout_min_s=1.0)
+    ctrl.enter_brownout("warning", "slo.p99")
+    with pytest.raises(AdmissionRejected) as ei:
+        ctrl.admit(FakeReq("t", BATCH))
+    assert ei.value.reason == "brownout"
+    ctrl.admit(FakeReq("t", INTERACTIVE))  # level 1 spares interactive
+    ctrl.enter_brownout("critical", "slo.errors")  # escalates to level 2
+    with pytest.raises(AdmissionRejected):
+        ctrl.admit(FakeReq("t", INTERACTIVE))
+    # still breached after the dwell: stays browned out
+    now[0] = 2.0
+    with pytest.raises(AdmissionRejected):
+        ctrl.admit(FakeReq("t", INTERACTIVE))
+    # probe clears + dwell passes: exits and admits again
+    breached[0] = False
+    now[0] = 4.0
+    ctrl.admit(FakeReq("t", BATCH))
+    assert ctrl.brownout_level == 0
+    assert metrics.tenant_shed("t")["brownout"] == 3
+
+
+def test_admission_unknown_tenant_rejected():
+    now = [0.0]
+    sched = WeightedFairScheduler(
+        _tenants(t=dict(queue_capacity=4)), clock=lambda: now[0])
+    ctrl, _ = _controller(sched, now)
+    with pytest.raises(AdmissionRejected) as ei:
+        ctrl.admit(FakeReq("ghost"))
+    assert ei.value.reason == "unknown_tenant"
+
+
+# ---- engine integration ---------------------------------------------------
+
+
+def _net(x):
+    h = pt.layers.fc(x, size=8, act="relu", name="fc1")
+    return pt.layers.fc(h, size=3, name="fc2")
+
+
+@pytest.fixture(scope="module")
+def model_and_vars():
+    rng = np.random.RandomState(0)
+    model = pt.build(_net)
+    x0 = rng.randn(2, D_IN).astype(np.float32)
+    return model, model.init(0, x0)
+
+
+def _engine(model_and_vars, **cfg_kwargs):
+    model, variables = model_and_vars
+    return ServingEngine(
+        model, variables, [FeedSpec("x", (D_IN,), "float32")],
+        config=ServingConfig(**cfg_kwargs))
+
+
+def test_engine_quota_shed_is_typed_and_logged(model_and_vars, tmp_path):
+    """Overflowing a tenant quota yields AdmissionRejected(queue_quota),
+    an admission_shed runlog event, and tenant counters — while accepted
+    requests still complete (zero silent drops)."""
+    prev = runlog_mod.set_runlog(runlog_mod.RunLog(str(tmp_path / "r.jsonl")))
+    engine = _engine(
+        model_and_vars, max_batch_size=2, max_queue_delay_s=0.001,
+        num_replicas=1, engine_label="quota_shed_t",
+        tenants=[TenantConfig("t", queue_capacity=2)])
+    try:
+        release = threading.Event()
+        orig_flush = engine._batcher._flush
+        engine._batcher._flush = lambda g: (release.wait(30), orig_flush(g))
+        x0 = np.zeros((1, D_IN), np.float32)
+        pendings, shed = [], 0
+        for _ in range(10):
+            try:
+                pendings.append(engine.submit({"x": x0}, tenant="t"))
+            except AdmissionRejected as e:
+                assert e.reason == "queue_quota"
+                assert e.tenant == "t" and e.cls == INTERACTIVE
+                shed += 1
+        assert shed >= 4
+        release.set()
+        for p in pendings:  # every accepted request resolves
+            assert np.asarray(p.result(timeout=30)).shape == (1, 3)
+        assert engine.metrics.tenant_shed("t")["queue_quota"] == shed
+        assert engine.metrics.tenant_admitted("t") == len(pendings)
+        events = runlog_mod.read_runlog(str(tmp_path / "r.jsonl"))
+        sheds = [e for e in events if e["kind"] == "admission_shed"]
+        assert len(sheds) == shed
+        assert sheds[0]["reason"] == "queue_quota"
+        assert sheds[0]["tenant"] == "t"
+    finally:
+        release.set()
+        engine.close()
+        runlog_mod.set_runlog(prev)
+
+
+def test_engine_starvation_freedom_under_interactive_overload(model_and_vars):
+    """A saturating interactive tenant (10x the batch tenant's rate) must
+    not stop batch progress: every batch request completes while the
+    flood is still running — the guaranteed-share contract end to end."""
+    engine = _engine(
+        model_and_vars, max_batch_size=4, max_queue_delay_s=0.001,
+        num_replicas=2, engine_label="starve_t",
+        tenants=[TenantConfig("chatty", weight=8.0, queue_capacity=16),
+                 TenantConfig("nightly", weight=1.0, queue_capacity=16,
+                              default_class=BATCH)],
+        batch_min_share=0.2)
+    try:
+        x0 = np.zeros((1, D_IN), np.float32)
+        stop = threading.Event()
+        flood_ok = [0]
+
+        def flood():
+            while not stop.is_set():
+                try:
+                    engine.infer({"x": x0}, tenant="chatty")
+                    flood_ok[0] += 1
+                except AdmissionRejected:
+                    pass  # overload shed is fine; starvation is not
+
+        floods = [threading.Thread(target=flood) for _ in range(10)]
+        for t in floods:
+            t.start()
+        n_batch, done = 12, []
+        for _ in range(n_batch):
+            while True:  # batch client retries its own quota sheds
+                try:
+                    done.append(engine.submit({"x": x0}, tenant="nightly"))
+                    break
+                except AdmissionRejected:
+                    time.sleep(0.002)
+        for p in done:  # batch completes while the flood still runs
+            assert np.asarray(p.result(timeout=30)).shape == (1, 3)
+        assert not stop.is_set()  # results arrived under live overload
+        stop.set()
+        for t in floods:
+            t.join(timeout=30)
+        assert flood_ok[0] > 0  # interactive kept being served too
+        assert engine.metrics.tenant_admitted("nightly") >= n_batch
+    finally:
+        stop.set()
+        engine.close()
+
+
+def test_engine_expired_deadline_rejected_at_submit(model_and_vars):
+    """An already-expired deadline is refused synchronously — it never
+    occupies a queue slot even when the queue is saturated."""
+    engine = _engine(
+        model_and_vars, max_batch_size=2, max_queue_delay_s=0.001,
+        num_replicas=1, queue_capacity=2, engine_label="expired_t")
+    try:
+        release = threading.Event()
+        orig_flush = engine._batcher._flush
+        engine._batcher._flush = lambda g: (release.wait(30), orig_flush(g))
+        x0 = np.zeros((1, D_IN), np.float32)
+        before = engine.metrics.timeouts_total
+        with pytest.raises(DeadlineExceeded):
+            engine.submit({"x": x0}, deadline_s=0.0)
+        with pytest.raises(DeadlineExceeded):
+            engine.submit({"x": x0}, deadline_s=-1.0)
+        assert engine.metrics.timeouts_total == before + 2
+        assert engine._queue.qsize() == 0  # no slot was consumed
+        # and an in-queue expiry frees its slot promptly for new senders
+        accepted = [engine.submit({"x": x0}, timeout=1)
+                    for _ in range(2)]  # first pair wedges in the batcher
+        expiring = [engine.submit({"x": x0}, deadline_s=0.05, timeout=1)
+                    for _ in range(2)]  # fills the bounded queue
+        time.sleep(0.1)  # both expire while still queued
+        late = engine.submit({"x": x0}, timeout=0.5)  # evicts, admits
+        for p in expiring:
+            with pytest.raises(DeadlineExceeded):
+                p.result(timeout=5)
+        release.set()
+        for p in accepted + [late]:
+            assert np.asarray(p.result(timeout=30)).shape == (1, 3)
+    finally:
+        release.set()
+        engine.close()
+
+
+def test_engine_retry_budget_token_bucket(model_and_vars):
+    """submit(retries=) retries typed rejections with backoff, but the
+    per-engine token bucket caps total retry volume (storm control)."""
+    engine = _engine(
+        model_and_vars, max_batch_size=2, max_queue_delay_s=0.001,
+        num_replicas=1, engine_label="retry_t",
+        tenants=[TenantConfig("t", queue_capacity=1)],
+        retry_budget_per_s=0.0, retry_budget_burst=3.0)
+    try:
+        release = threading.Event()
+        orig_flush = engine._batcher._flush
+        engine._batcher._flush = lambda g: (release.wait(30), orig_flush(g))
+        x0 = np.zeros((1, D_IN), np.float32)
+        accepted = []
+        while True:  # wedge the pipeline + fill the 1-slot quota
+            try:
+                accepted.append(engine.submit({"x": x0}, tenant="t"))
+            except AdmissionRejected:
+                break
+        for _ in range(6):
+            with pytest.raises(AdmissionRejected):
+                engine.submit({"x": x0}, tenant="t", retries=2,
+                              backoff=0.001)
+        snap = engine.metrics.snapshot()
+        assert snap["retries_total"] == 3  # burst of 3, refill rate 0
+        assert snap["retry_budget_exhausted_total"] >= 1
+        release.set()
+        for p in accepted:
+            p.result(timeout=30)
+    finally:
+        release.set()
+        engine.close()
+
+
+def test_engine_slo_alert_enters_brownout(model_and_vars, tmp_path):
+    """An slo.* alert on this engine's hub flips admission into brownout
+    (batch shed first), and clear_brownout reopens it — the AlertHub →
+    AdmissionController wiring."""
+    from paddle_tpu.watch import WatchConfig
+
+    prev = runlog_mod.set_runlog(runlog_mod.RunLog(str(tmp_path / "r.jsonl")))
+    engine = _engine(
+        model_and_vars, max_batch_size=2, max_queue_delay_s=0.001,
+        num_replicas=1, engine_label="brownout_t",
+        tenants=[TenantConfig("t", queue_capacity=8)],
+        watch=WatchConfig(enabled=True, use_default_rules=False,
+                          slos=serving_slos("brownout_t")))
+    try:
+        x0 = np.zeros((1, D_IN), np.float32)
+        engine._watcher.hub.emit(Alert(
+            source="slo.serving_brownout_t_p99_latency", key="p99",
+            message="breach", severity="warning",
+            labels={"engine": "brownout_t"}))
+        assert engine.admission.brownout_level == 1
+        with pytest.raises(AdmissionRejected) as ei:
+            engine.submit({"x": x0}, tenant="t", cls=BATCH)
+        assert ei.value.reason == "brownout"
+        # an alert for a DIFFERENT engine must not affect this one
+        engine.clear_brownout()
+        engine._watcher.hub.emit(Alert(
+            source="slo.other", key="x", message="m", severity="critical",
+            labels={"engine": "someone_else"}))
+        assert engine.admission.brownout_level == 0
+        events = runlog_mod.read_runlog(str(tmp_path / "r.jsonl"))
+        kinds = [e["kind"] for e in events]
+        assert "brownout_enter" in kinds and "brownout_exit" in kinds
+    finally:
+        engine.close()
+        runlog_mod.set_runlog(prev)
+
+
+def test_tenants_endpoint_serves_admission_state(model_and_vars):
+    """GET /tenants on the exporter returns every installed controller's
+    per-tenant quotas, depths, and shed counts."""
+    engine = _engine(
+        model_and_vars, max_batch_size=2, max_queue_delay_s=0.001,
+        num_replicas=1, engine_label="tenants_ep",
+        tenants=[TenantConfig("t", weight=2.0, queue_capacity=5)])
+    server = MetricsServer(port=0).start()
+    try:
+        x0 = np.zeros((1, D_IN), np.float32)
+        engine.infer({"x": x0}, tenant="t")
+        with urllib.request.urlopen(server.url + "/tenants", timeout=10) as r:
+            assert r.status == 200
+            snaps = json.loads(r.read().decode())
+        ours = [s for s in snaps if s["engine"] == "tenants_ep"]
+        assert len(ours) == 1
+        t = ours[0]["tenants"]["t"]
+        assert t["weight"] == 2.0 and t["queue_capacity"] == 5
+        assert t["admitted_total"] >= 1
+        assert ours[0]["brownout"]["level"] == 0
+    finally:
+        server.close()
+        engine.close()
+    # close() uninstalls: the endpoint no longer lists this engine
+    from paddle_tpu.serving import admission as admission_mod
+    assert all(c.metrics.engine_label != "tenants_ep"
+               for c in admission_mod.installed_controllers())

@@ -18,7 +18,12 @@ actually recovered:
   final save and auto-resumed in a fresh trainer;
 - serving ejected the sick replica (circuit breaker), redispatched its
   batches, kept answering every request, and re-admitted the replica after
-  the faults stopped.
+  the faults stopped;
+- under mixed-tenant overload at ~10x capacity (plus a transiently
+  failing replica), admission control held the interactive p99 SLO, shed
+  batch traffic via typed ``AdmissionRejected`` while batch kept its
+  guaranteed drain share, and no request was silently dropped — verified
+  from ``/metrics``, ``/tenants``, and the runlog.
 
 Exit code 0 = every fault fired AND every recovery held; 1 = any
 unrecovered fault. CI-registered next to ``tools/lint_program.py
@@ -281,6 +286,183 @@ def _serving_phase(seed: int) -> None:
         check(not unjoined, f"threads failed to join on close: {unjoined}")
 
 
+def _overload_phase(work: str, seed: int) -> None:
+    """Mixed-tenant overload at ~10x drain capacity with a transiently
+    failing replica: interactive p99 must hold its SLO, batch must shed
+    via typed ``AdmissionRejected`` while still making its guaranteed
+    minimum progress, and every submitted request must resolve (result or
+    typed rejection — zero silent drops). All of it proven from the
+    exporter (``/metrics`` + ``/tenants``) and the runlog, not from
+    in-process state."""
+    import json
+    import threading
+    import urllib.request
+
+    import paddle_tpu as pt
+    from paddle_tpu.observability import runlog as runlog_mod
+    from paddle_tpu.observability.exporter import (
+        MetricsServer,
+        parse_text_exposition,
+    )
+    from paddle_tpu.observability.metrics import histogram_quantile
+    from paddle_tpu.reader.feeder import FeedSpec
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import (
+        AdmissionRejected,
+        DeadlineExceeded,
+        ServingConfig,
+        ServingEngine,
+        TenantConfig,
+    )
+
+    slo_p99_s = 0.5
+    label = "chaos_overload"
+
+    def net(x):
+        return pt.layers.fc(x, size=3)
+
+    rng = np.random.RandomState(seed)
+    model = pt.build(net)
+    variables = model.init(0, rng.randn(2, 5).astype(np.float32))
+    engine = ServingEngine(
+        model, variables, [FeedSpec("x", (5,), "float32")],
+        config=ServingConfig(
+            max_batch_size=4, max_queue_delay_s=0.002, num_replicas=2,
+            engine_label=label,
+            tenants=[
+                TenantConfig("interactive", weight=4.0, queue_capacity=8),
+                TenantConfig("batch", weight=1.0, queue_capacity=2,
+                             default_class="batch"),
+            ],
+            batch_min_share=0.2,
+        ),
+    )
+    prev_runlog = runlog_mod.set_runlog(
+        runlog_mod.RunLog(os.path.join(work, "overload_runlog.jsonl")))
+    server = MetricsServer(port=0).start()
+    stop_at = time.monotonic() + 1.5
+    stats_lock = threading.Lock()
+    stats = {"interactive": {"attempts": 0, "ok": 0, "shed": 0, "late": 0},
+             "batch": {"attempts": 0, "ok": 0, "shed": 0, "late": 0}}
+
+    def bump(tenant, key, n=1):
+        with stats_lock:
+            stats[tenant][key] += n
+
+    def interactive_client(ci):
+        r = np.random.RandomState(1000 + ci)
+        while time.monotonic() < stop_at:
+            x = r.randn(1, 5).astype(np.float32)
+            bump("interactive", "attempts")
+            try:
+                out = engine.infer({"x": x}, deadline_s=slo_p99_s,
+                                   tenant="interactive")
+                check(np.asarray(out).shape == (1, 3), "bad overload output")
+                bump("interactive", "ok")
+            except AdmissionRejected:
+                bump("interactive", "shed")  # typed early shed, not a drop
+            except DeadlineExceeded:
+                bump("interactive", "late")  # typed late reject, not a drop
+
+    def batch_client(ci):
+        r = np.random.RandomState(2000 + ci)
+        while time.monotonic() < stop_at:
+            pendings = []
+            for _ in range(4):  # burst past the batch queue quota
+                x = r.randn(1, 5).astype(np.float32)
+                bump("batch", "attempts")
+                try:
+                    pendings.append(engine.submit({"x": x}, tenant="batch"))
+                except AdmissionRejected:
+                    bump("batch", "shed")
+            for p in pendings:
+                check(np.asarray(p.result(timeout=30)).shape == (1, 3),
+                      "bad batch output")
+                bump("batch", "ok")
+
+    try:
+        with faults.injected(
+            # replica 0 drops a few batches mid-overload: redispatch must
+            # absorb it without surfacing request errors
+            faults.FaultSpec(faults.SERVING_DISPATCH, "error",
+                             after=5, times=3, match={"replica": 0}),
+            seed=seed,
+        ):
+            threads = (
+                [threading.Thread(target=interactive_client, args=(i,))
+                 for i in range(10)]
+                + [threading.Thread(target=batch_client, args=(i,))
+                   for i in range(3)]
+            )
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            check(not any(t.is_alive() for t in threads),
+                  "overload clients failed to finish")
+
+        # zero silent drops: every attempt resolved one way, all typed
+        for tenant, s in stats.items():
+            check(s["attempts"] == s["ok"] + s["shed"] + s["late"],
+                  f"silent drop for {tenant}: {s}")
+        check(stats["interactive"]["ok"] > 0, f"interactive starved: {stats}")
+        check(stats["batch"]["shed"] >= 1,
+              f"batch never shed under 10x overload: {stats}")
+        # guaranteed-share floor: batch keeps completing under the flood
+        check(stats["batch"]["ok"] >= 10,
+              f"batch below its guaranteed drain share: {stats}")
+        snap = engine.metrics.snapshot()
+        check(snap["errors_total"] == 0,
+              f"requests errored (redispatch failed to absorb faults): {snap}")
+
+        # interactive p99 from the exporter, the way a dashboard sees it
+        with urllib.request.urlopen(server.url + "/metrics", timeout=10) as r:
+            fams = parse_text_exposition(r.read().decode("utf-8"))
+        fam = fams["serving_tenant_request_latency_seconds"]
+        series = sorted(
+            (float(s[1]["le"]) if s[1]["le"] != "+Inf" else float("inf"),
+             int(float(s[2])))
+            for s in fam["samples"]
+            if s[0].endswith("_bucket") and s[1].get("engine") == label
+            and s[1].get("tenant") == "interactive"
+        )
+        check(bool(series), "no interactive latency series exported")
+        edges = [le for le, _ in series if le != float("inf")]
+        cums = [c for le, c in series if le != float("inf")]
+        count = series[-1][1]
+        p99 = histogram_quantile(edges, cums, count, 0.99)
+        check(p99 <= slo_p99_s,
+              f"interactive p99 {p99:.3f}s blew the {slo_p99_s}s SLO")
+
+        # typed sheds accounted end to end: /tenants and the runlog agree
+        # with what the clients saw
+        client_sheds = stats["interactive"]["shed"] + stats["batch"]["shed"]
+        with urllib.request.urlopen(server.url + "/tenants", timeout=10) as r:
+            tenants_snap = [s for s in json.loads(r.read().decode())
+                            if s["engine"] == label]
+        check(len(tenants_snap) == 1, f"/tenants missing {label}")
+        endpoint_sheds = sum(
+            sum(t["shed_total"].values())
+            for t in tenants_snap[0]["tenants"].values())
+        check(endpoint_sheds == client_sheds,
+              f"/tenants sheds {endpoint_sheds} != client {client_sheds}")
+        events = runlog_mod.read_runlog(
+            os.path.join(work, "overload_runlog.jsonl"))
+        shed_events = [e for e in events if e["kind"] == "admission_shed"]
+        check(len(shed_events) == client_sheds,
+              f"runlog sheds {len(shed_events)} != client {client_sheds}")
+        check(all(e.get("trace_id") for e in shed_events),
+              "admission_shed events missing trace ids")
+        print(f"[chaos] overload: interactive p99={p99 * 1e3:.1f}ms "
+              f"(SLO {slo_p99_s * 1e3:.0f}ms), "
+              f"batch ok={stats['batch']['ok']} shed={stats['batch']['shed']}, "
+              f"sheds accounted={client_sheds}, drops=0")
+    finally:
+        server.close()
+        engine.close(timeout=30)
+        runlog_mod.set_runlog(prev_runlog)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=0)
@@ -297,6 +479,7 @@ def main(argv=None) -> int:
         _corrupt_resume_phase(root)
         _elastic_phase(work, args.seed)
         _serving_phase(args.seed)
+        _overload_phase(work, args.seed)
     except ChaosFailure as e:
         print(f"[chaos] FAIL: {e}", file=sys.stderr)
         return 1
