@@ -702,6 +702,108 @@ def serve_main(duration_s: float = 3.0, tenant_mix: bool = False) -> dict:
     return result
 
 
+def serve_decode_main(n_requests: int = 24) -> dict:
+    """Continuous-batching decode benchmark (``bench.py --serve-decode``):
+    a seeded mixed-length request set served two ways on CPU JAX —
+
+    - **continuous**: ``serving.DecodeEngine`` (paged KV cache, iteration-
+      level admission; a finished request's slot refills next step);
+    - **static**: the ``generate()`` path batched ``max_slots`` at a time,
+      prompts padded to a 16-token bucket and every batch member running
+      to the slowest member's budget — the pre-PR serving discipline.
+
+    Prints ONE JSON line: generated tokens/sec for both paths, the ratio,
+    mean step occupancy, preemption count, and whether the jitted decode
+    step stayed compile-flat under the mixed traffic. Compile time is
+    excluded from both sides (engine warmup / per-shape prewarm), so the
+    ratio isolates the scheduling win, not recompile overhead."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu import models
+    from paddle_tpu.models.transformer_lm import generate
+    from paddle_tpu.serving import DecodeConfig, DecodeEngine
+
+    result = {
+        "metric": "decode_serve_cont_tok_per_sec",
+        "value": 0.0,
+        "unit": "tok/s",
+        "notes": [],
+    }
+    try:
+        result["device_kind"] = jax.devices()[0].device_kind
+        vocab, slots = 512, 4
+        spec = models.get_model("transformer_lm", seq_len=128, vocab=vocab,
+                                d_model=64, d_inner=128, num_heads=4,
+                                n_layers=2)
+        cfg = spec.extra["cfg"]
+        rng = np.random.RandomState(0)
+        variables = spec.model.init(0, *spec.synth_batch(2, rng))
+        reqs = []
+        for _ in range(n_requests):
+            tp = int(rng.randint(4, 25))
+            mnt = int(rng.randint(8, 49))
+            reqs.append((rng.randint(1, vocab, size=(tp,)).astype(np.int32),
+                         mnt))
+        total_tokens = sum(mnt for _, mnt in reqs)
+
+        # -- continuous: one engine, all requests submitted up front ------
+        eng = DecodeEngine(variables, cfg, decode=DecodeConfig(
+            max_slots=slots, page_size=16, max_context=128,
+            prefill_chunk=16))
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, mnt) for p, mnt in reqs]
+        outs = [h.result(timeout=600) for h in handles]
+        dt_cont = time.perf_counter() - t0
+        gen_cont = sum(len(o.tokens) for o in outs)
+        snap = eng.metrics.snapshot()
+        compile_flat = (eng.decode_step_cache_size() == 1
+                        and eng.prefill_cache_size() == 1)
+        eng.close()
+        eng.kv.assert_no_leaks()
+
+        # -- static: generate() in admission-order batches of `slots` -----
+        def bucket(n, q=16):
+            return -(-n // q) * q
+
+        batches = []
+        for i in range(0, len(reqs), slots):
+            group = reqs[i:i + slots]
+            tp_pad = bucket(max(len(p) for p, _ in group))
+            mnt_max = max(mnt for _, mnt in group)
+            prompts = np.ones((len(group), tp_pad), np.int32)  # pad tok 1
+            for j, (p, _) in enumerate(group):
+                prompts[j, tp_pad - len(p):] = p  # right-align real tokens
+            batches.append((jnp.asarray(prompts), mnt_max))
+        for prompts, mnt_max in batches:  # prewarm each (B, Tp, N) shape
+            np.asarray(generate(variables, prompts, mnt_max, cfg))
+        t0 = time.perf_counter()
+        for prompts, mnt_max in batches:
+            np.asarray(generate(variables, prompts, mnt_max, cfg))
+        dt_static = time.perf_counter() - t0
+
+        result["value"] = round(gen_cont / dt_cont, 1)
+        result["decode_serve_static_tok_per_sec"] = round(
+            total_tokens / dt_static, 1)
+        result["speedup_vs_static"] = round(
+            (gen_cont / dt_cont) / max(total_tokens / dt_static, 1e-9), 2)
+        result["requests"] = len(reqs)
+        result["tokens_generated"] = gen_cont
+        result["mean_step_occupancy"] = round(snap["mean_step_occupancy"], 2)
+        result["preempted_total"] = snap["preempted_total"]
+        result["compile_flat"] = compile_flat
+        if not compile_flat:
+            result["notes"].append("decode step recompiled under traffic")
+    except Exception as e:  # same robustness contract as main(): always JSON
+        result["notes"].append(
+            f"serve_decode_failed: {type(e).__name__}: {e}"[:300])
+    print(json.dumps(result))
+    return result
+
+
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
 
@@ -807,6 +909,9 @@ def main() -> dict:
 if __name__ == "__main__":
     if "--child" in sys.argv:
         child_main(tiny="--tiny" in sys.argv, force_cpu="--cpu" in sys.argv)
+    elif "--serve-decode" in sys.argv:
+        serve_decode_main(
+            n_requests=int(os.environ.get("PT_BENCH_DECODE_REQS", "24")))
     elif "--serve" in sys.argv:
         serve_main(
             duration_s=float(os.environ.get("PT_BENCH_SERVE_S", "3")),

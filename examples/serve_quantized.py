@@ -1,6 +1,11 @@
 """Serve a trained model from pure C++ via the native predictor, with
 int8 weight-only quantization (~4x smaller artifact).
 
+This path fits fixed-shape (single forward pass) inference. For
+autoregressive generation, use the continuous-batching decode engine
+instead — see examples/serve_decode.py (paged KV cache, iteration-level
+admission, no per-shape recompiles).
+
 Run: python examples/serve_quantized.py
 """
 import os

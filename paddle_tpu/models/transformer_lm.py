@@ -36,7 +36,8 @@ from paddle_tpu.models.transformer import (
 )
 
 __all__ = ["get_model", "lm_forward", "generate", "generate_beam",
-           "stack_decode_params", "BASE_CFG"]
+           "stack_decode_params", "BASE_CFG",
+           "paged_cache_shape", "paged_prefill_chunk", "paged_decode_step"]
 
 
 def _ring_core(ring_mesh, window=None):
@@ -635,6 +636,301 @@ def generate(
         step, carry, jnp.arange(max_new_tokens - 1)
     )
     return jnp.concatenate([toks.transpose(1, 0), last_tok[:, None]], axis=1)
+
+
+# ---- paged decode (serving.kv_cache / serving.decode) ---------------------
+#
+# The paged variant of generate()'s cache read/write: K/V live in fixed-size
+# pages ([L, num_pages, H_kv, page_size, dh]) and each sequence maps logical
+# positions to physical pages through an int32 page-table row. Every array
+# shape below is a function of static config (slot count, table width, page
+# size) — never of which requests are in flight — so the serving decode step
+# compiles once and continuous batching (admit/evict between steps) never
+# pays XLA again. Same parameter names and attention math as generate();
+# the exactness test pins the two against each other.
+
+
+def _paged_enforce(cfg, temperature, rng):
+    from paddle_tpu.core.enforce import enforce
+
+    enforce(
+        not cfg.get("scan_layers"),
+        "paged decode: scan_layers is not supported in the paged path yet "
+        "(v1 scope: the layer loop is unrolled; use generate() for "
+        "scan-layers decode)",
+    )
+    enforce(
+        not cfg.get("moe_experts"),
+        "paged decode: MoE FFNs are not supported in the cached decoders — "
+        "use a dense-FFN config",
+    )
+    enforce(
+        temperature == 0.0 or rng is not None,
+        "paged decode: sampling (temperature > 0) needs an explicit rng key",
+    )
+
+
+def paged_cache_shape(cfg: dict, num_pages: int, page_size: int):
+    """Shape of ``k_pages``/``v_pages`` for ``cfg``:
+    ``[L, num_pages, H_kv, page_size, dh]``."""
+    H = cfg["num_heads"]
+    H_kv = cfg.get("num_kv_heads") or H
+    dh = cfg["d_model"] // H
+    return (cfg["n_layers"], num_pages, H_kv, page_size, dh)
+
+
+def _paged_ops(params, cfg):
+    """The p/ln/proj/ffn/logits/sample closures shared by the paged prefill
+    and decode-step entry points — the same math as :func:`generate`'s
+    inline copies (parameter names as created by :func:`lm_forward`)."""
+    D, H = cfg["d_model"], cfg["num_heads"]
+    dh = D // H
+    swiglu = cfg.get("ffn_activation", "relu") == "swiglu"
+
+    def p(name):
+        return params[name]
+
+    def ln(x, pfx):
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.mean((x - mu) ** 2, -1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-5) * p(f"{pfx}/scale") + p(f"{pfx}/bias")
+
+    def proj(x, pfx, bias=True):
+        out = x @ p(f"{pfx}/w")
+        return out + p(f"{pfx}/b") if bias else out
+
+    ffn = _decode_ffn_fn(proj, swiglu)
+
+    def logits_of(x_last):
+        return ln(x_last, "layer_norm") @ p("project/logits/w")
+
+    def sample(logits, key, temperature, top_k, top_p):
+        if temperature == 0.0:
+            return jnp.argmax(logits, -1).astype(jnp.int32)
+        logits = logits / temperature
+        if top_k is not None:
+            kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p is not None:
+            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep_sorted = cum - probs < top_p
+            cutoff = jnp.min(
+                jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
+                keepdims=True,
+            )
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+        return jax.random.categorical(key, logits).astype(jnp.int32)
+
+    return p, ln, proj, ffn, logits_of, sample
+
+
+def _paged_live_mask(q_pos, t_eff: int, window):
+    """[..., T_eff] bool: key position t visible from query position
+    ``q_pos`` ([...] int32) — causal, and within the sliding window when
+    configured. The gathered pages cover logical positions [0, T_eff); any
+    slot beyond the sequence's written length is > q_pos and masks out."""
+    t = jnp.arange(t_eff)
+    live = t <= q_pos[..., None]
+    if window is not None:
+        live &= t > q_pos[..., None] - window
+    return live
+
+
+def paged_prefill_chunk(
+    params,
+    tokens: jax.Array,
+    pos0: jax.Array,
+    last_index: jax.Array,
+    page_table: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    rng: jax.Array | None = None,
+    *,
+    cfg: dict,
+    page_size: int,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+):
+    """Prefill ONE sequence's chunk into its pages: ``tokens`` [C] int32 at
+    absolute positions ``[pos0, pos0+C)``, mapped through ``page_table``
+    [P] int32. Returns ``(next_token, k_pages, v_pages)`` where
+    ``next_token`` (scalar int32) is sampled from the logits at chunk
+    index ``last_index`` — meaningful only on the prompt's final chunk
+    (the first generated token); earlier chunks ignore it.
+
+    Long prompts run as a sequence of fixed-``C`` chunks (the final one
+    padded up), so prompt length never changes the compiled program and a
+    long prefill never monopolizes the decode loop — the engine interleaves
+    one chunk per iteration. Queries at padded positions (>= the prompt
+    end) write K/V that decode overwrites position-by-position before ever
+    attending to them, and their own outputs are discarded.
+    """
+    from paddle_tpu.models.transformer import sinusoid_position_encoding
+
+    params = params.params if hasattr(params, "params") else params
+    _paged_enforce(cfg, temperature, rng)
+    (C,) = tokens.shape
+    P = page_table.shape[0]
+    t_eff = P * page_size
+    D, H = cfg["d_model"], cfg["num_heads"]
+    dh = D // H
+    H_kv = cfg.get("num_kv_heads") or H
+    G = H // H_kv
+    L = cfg["n_layers"]
+    rope = cfg.get("pos_encoding", "sinusoid") == "rope"
+    window = cfg.get("attention_window")
+    scale = 1.0 / np.sqrt(dh)
+    cdt = k_pages.dtype
+    p, ln, proj, ffn, logits_of, sample = _paged_ops(params, cfg)
+
+    e = jnp.take(p("emb/embedding/word_emb"), tokens, axis=0) * (D ** 0.5)
+    if rope:
+        from paddle_tpu.ops.attention import apply_rope, rope_tables
+
+        rope_cos, rope_sin = rope_tables(dh, max(cfg["max_len"], t_eff))
+    else:
+        pe = sinusoid_position_encoding(max(cfg["max_len"], t_eff), D)
+        e = e + jax.lax.dynamic_slice_in_dim(pe, pos0, C, axis=0)
+    x = e[None]  # [1, C, D]
+    pos = pos0 + jnp.arange(C, dtype=jnp.int32)
+    phys = page_table[pos // page_size]  # [C] physical page per position
+    off = pos % page_size
+    live = _paged_live_mask(pos, t_eff, window)  # [C, T_eff]
+
+    def heads(y, n):  # [1, C, n*dh] -> [1, n, C, dh]
+        return y.reshape(1, C, n, dh).transpose(0, 2, 1, 3)
+
+    for i in range(L):
+        pfx = f"layer_{i}/self_attn"
+        q = heads(proj(x, f"{pfx}/q"), H)
+        k = heads(proj(x, f"{pfx}/k"), H_kv)
+        v = heads(proj(x, f"{pfx}/v"), H_kv)
+        if rope:
+            cos = jax.lax.dynamic_slice_in_dim(rope_cos, pos0, C, axis=0)
+            sin = jax.lax.dynamic_slice_in_dim(rope_sin, pos0, C, axis=0)
+            q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+        # scatter the chunk's K/V into this sequence's pages (pre-rotated
+        # K, exactly as generate() stores it)
+        k_pages = k_pages.at[i, phys, :, off].set(
+            k[0].transpose(1, 0, 2).astype(cdt))
+        v_pages = v_pages.at[i, phys, :, off].set(
+            v[0].transpose(1, 0, 2).astype(cdt))
+        # gather the sequence's whole logical context back through the
+        # table (includes the chunk just written) and mask by position
+        kl = k_pages[i][page_table].transpose(1, 0, 2, 3).reshape(
+            H_kv, t_eff, dh)[None]
+        vl = v_pages[i][page_table].transpose(1, 0, 2, 3).reshape(
+            H_kv, t_eff, dh)[None]
+        qg = q.reshape(1, H_kv, G, C, dh)
+        s = jnp.einsum("bkgqd,bktd->bkgqt", qg, kl) * scale
+        s = jnp.where(live[None, None, None], s, -1e9)
+        ctx = jnp.einsum("bkgqt,bktd->bkgqd", jax.nn.softmax(s, -1), vl)
+        ctx = ctx.reshape(1, H, C, dh).transpose(0, 2, 1, 3).reshape(1, C, D)
+        x = ln(x + proj(ctx, f"{pfx}/out"), f"layer_{i}/layer_norm")
+        x = ln(x + ffn(x, i), f"layer_{i}/layer_norm_1")
+
+    x_last = jax.lax.dynamic_index_in_dim(x[0], last_index, 0, keepdims=False)
+    tok = sample(logits_of(x_last), rng, temperature, top_k, top_p)
+    return tok, k_pages, v_pages
+
+
+def paged_decode_step(
+    params,
+    tokens: jax.Array,
+    positions: jax.Array,
+    page_tables: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    rng: jax.Array | None = None,
+    *,
+    cfg: dict,
+    page_size: int,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+):
+    """One decode iteration for ``S`` independent sequences against the
+    paged cache: embed ``tokens`` [S] at per-slot absolute ``positions``
+    [S], write each token's K/V into its slot's pages, attend over each
+    slot's gathered context, and sample the next token. Returns
+    ``(next_tokens [S], k_pages, v_pages)``.
+
+    Shapes depend only on (S, table width, page size, model config) — the
+    continuous-batching contract: slots change occupants between calls
+    without recompiling. Inactive slots point at the scratch page; their
+    writes and outputs are garbage the engine ignores.
+
+    The gather materializes each slot's ``[H_kv, T_eff, dh]`` context per
+    layer — the straightforward XLA lowering. A Pallas paged-attention
+    kernel that streams pages from HBM without the copy is the known TPU
+    follow-up; the interface (pages + tables) is already shaped for it.
+    """
+    from paddle_tpu.models.transformer import sinusoid_position_encoding
+
+    params = params.params if hasattr(params, "params") else params
+    _paged_enforce(cfg, temperature, rng)
+    (S,) = tokens.shape
+    P = page_tables.shape[1]
+    t_eff = P * page_size
+    D, H = cfg["d_model"], cfg["num_heads"]
+    dh = D // H
+    H_kv = cfg.get("num_kv_heads") or H
+    G = H // H_kv
+    L = cfg["n_layers"]
+    rope = cfg.get("pos_encoding", "sinusoid") == "rope"
+    window = cfg.get("attention_window")
+    scale = 1.0 / np.sqrt(dh)
+    cdt = k_pages.dtype
+    p, ln, proj, ffn, logits_of, sample = _paged_ops(params, cfg)
+
+    x = jnp.take(p("emb/embedding/word_emb"), tokens, axis=0) * (D ** 0.5)
+    if rope:
+        from paddle_tpu.ops.attention import rope_tables
+
+        rope_cos, rope_sin = rope_tables(dh, max(cfg["max_len"], t_eff))
+        cos, sin = rope_cos[positions], rope_sin[positions]  # [S, dh//2]
+
+        def rot(y):  # [S, n, dh] rotated at each slot's own position
+            half = dh // 2
+            y1, y2 = y[..., :half], y[..., half:]
+            c, s_ = cos[:, None, :], sin[:, None, :]
+            yf1, yf2 = y1.astype(jnp.float32), y2.astype(jnp.float32)
+            return jnp.concatenate(
+                [yf1 * c - yf2 * s_, yf1 * s_ + yf2 * c], -1
+            ).astype(y.dtype)
+    else:
+        pe = sinusoid_position_encoding(max(cfg["max_len"], t_eff), D)
+        x = x + pe[positions]
+    phys = page_tables[jnp.arange(S), positions // page_size]  # [S]
+    off = positions % page_size
+    live = _paged_live_mask(positions, t_eff, window)  # [S, T_eff]
+
+    for i in range(L):
+        pfx = f"layer_{i}/self_attn"
+        q = proj(x, f"{pfx}/q").reshape(S, H, dh)
+        k = proj(x, f"{pfx}/k").reshape(S, H_kv, dh)
+        v = proj(x, f"{pfx}/v").reshape(S, H_kv, dh)
+        if rope:
+            q, k = rot(q), rot(k)
+        k_pages = k_pages.at[i, phys, :, off].set(k.astype(cdt))
+        v_pages = v_pages.at[i, phys, :, off].set(v.astype(cdt))
+        kl = k_pages[i][page_tables].transpose(0, 2, 1, 3, 4).reshape(
+            S, H_kv, t_eff, dh)
+        vl = v_pages[i][page_tables].transpose(0, 2, 1, 3, 4).reshape(
+            S, H_kv, t_eff, dh)
+        qg = q.reshape(S, H_kv, G, dh)
+        s = jnp.einsum("skgd,sktd->skgt", qg, kl) * scale
+        s = jnp.where(live[:, None, None], s, -1e9)
+        ctx = jnp.einsum("skgt,sktd->skgd", jax.nn.softmax(s, -1), vl)
+        ctx = ctx.reshape(S, D)
+        x = ln(x + proj(ctx, f"{pfx}/out"), f"layer_{i}/layer_norm")
+        x = ln(x + ffn(x, i), f"layer_{i}/layer_norm_1")
+
+    nxt = sample(logits_of(x), rng, temperature, top_k, top_p)
+    return nxt, k_pages, v_pages
 
 
 BASE_CFG = dict(

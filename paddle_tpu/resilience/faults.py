@@ -57,6 +57,7 @@ __all__ = [
     "READER_NEXT",
     "TRAINER_STEP",
     "SERVING_DISPATCH",
+    "DECODE_STEP",
     "DEVICE_LOST",
     "PREEMPT_NOTICE",
     "DeviceLostError",
@@ -68,6 +69,10 @@ CHECKPOINT_LOAD = "checkpoint.load"
 READER_NEXT = "reader.next"
 TRAINER_STEP = "trainer.step"
 SERVING_DISPATCH = "serving.dispatch"
+# continuous-batching decode loop (serving.decode.DecodeEngine): fires
+# around the jitted decode step, so chaos runs can fail one iteration and
+# assert the loop keeps serving the surviving requests
+DECODE_STEP = "serving.decode.step"
 # elastic-training points (trainer step loop): a replica/device vanishing
 # mid-step, and the scheduler's advance preemption notice — both are
 # hardware/cluster events in production, injectable here so the whole

@@ -24,6 +24,17 @@ Quickstart::
     logits = engine.infer({"x": batch})     # sync
     fut = engine.submit({"x": batch})        # async → fut.result()
     engine.close()                           # graceful drain
+
+Autoregressive decode uses the continuous-batching path instead
+(``serving.decode`` + ``serving.kv_cache``): iteration-level admission
+into a paged KV cache, so a freed slot refills on the next decode step
+instead of idling until the slowest request in a static batch drains::
+
+    from paddle_tpu.serving import DecodeEngine, DecodeConfig
+
+    eng = DecodeEngine(variables, cfg, decode=DecodeConfig(max_slots=8))
+    out = eng.infer(prompt_ids, max_new_tokens=64)   # DecodeOutput
+    eng.close()
 """
 
 from paddle_tpu.serving.admission import (
@@ -34,6 +45,13 @@ from paddle_tpu.serving.admission import (
 )
 from paddle_tpu.serving.batcher import Group, MicroBatcher
 from paddle_tpu.serving.buckets import ShapeBuckets
+from paddle_tpu.serving.decode import (
+    DecodeConfig,
+    DecodeCostModel,
+    DecodeEngine,
+    DecodeHandle,
+    DecodeOutput,
+)
 from paddle_tpu.serving.engine import (
     DeadlineExceeded,
     EngineClosedError,
@@ -42,7 +60,12 @@ from paddle_tpu.serving.engine import (
     ServingConfig,
     ServingEngine,
 )
-from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.serving.kv_cache import (
+    SCRATCH_PAGE,
+    PageAllocator,
+    PagedKVCache,
+)
+from paddle_tpu.serving.metrics import DecodeMetrics, ServingMetrics
 from paddle_tpu.serving.scheduler import (
     BATCH,
     INTERACTIVE,
@@ -67,4 +90,13 @@ __all__ = [
     "WeightedFairScheduler",
     "INTERACTIVE",
     "BATCH",
+    "DecodeEngine",
+    "DecodeConfig",
+    "DecodeCostModel",
+    "DecodeHandle",
+    "DecodeOutput",
+    "DecodeMetrics",
+    "PagedKVCache",
+    "PageAllocator",
+    "SCRATCH_PAGE",
 ]

@@ -199,6 +199,7 @@ class AdmissionController:
         exec_snapshot: Optional[Callable[[], Optional[dict]]] = None,
         healthy_replicas: Callable[[], int] = lambda: 1,
         slo_probe: Optional[Callable[[], bool]] = None,
+        request_cost: Optional[Callable[[Any], Optional[float]]] = None,
         brownout_min_s: float = 1.0,
         deadline_quantile: float = 0.9,
         clock: Callable[[], float] = time.monotonic,
@@ -210,6 +211,7 @@ class AdmissionController:
         self._exec_snapshot = exec_snapshot
         self._healthy_replicas = healthy_replicas
         self._slo_probe = slo_probe
+        self._request_cost = request_cost
         self.brownout_min_s = float(brownout_min_s)
         self.deadline_quantile = float(deadline_quantile)
         self._clock = clock
@@ -303,6 +305,24 @@ class AdmissionController:
         wait = depth / max(batch_rate * share, 1e-9)
         return wait + p_exec
 
+    def _predict_for(self, req, tenant: str) -> Optional[float]:
+        """Per-request latency prediction. The per-request cost model (when
+        wired) wins over the whole-request histogram: for autoregressive
+        decode, whole-request latency distributions misprice long
+        generations — the decode engine supplies per-token cost ×
+        ``max_new_tokens`` instead (see serving.decode.DecodeCostModel).
+        A None or failing cost model falls back to the histogram path, and
+        both return None when cold (admit everything; shedding on zero data
+        would reject the traffic that builds the model)."""
+        if self._request_cost is not None:
+            try:
+                predicted = self._request_cost(req)
+            except Exception:
+                predicted = None  # a broken cost model must not shed
+            if predicted is not None:
+                return float(predicted)
+        return self.predicted_latency(tenant)
+
     # -- the decision ------------------------------------------------------
 
     def admit(self, req) -> None:
@@ -319,7 +339,7 @@ class AdmissionController:
             self._shed(req, "brownout",
                        f"level={level} reason={self._brownout_reason}")
         if req.deadline is not None:
-            predicted = self.predicted_latency(tenant)
+            predicted = self._predict_for(req, tenant)
             remaining = req.deadline - self._clock()
             if predicted is not None and predicted > remaining:
                 self._shed(

@@ -1,0 +1,836 @@
+"""Continuous (iteration-level) batching for autoregressive decode.
+
+The static-batch path (:mod:`serving.engine`) dispatches whole requests:
+for autoregressive decode that means every slot in a micro-batch idles
+until the SLOWEST request in it drains — measured tokens/sec is bounded
+by the worst request per batch, not the hardware. :class:`DecodeEngine`
+replaces that execution model for LM decode: requests are admitted into
+and evicted from the running batch *between decode iterations*, so a slot
+freed by a short generation is refilled on the very next step while long
+generations keep streaming.
+
+Execution model (single decode-loop thread)::
+
+    submit(prompt, max_new_tokens) ──▶ admission control (per-token cost)
+        ──▶ weighted-fair scheduler (DRR by predicted token cost)
+        ──▶ slot + page assignment (serving.kv_cache)
+        ──▶ chunked prefill, bounded per iteration (never stalls decode)
+        ──▶ ONE jitted decode step per iteration over all active slots
+        ──▶ host-side finish checks (eos / budget / cancel / deadline)
+        ──▶ freed slots refill from the queue before the next step
+
+The KV cache is paged (:mod:`serving.kv_cache`): fixed-size pages plus
+per-slot page tables, so the jitted step's shapes depend only on static
+config ``(max_slots, table_width, page_size)`` — XLA compiles the step
+once at warmup and admission/eviction/preemption never recompile
+(:meth:`DecodeEngine.decode_step_cache_size` stays flat; the acceptance
+test pins it). Prefill runs as fixed-size chunks through the same pages,
+at most ``prefill_chunks_per_iter`` per iteration, so a long prompt is
+absorbed a chunk at a time between decode steps instead of stalling them.
+
+When the page pool is exhausted mid-growth the engine preempts the most
+recently admitted other request (LIFO — oldest work finishes first):
+its pages are freed, its generated prefix is kept, and it re-enters at
+the front of the line to re-prefill ``prompt + generated`` and continue.
+Greedy decode therefore produces identical tokens with or without
+preemption. ``num_pages`` must exceed one slot's worth of pages
+(enforced), so a lone request can always run to completion — the
+preemption loop cannot deadlock.
+
+Deadline admission uses a per-token cost model (:class:`DecodeCostModel`)
+instead of the whole-request latency histograms the static path predicts
+from: predicted latency = chunks x chunk-EMA + max_new_tokens x step-EMA,
+which prices a 4-token and a 400-token generation differently where a
+request-latency histogram cannot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from paddle_tpu import observability, tracing
+from paddle_tpu.concurrency import ChannelClosedError, go
+from paddle_tpu.core import config as cfg_mod
+from paddle_tpu.core import logging as ptlog
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.models.transformer_lm import (
+    paged_cache_shape,
+    paged_decode_step,
+    paged_prefill_chunk,
+)
+from paddle_tpu.observability import runlog
+from paddle_tpu.resilience import faults
+from paddle_tpu.serving import admission as admission_mod
+from paddle_tpu.serving import scheduler as sched_mod
+from paddle_tpu.serving.admission import AdmissionRejected, TenantConfig
+from paddle_tpu.serving.engine import (
+    DeadlineExceeded,
+    EngineClosedError,
+    PendingResult,
+    ServingConfig,
+)
+from paddle_tpu.serving.kv_cache import SCRATCH_PAGE, PagedKVCache
+from paddle_tpu.serving.metrics import DecodeMetrics
+
+__all__ = [
+    "DecodeConfig",
+    "DecodeCostModel",
+    "DecodeEngine",
+    "DecodeHandle",
+    "DecodeOutput",
+]
+
+
+@dataclasses.dataclass
+class DecodeConfig:
+    """Continuous-batching policy knobs (the model/tenant/admission side
+    rides on :class:`~paddle_tpu.serving.engine.ServingConfig`)."""
+
+    # concurrent sequences per decode step (the step's static batch dim)
+    max_slots: int = 4
+    # tokens per KV page; pages are the HBM allocation granularity
+    page_size: int = 16
+    # per-sequence position capacity (prompt + generation); must be a
+    # multiple of both page_size and prefill_chunk
+    max_context: int = 256
+    # physical page pool; None = every slot fully grown + scratch
+    num_pages: Optional[int] = None
+    # prompt tokens absorbed per prefill call (fixed-shape chunks)
+    prefill_chunk: int = 32
+    # prefill chunks run per decode iteration (prefill never monopolizes
+    # the loop; decode steps keep landing between chunks)
+    prefill_chunks_per_iter: int = 1
+    # sampling policy (engine-wide; greedy by default)
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    rng_seed: int = 0
+    # stop token; None = run every request to its max_new_tokens budget
+    eos_id: Optional[int] = None
+    # KV page dtype; overrides ServingConfig.cache_dtype when set
+    cache_dtype: Optional[Any] = None
+    # compile the prefill + step executables at init
+    warmup: bool = True
+    # idle poll interval on the scheduler when no slot is active
+    idle_poll_s: float = 0.02
+
+
+@dataclasses.dataclass
+class DecodeOutput:
+    """One finished generation. ``tokens`` holds the generated ids
+    (including ``eos_id`` when that ended it); ``finish_reason`` is
+    ``"eos"`` | ``"length"`` | ``"cancelled"``."""
+
+    tokens: np.ndarray
+    finish_reason: str
+    prompt_len: int
+    n_preemptions: int = 0
+
+
+class DecodeHandle(PendingResult):
+    """Future for one decode request, plus mid-generation cancellation:
+    :meth:`cancel` marks the request; the loop completes it with the
+    tokens generated so far (``finish_reason="cancelled"``) at the next
+    iteration boundary."""
+
+    def __init__(self, req: "_DecodeRequest"):
+        super().__init__()
+        self._req = req
+
+    def cancel(self) -> None:
+        self._req.cancelled = True
+
+
+class _DecodeRequest:
+    __slots__ = ("prompt", "mnt", "n", "bytes", "tenant", "cls", "deadline",
+                 "t_submit", "handle", "generated", "slot", "phase", "seq",
+                 "chunks_done", "cur_len", "last_tok", "cancelled",
+                 "n_preemptions", "trace", "t_enqueue_pc", "t_admit_pc")
+
+    def __init__(self, prompt: np.ndarray, mnt: int, n_chunks: int,
+                 deadline: Optional[float], t_submit: float,
+                 tenant: str = "default", cls: str = "interactive"):
+        self.prompt = prompt
+        self.mnt = mnt
+        # DRR weight: predicted device iterations (decode steps + prefill
+        # chunks), so fairness is by token cost, not request count
+        self.n = mnt + n_chunks
+        self.bytes = int(prompt.nbytes)
+        self.tenant = tenant
+        self.cls = cls
+        self.deadline = deadline
+        self.t_submit = t_submit
+        self.handle = DecodeHandle(self)
+        self.generated: List[int] = []
+        self.slot: Optional[int] = None
+        self.phase = "queued"          # queued | prefill | decode
+        self.seq: Optional[np.ndarray] = None  # tokens being prefilled
+        self.chunks_done = 0
+        self.cur_len = 0               # K/V positions written so far
+        self.last_tok = 0              # next token to feed the step
+        self.cancelled = False
+        self.n_preemptions = 0
+        self.trace: Optional[tracing.SpanContext] = None
+        self.t_enqueue_pc: Optional[float] = None
+        self.t_admit_pc: Optional[float] = None
+
+
+class DecodeCostModel:
+    """EMA cost model for decode admission: per-iteration step cost and
+    per-chunk prefill cost, observed by the loop. ``step_s``/``chunk_s``
+    preset the EMAs (deterministic tests / warm handoff); cold (no step
+    observations and no preset) estimates are None so admission falls
+    back to admitting everything — shedding on zero data would reject
+    the traffic that builds the model."""
+
+    def __init__(self, alpha: float = 0.2, step_s: Optional[float] = None,
+                 chunk_s: Optional[float] = None):
+        enforce(0.0 < alpha <= 1.0, f"alpha must be in (0, 1], got {alpha}")
+        self.alpha = float(alpha)
+        self._step_s = step_s
+        self._chunk_s = chunk_s
+        self._lock = threading.Lock()
+
+    def observe_step(self, seconds: float) -> None:
+        with self._lock:
+            self._step_s = (seconds if self._step_s is None else
+                            self.alpha * seconds +
+                            (1 - self.alpha) * self._step_s)
+
+    def observe_chunk(self, seconds: float) -> None:
+        with self._lock:
+            self._chunk_s = (seconds if self._chunk_s is None else
+                             self.alpha * seconds +
+                             (1 - self.alpha) * self._chunk_s)
+
+    def estimate(self, n_chunks: int, max_new_tokens: int,
+                 queue_cost: int = 0) -> Optional[float]:
+        """Predicted service latency: prefill chunks + one step per new
+        token, plus ``queue_cost`` iterations already queued ahead. None
+        while cold."""
+        with self._lock:
+            step_s, chunk_s = self._step_s, self._chunk_s
+        if step_s is None:
+            return None
+        if chunk_s is None:
+            chunk_s = step_s
+        return (n_chunks * chunk_s + max_new_tokens * step_s
+                + queue_cost * step_s)
+
+    def snapshot(self) -> Dict[str, Optional[float]]:
+        with self._lock:
+            return {"step_s": self._step_s, "chunk_s": self._chunk_s}
+
+
+class DecodeEngine:
+    """Iteration-level batched autoregressive serving over a trained
+    transformer LM (params as created by
+    :func:`~paddle_tpu.models.transformer_lm.lm_forward`).
+
+    ::
+
+        eng = DecodeEngine(variables, cfg, decode=DecodeConfig(max_slots=8))
+        out = eng.infer(prompt_ids, max_new_tokens=32)   # DecodeOutput
+        h = eng.submit(prompt_ids, 128)                  # async
+        h.cancel()                                       # mid-generation
+        eng.close()                                      # graceful drain
+    """
+
+    def __init__(
+        self,
+        variables,
+        model_cfg: dict,
+        *,
+        config: Optional[ServingConfig] = None,
+        decode: Optional[DecodeConfig] = None,
+    ):
+        self.config = config or ServingConfig()
+        self.decode_config = dconf = decode or DecodeConfig()
+        self.model_cfg = dict(model_cfg)
+        enforce(dconf.max_slots >= 1,
+                f"max_slots must be >= 1, got {dconf.max_slots}")
+        enforce(dconf.prefill_chunk >= 1,
+                f"prefill_chunk must be >= 1, got {dconf.prefill_chunk}")
+        enforce(dconf.max_context % dconf.page_size == 0,
+                f"max_context ({dconf.max_context}) must be a multiple of "
+                f"page_size ({dconf.page_size})")
+        # padded prompt chunks must stay inside the slot's table span —
+        # a chunk running past it would clamp-scatter into the last page
+        enforce(dconf.max_context % dconf.prefill_chunk == 0,
+                f"max_context ({dconf.max_context}) must be a multiple of "
+                f"prefill_chunk ({dconf.prefill_chunk})")
+        pages_per_slot = dconf.max_context // dconf.page_size
+        num_pages = (dconf.num_pages if dconf.num_pages is not None
+                     else 1 + dconf.max_slots * pages_per_slot)
+        self._kv = PagedKVCache(
+            max_slots=dconf.max_slots, page_size=dconf.page_size,
+            num_pages=num_pages, pages_per_slot=pages_per_slot)
+        self.metrics = DecodeMetrics(engine_label=self.config.engine_label)
+        observability.setup()
+        self.cost = DecodeCostModel()
+
+        params = variables.params if hasattr(variables, "params") else variables
+        self._params = jax.device_put(params)
+        cdt = (dconf.cache_dtype if dconf.cache_dtype is not None
+               else self.config.cache_dtype)
+        pshape = paged_cache_shape(self.model_cfg, num_pages, dconf.page_size)
+        import jax.numpy as jnp
+
+        self._cache_dtype = cdt or jnp.float32
+        self._k_pages = jnp.zeros(pshape, self._cache_dtype)
+        self._v_pages = jnp.zeros(pshape, self._cache_dtype)
+        sample_kw = dict(temperature=dconf.temperature, top_k=dconf.top_k,
+                         top_p=dconf.top_p)
+        self._step = jax.jit(functools.partial(
+            paged_decode_step, cfg=self.model_cfg,
+            page_size=dconf.page_size, **sample_kw))
+        self._prefill = jax.jit(functools.partial(
+            paged_prefill_chunk, cfg=self.model_cfg,
+            page_size=dconf.page_size, **sample_kw))
+        self._rng = (jax.random.PRNGKey(dconf.rng_seed)
+                     if dconf.temperature > 0.0 else None)
+
+        # tenants / scheduler / admission — same wiring as ServingEngine,
+        # but deadline feasibility runs through the per-token cost model
+        tenant_cfgs = [t.resolved() for t in (self.config.tenants or ())]
+        if not tenant_cfgs:
+            tenant_cfgs = [TenantConfig(
+                "default", queue_capacity=self.config.queue_capacity,
+            ).resolved()]
+        self._tenants = {t.name: t for t in tenant_cfgs}
+        self._default_tenant = (
+            "default" if "default" in self._tenants else tenant_cfgs[0].name)
+        admission_on = (self.config.admission
+                        if self.config.admission is not None
+                        else self.config.tenants is not None)
+        self._queue = sched_mod.WeightedFairScheduler(
+            self._tenants,
+            quantum_rows=max(8, dconf.max_slots * 8),
+            batch_min_share=(self.config.batch_min_share
+                             if self.config.batch_min_share is not None
+                             else cfg_mod.flags().tenant_batch_min_share),
+            legacy_capacity=(None if admission_on
+                             else self.config.queue_capacity),
+            on_expired=self._expire,
+        )
+        self._admission: Optional[admission_mod.AdmissionController] = None
+        if admission_on:
+            self._admission = admission_mod.AdmissionController(
+                self._queue, self.metrics, self._tenants,
+                request_cost=self._request_cost,
+                brownout_min_s=self.config.brownout_min_s,
+            )
+            admission_mod.install(self._admission)
+
+        self._active: List[_DecodeRequest] = []     # admission order
+        self._resume: Deque[_DecodeRequest] = deque()
+        self._pending_admit: Deque[_DecodeRequest] = deque()
+        self._closed = False
+        self._close_lock = threading.Lock()
+        self._loop_trace: Optional[tracing.SpanContext] = None
+        if tracing.tracing_enabled():
+            self._loop_trace = tracing.SpanContext.new_trace()
+
+        if dconf.warmup:
+            self._warmup()
+        self._thread = go(self._loop)
+
+    # -- startup -----------------------------------------------------------
+
+    def _warmup(self) -> None:
+        """Compile the prefill-chunk and decode-step executables before
+        traffic arrives. Warmup writes land on the scratch page (zero
+        tables), so no reset is needed afterwards."""
+        import jax.numpy as jnp
+
+        dconf = self.decode_config
+        S, P = dconf.max_slots, self._kv.pages_per_slot
+        table0 = jnp.zeros((P,), jnp.int32)
+        key = None
+        if self._rng is not None:
+            self._rng, key = jax.random.split(self._rng)
+        _, self._k_pages, self._v_pages = self._prefill(
+            self._params, jnp.zeros((dconf.prefill_chunk,), jnp.int32),
+            jnp.int32(0), jnp.int32(0), table0,
+            self._k_pages, self._v_pages, key)
+        if self._rng is not None:
+            self._rng, key = jax.random.split(self._rng)
+        out, self._k_pages, self._v_pages = self._step(
+            self._params, jnp.zeros((S,), jnp.int32),
+            jnp.zeros((S,), jnp.int32),
+            jnp.zeros((S, P), jnp.int32),
+            self._k_pages, self._v_pages, key)
+        jax.block_until_ready(out)
+
+    def decode_step_cache_size(self) -> int:
+        """Compiled-executable count inside the jitted decode step (−1
+        when jax doesn't expose it). Flat after warmup ⇒ continuous
+        batching never triggered a recompile — the shape-stability
+        contract the acceptance test pins."""
+        return (self._step._cache_size()
+                if hasattr(self._step, "_cache_size") else -1)
+
+    def prefill_cache_size(self) -> int:
+        return (self._prefill._cache_size()
+                if hasattr(self._prefill, "_cache_size") else -1)
+
+    @property
+    def kv(self) -> PagedKVCache:
+        return self._kv
+
+    @property
+    def admission(self) -> Optional[admission_mod.AdmissionController]:
+        return self._admission
+
+    # -- admission cost ----------------------------------------------------
+
+    def _n_chunks(self, length: int) -> int:
+        return max(1, -(-length // self.decode_config.prefill_chunk))
+
+    def _request_cost(self, req) -> Optional[float]:
+        """Per-token deadline prediction for the admission controller:
+        chunks x chunk-EMA + max_new_tokens x step-EMA, plus the queued
+        work ahead priced in iterations."""
+        queued = self._queue.qsize() + len(self._pending_admit)
+        return self.cost.estimate(
+            self._n_chunks(len(req.prompt)), req.mnt,
+            queue_cost=queued)
+
+    # -- request intake ----------------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int,
+        deadline_s: Optional[float] = None,
+        timeout: Optional[float] = None,
+        tenant: Optional[str] = None,
+        cls: Optional[str] = None,
+    ) -> DecodeHandle:
+        """Enqueue one generation request. ``prompt`` is a 1-D int token
+        array; the result is a :class:`DecodeOutput` via the returned
+        handle. Admission/backpressure semantics mirror
+        :meth:`~paddle_tpu.serving.engine.ServingEngine.submit`."""
+        if self._closed:
+            raise EngineClosedError("engine is closed")
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        dconf = self.decode_config
+        enforce(prompt.size >= 1, "prompt must be non-empty")
+        enforce(max_new_tokens >= 1,
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        enforce(
+            int(prompt.size) + max_new_tokens <= dconf.max_context,
+            f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds max_context ({dconf.max_context})")
+        now = time.monotonic()
+        if deadline_s is None:
+            deadline_s = self.config.default_deadline_s
+        if deadline_s is not None and deadline_s <= 0:
+            self.metrics.record_timeout()
+            raise DeadlineExceeded(
+                f"deadline {deadline_s}s already expired at submit")
+        deadline = None if deadline_s is None else now + deadline_s
+        tname = tenant if tenant is not None else self._default_tenant
+        tcfg = self._tenants.get(tname)
+        rcls = cls if cls is not None else (
+            tcfg.default_class if tcfg is not None
+            else cfg_mod.flags().tenant_default_class)
+        enforce(rcls in sched_mod.CLASSES,
+                f"unknown priority class {rcls!r} "
+                f"(expected one of {sched_mod.CLASSES})")
+        if self._admission is None:
+            enforce(tcfg is not None,
+                    f"unknown tenant {tname!r} "
+                    f"(configured: {sorted(self._tenants)})")
+        req = _DecodeRequest(prompt, int(max_new_tokens),
+                             self._n_chunks(int(prompt.size)),
+                             deadline, now, tenant=tname, cls=rcls)
+        if tracing.tracing_enabled():
+            req.trace = tracing.SpanContext.new_trace()
+            req.handle.trace = req.trace
+            req.t_enqueue_pc = time.perf_counter()
+        try:
+            if self._admission is not None:
+                self._admission.admit(req)
+            else:
+                self._queue.send(req, timeout=timeout)
+        except ChannelClosedError:
+            raise EngineClosedError("engine is closed") from None
+        except AdmissionRejected:
+            if req.trace is not None:
+                self._finish_trace(req, time.perf_counter(), status="shed")
+            raise
+        self.metrics.record_submit()
+        return req.handle
+
+    def infer(self, prompt, max_new_tokens: int, **kwargs) -> DecodeOutput:
+        """Synchronous decode: submit + wait."""
+        return self.submit(prompt, max_new_tokens, **kwargs).result()
+
+    # -- completion paths (loop thread, except _expire) --------------------
+
+    def _finish_trace(self, req: _DecodeRequest, t1_pc: float,
+                      **attrs) -> None:
+        if req.trace is None:
+            return
+        tracing.record_span(
+            "serving.decode.request", req.t_enqueue_pc, t1_pc,
+            context=req.trace, engine=self.metrics.engine_label,
+            tenant=req.tenant, cls=req.cls,
+            generated=len(req.generated), **attrs)
+
+    def _expire(self, req: _DecodeRequest) -> None:
+        """Deadline lapsed while queued (scheduler callback) or mid-
+        generation (loop check)."""
+        self.metrics.record_timeout()
+        self.metrics.record_evict("deadline")
+        self._finish_trace(req, time.perf_counter(),
+                           status="deadline_exceeded")
+        req.handle._fail(DeadlineExceeded(
+            f"request expired after "
+            f"{time.monotonic() - req.t_submit:.3f}s "
+            f"({len(req.generated)}/{req.mnt} tokens generated)"))
+
+    def _release(self, req: _DecodeRequest) -> None:
+        if req.slot is not None:
+            self._kv.release_slot(req.slot)
+            req.slot = None
+        if req in self._active:
+            self._active.remove(req)
+
+    def _finish(self, req: _DecodeRequest, reason: str) -> None:
+        self._release(req)
+        self.metrics.record_evict(reason)
+        if reason == "cancelled":
+            self.metrics.record_cancel()
+        latency = time.monotonic() - req.t_submit
+        self.metrics.record_response(latency)
+        self._finish_trace(req, time.perf_counter(), status=reason)
+        runlog.emit("decode_evict", reason=reason, tenant=req.tenant,
+                    generated=len(req.generated),
+                    engine=self.metrics.engine_label)
+        req.handle._complete(DecodeOutput(
+            tokens=np.asarray(req.generated, dtype=np.int32),
+            finish_reason=reason,
+            prompt_len=int(req.prompt.size),
+            n_preemptions=req.n_preemptions))
+
+    def _fail(self, req: _DecodeRequest, exc: BaseException) -> None:
+        self._release(req)
+        self.metrics.record_error()
+        self.metrics.record_evict("error")
+        self._finish_trace(req, time.perf_counter(), status="error",
+                           error=type(exc).__name__)
+        req.handle._fail(exc)
+
+    # -- the decode loop ---------------------------------------------------
+
+    def _loop(self) -> None:
+        try:
+            self._loop_body()
+        except BaseException as e:  # fail everything rather than hang
+            ptlog.error("decode loop died: %r", e)
+            for req in list(self._active) + list(self._resume) + \
+                    list(self._pending_admit):
+                try:
+                    self._fail(req, RuntimeError(f"decode loop died: {e!r}"))
+                except Exception:
+                    pass
+            raise
+
+    def _loop_body(self) -> None:
+        dconf = self.decode_config
+        while True:
+            self._sweep_cancel_deadline()
+            self._admit()
+            t0 = time.perf_counter()
+            did_prefill = self._prefill_some()
+            did_step = self._decode_step()
+            if did_prefill or did_step:
+                self.metrics.set_pages(self._kv.pages_in_use,
+                                       self._kv.pages_free)
+                self.metrics.set_active_slots(len(self._active))
+                if self._loop_trace is not None:
+                    tracing.record_span(
+                        "serving.decode.step", t0, time.perf_counter(),
+                        parent=self._loop_trace,
+                        active=len(self._active))
+                continue
+            # idle: nothing to prefill or step — wait for work or drain out
+            if self._active or self._resume or self._pending_admit:
+                continue
+            try:
+                req, ok = self._queue.recv(timeout=dconf.idle_poll_s)
+            except TimeoutError:
+                continue
+            if not ok:
+                break  # closed AND drained, nothing in flight
+            self._pending_admit.append(req)
+        self.metrics.set_active_slots(0)
+        self.metrics.set_pages(self._kv.pages_in_use, self._kv.pages_free)
+
+    def _sweep_cancel_deadline(self) -> None:
+        now = time.monotonic()
+        for req in list(self._active):
+            if req.cancelled:
+                self._finish(req, "cancelled")
+            elif req.deadline is not None and now > req.deadline:
+                self._release(req)
+                self._expire(req)
+        for pool in (self._resume, self._pending_admit):
+            for req in list(pool):
+                if req.cancelled:
+                    pool.remove(req)
+                    self._finish(req, "cancelled")
+                elif req.deadline is not None and now > req.deadline:
+                    pool.remove(req)
+                    self._expire(req)
+
+    def _admit(self) -> None:
+        """Fill free slots: preempted requests first (front of line), then
+        parked arrivals, then fresh pops from the scheduler. A request
+        that cannot get a slot parks; pages are granted lazily at
+        prefill/step time."""
+        while len(self._active) < self.decode_config.max_slots:
+            resumed = False
+            if self._resume:
+                req = self._resume.popleft()
+                resumed = True
+            elif self._pending_admit:
+                req = self._pending_admit.popleft()
+            else:
+                try:
+                    req, ok = self._queue.recv(timeout=0)
+                except TimeoutError:
+                    return
+                if not ok:
+                    return  # closed and drained
+            if req.cancelled:
+                self._finish(req, "cancelled")
+                continue
+            slot = self._kv.acquire_slot()
+            if slot is None:  # raced vs max_slots accounting; park
+                (self._resume if resumed
+                 else self._pending_admit).appendleft(req)
+                return
+            req.slot = slot
+            req.phase = "prefill"
+            req.seq = (np.concatenate([req.prompt,
+                                       np.asarray(req.generated, np.int32)])
+                       if req.generated else req.prompt)
+            req.chunks_done = 0
+            req.t_admit_pc = time.perf_counter()
+            self._active.append(req)
+            if resumed:
+                self.metrics.record_resume()
+                runlog.emit("decode_resume", tenant=req.tenant,
+                            generated=len(req.generated),
+                            engine=self.metrics.engine_label)
+            else:
+                self.metrics.record_slot_admit()
+                runlog.emit("decode_admit", tenant=req.tenant,
+                            prompt_len=int(req.prompt.size), mnt=req.mnt,
+                            engine=self.metrics.engine_label)
+                if req.trace is not None:
+                    tracing.record_span(
+                        "serving.decode.queue_wait", req.t_enqueue_pc,
+                        req.t_admit_pc, parent=req.trace)
+
+    def _ensure_pages(self, req: _DecodeRequest, n_positions: int) -> bool:
+        """Grow ``req``'s slot to ``n_positions``, preempting the most
+        recently admitted OTHER request (LIFO) while the pool is short.
+        The kv-cache deadlock guard guarantees a lone request can always
+        grow to max_context, so this terminates."""
+        while not self._kv.ensure_capacity(req.slot, n_positions):
+            victim = next((r for r in reversed(self._active) if r is not req),
+                          None)
+            if victim is None:  # unreachable per the pool-size guard
+                self._fail(req, RuntimeError(
+                    "page pool exhausted with no preemption victim"))
+                return False
+            self._preempt(victim)
+        return True
+
+    def _preempt(self, victim: _DecodeRequest) -> None:
+        """Evict ``victim`` on page exhaustion, keeping its generated
+        prefix: it re-enters at the front of the line and re-prefills
+        ``prompt + generated`` — greedy decode continues identically."""
+        freed = self._kv.slot_page_count(victim.slot)
+        self._release(victim)
+        victim.phase = "queued"
+        victim.seq = None
+        victim.chunks_done = 0
+        victim.cur_len = 0
+        victim.n_preemptions += 1
+        self._resume.append(victim)
+        self.metrics.record_preempt()
+        runlog.emit("decode_preempt", tenant=victim.tenant,
+                    generated=len(victim.generated), pages_freed=freed,
+                    engine=self.metrics.engine_label)
+
+    def _append_token(self, req: _DecodeRequest, tok: int) -> None:
+        """Host-side finish checks for one sampled token."""
+        req.generated.append(tok)
+        eos = self.decode_config.eos_id
+        if eos is not None and tok == eos:
+            self._finish(req, "eos")
+        elif len(req.generated) >= req.mnt:
+            self._finish(req, "length")
+        else:
+            req.last_tok = tok
+
+    def _next_key(self):
+        if self._rng is None:
+            return None
+        self._rng, key = jax.random.split(self._rng)
+        return key
+
+    def _prefill_some(self) -> bool:
+        """Run up to ``prefill_chunks_per_iter`` chunks across prefill-
+        phase requests (oldest first)."""
+        import jax.numpy as jnp
+
+        dconf = self.decode_config
+        budget = dconf.prefill_chunks_per_iter
+        progressed = False
+        for req in list(self._active):
+            if budget <= 0:
+                break
+            if req.phase != "prefill":
+                continue
+            C = dconf.prefill_chunk
+            c = req.chunks_done
+            n_chunks = self._n_chunks(len(req.seq))
+            chunk_end = (c + 1) * C
+            if not self._ensure_pages(req, min(chunk_end, len(req.seq))):
+                continue
+            chunk = np.zeros((C,), np.int32)
+            seg = req.seq[c * C:min((c + 1) * C, len(req.seq))]
+            chunk[:len(seg)] = seg
+            last = len(req.seq) - 1 - c * C
+            t0 = time.perf_counter()
+            try:
+                tok, self._k_pages, self._v_pages = self._prefill(
+                    self._params, jnp.asarray(chunk),
+                    jnp.int32(c * C), jnp.int32(max(last, 0)),
+                    jnp.asarray(self._kv.page_tables[req.slot]),
+                    self._k_pages, self._v_pages, self._next_key())
+                last_chunk = (c == n_chunks - 1)
+                tok = int(tok) if last_chunk else 0
+            except Exception as e:
+                self._fail(req, e)
+                continue
+            t1 = time.perf_counter()
+            self.metrics.record_prefill_chunk(t1 - t0)
+            self.cost.observe_chunk(t1 - t0)
+            if req.trace is not None:
+                tracing.record_span("serving.decode.prefill", t0, t1,
+                                    parent=req.trace, chunk=c)
+            req.chunks_done = c + 1
+            self._kv.seq_lens[req.slot] = min(chunk_end, len(req.seq))
+            budget -= 1
+            progressed = True
+            if last_chunk:
+                req.phase = "decode"
+                req.cur_len = len(req.seq)
+                # the final chunk's sample IS the next token after the
+                # prefilled sequence — the first (or, after a resume, the
+                # next) generated token
+                self._append_token(req, tok)
+        return progressed
+
+    def _decode_step(self) -> bool:
+        """One jitted iteration over every decode-phase slot. Slots that
+        are inactive or mid-prefill get a scratch table row and position
+        0, so their garbage writes land on the scratch page and their
+        outputs are ignored — no per-slot branching inside the step."""
+        import jax.numpy as jnp
+
+        decoding = [r for r in self._active if r.phase == "decode"]
+        if not decoding:
+            return False
+        for req in list(decoding):
+            if req not in self._active:
+                # preempted as the victim of an earlier grow this iteration
+                decoding.remove(req)
+                continue
+            if not self._ensure_pages(req, req.cur_len + 1):
+                decoding.remove(req)
+        # a later grow can also preempt an already-checked request
+        decoding = [r for r in decoding if r in self._active]
+        if not decoding:
+            return False
+        S = self.decode_config.max_slots
+        P = self._kv.pages_per_slot
+        tokens = np.zeros((S,), np.int32)
+        positions = np.zeros((S,), np.int32)
+        tables = np.full((S, P), SCRATCH_PAGE, np.int32)
+        for req in decoding:
+            tokens[req.slot] = req.last_tok
+            positions[req.slot] = req.cur_len
+            tables[req.slot] = self._kv.page_tables[req.slot]
+        t0 = time.perf_counter()
+        try:
+            faults.inject(faults.DECODE_STEP,
+                          engine=self.metrics.engine_label)
+            nxt, self._k_pages, self._v_pages = self._step(
+                self._params, jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(tables), self._k_pages, self._v_pages,
+                self._next_key())
+            nxt = np.asarray(nxt)
+        except Exception as e:
+            # a failed step loses this iteration's K/V writes for every
+            # in-flight sequence; fail them all, keep the loop serving
+            runlog.emit("decode_step_error", error=repr(e),
+                        engine=self.metrics.engine_label)
+            ptlog.error("decode step failed: %r", e)
+            for req in list(self._active):
+                self._fail(req, e)
+            return True
+        t1 = time.perf_counter()
+        self.metrics.record_step(len(decoding), S, t1 - t0, len(decoding))
+        self.cost.observe_step(t1 - t0)
+        for req in list(decoding):
+            req.cur_len += 1
+            self._kv.seq_lens[req.slot] = req.cur_len
+            self._append_token(req, int(nxt[req.slot]))
+        return True
+
+    # -- shutdown ----------------------------------------------------------
+
+    def close(self, timeout: Optional[float] = None) -> List[str]:
+        """Graceful drain: stop intake, run every accepted request to
+        completion, then stop the loop. Returns unjoined thread names
+        (empty = clean)."""
+        with self._close_lock:
+            if self._closed:
+                return []
+            self._closed = True
+        self._queue.close()
+        self._thread.join(timeout)
+        unjoined = [self._thread.name] if self._thread.is_alive() else []
+        if unjoined:
+            ptlog.error("DecodeEngine.close: loop failed to join within %s",
+                        timeout)
+        if self._admission is not None:
+            admission_mod.uninstall(self._admission)
+        return unjoined
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def __enter__(self) -> "DecodeEngine":
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> bool:
+        self.close()
+        return False

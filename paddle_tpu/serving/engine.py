@@ -156,6 +156,11 @@ class ServingConfig:
     # client retry storms cannot amplify overload
     retry_budget_per_s: float = 8.0
     retry_budget_burst: float = 16.0
+    # -- autoregressive decode (serving.decode.DecodeEngine) ---------------
+    # KV-cache dtype for decode engines built over this config (e.g.
+    # jnp.bfloat16 halves decode HBM traffic — the same lever generate()'s
+    # cache_dtype exposes); None = f32. The static-batch path ignores it.
+    cache_dtype: Optional[Any] = None
 
 
 class PendingResult:

@@ -1,0 +1,203 @@
+"""Paged KV cache: fixed-size pages + per-sequence page tables, so the
+decode step's shapes never depend on which requests are in flight.
+
+The static cache in :func:`models.transformer_lm.generate` allocates
+``[L, B, H_kv, Tp + max_new_tokens, dh]`` per *batch*: every sequence in
+the batch owns a contiguous region sized for the worst case, and the
+jitted program is specialized to ``(B, T_max)`` — admitting a request
+with a different prompt length or budget means a new executable. That is
+the wrong shape discipline for continuous batching, where the set of
+in-flight sequences changes every iteration.
+
+Here HBM is carved into ``num_pages`` fixed ``page_size``-token pages
+(``k_pages``/``v_pages``: ``[L, num_pages, H_kv, page_size, dh]``), and
+each of ``max_slots`` sequence slots holds a page *table* — an int32 row
+of physical page ids, one per logical page. The jitted decode step takes
+``(tokens [S], positions [S], page_tables [S, P], k_pages, v_pages)``:
+every shape is a function of static config only, so XLA compiles the
+step ONCE and admission/eviction between steps never recompiles.
+Attention gathers a sequence's pages through its table row and masks
+positions ``> seq_len``; writes scatter one token's K/V into
+``table[pos // page_size]`` at offset ``pos % page_size``.
+
+Page 0 is reserved scratch: inactive slots point their whole table at it
+and their (garbage) writes land there harmlessly, so the step needs no
+per-slot branching. The allocator hands out pages ``1..num_pages-1``
+from a free list; :meth:`PageAllocator.assert_empty` is the no-leak
+invariant the drain tests pin.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+
+__all__ = ["PageAllocator", "PagedKVCache", "SCRATCH_PAGE"]
+
+# physical page 0: never allocated; inactive slots write/read it
+SCRATCH_PAGE = 0
+
+
+class PageAllocator:
+    """Free-list allocator over the physical page pool (host-side, not
+    thread-safe — the decode loop is the only caller). Allocation is
+    all-or-nothing: ``alloc(n)`` returns ``n`` page ids or ``None``
+    without splitting, so a failed grow never leaks a partial grant."""
+
+    def __init__(self, num_pages: int):
+        enforce(num_pages >= 2,
+                f"need >= 2 pages (page {SCRATCH_PAGE} is reserved scratch), "
+                f"got {num_pages}")
+        self.num_pages = int(num_pages)
+        # LIFO free list: recently-freed (cache-warm) pages are reused first
+        self._free: List[int] = list(range(num_pages - 1, SCRATCH_PAGE, -1))
+        self._allocated = [False] * num_pages
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` distinct page ids, or None if fewer than ``n`` are free."""
+        enforce(n >= 0, f"alloc: n must be >= 0, got {n}")
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self._allocated[p] = True
+        return out
+
+    def free(self, pages: Sequence[int]) -> None:
+        """Return pages to the pool. Double-free and scratch-free are
+        programming errors and raise (a silently-tolerated double free
+        would hand one physical page to two sequences later)."""
+        for p in pages:
+            enforce(SCRATCH_PAGE < p < self.num_pages,
+                    f"free: page id {p} out of range")
+            enforce(self._allocated[p], f"free: page {p} is not allocated "
+                    "(double free?)")
+            self._allocated[p] = False
+            self._free.append(p)
+
+    def assert_empty(self) -> None:
+        """The no-leak invariant: after a full drain every page is back in
+        the free list."""
+        leaked = [i for i, a in enumerate(self._allocated) if a]
+        enforce(not leaked,
+                f"page leak after drain: {len(leaked)} page(s) still "
+                f"allocated: {leaked[:8]}")
+
+
+class PagedKVCache:
+    """Host-side bookkeeping for the paged cache: slot lifecycle, page
+    tables, and sequence lengths. The device arrays themselves
+    (``k_pages``/``v_pages``) are created and threaded through the jitted
+    step by the engine — this class only decides *which* physical pages
+    each slot's logical positions map to.
+
+    A slot's logical capacity is ``pages_per_slot * page_size`` tokens
+    (``pages_per_slot`` is the static page-table width ``P``). Pages are
+    granted lazily by :meth:`ensure_capacity` as the sequence grows, so a
+    short request never reserves worst-case HBM.
+    """
+
+    def __init__(self, *, max_slots: int, page_size: int, num_pages: int,
+                 pages_per_slot: int):
+        enforce(max_slots >= 1, f"max_slots must be >= 1, got {max_slots}")
+        enforce(page_size >= 1, f"page_size must be >= 1, got {page_size}")
+        enforce(pages_per_slot >= 1,
+                f"pages_per_slot must be >= 1, got {pages_per_slot}")
+        # one fully-grown sequence must always fit, else a lone request
+        # could deadlock against an exhausted pool with nothing to preempt
+        enforce(num_pages - 1 >= pages_per_slot,
+                f"num_pages ({num_pages}) must exceed pages_per_slot "
+                f"({pages_per_slot}): one max-length sequence has to fit "
+                "even with every other slot evicted")
+        self.max_slots = int(max_slots)
+        self.page_size = int(page_size)
+        self.pages_per_slot = int(pages_per_slot)
+        self.max_context = self.pages_per_slot * self.page_size
+        self.allocator = PageAllocator(num_pages)
+        self.page_tables = np.full((max_slots, pages_per_slot), SCRATCH_PAGE,
+                                   dtype=np.int32)
+        self.seq_lens = np.zeros((max_slots,), dtype=np.int32)
+        self._slot_pages: List[List[int]] = [[] for _ in range(max_slots)]
+        self._active = [False] * max_slots
+
+    # -- slot lifecycle ----------------------------------------------------
+
+    def acquire_slot(self) -> Optional[int]:
+        """Claim a free slot (None when all are occupied)."""
+        for s in range(self.max_slots):
+            if not self._active[s]:
+                self._active[s] = True
+                self.seq_lens[s] = 0
+                return s
+        return None
+
+    def release_slot(self, slot: int) -> int:
+        """Free the slot's pages and point its table back at scratch.
+        Returns the number of pages released."""
+        enforce(self._active[slot], f"release_slot: slot {slot} not active")
+        pages = self._slot_pages[slot]
+        n = len(pages)
+        self.allocator.free(pages)
+        self._slot_pages[slot] = []
+        self.page_tables[slot, :] = SCRATCH_PAGE
+        self.seq_lens[slot] = 0
+        self._active[slot] = False
+        return n
+
+    def ensure_capacity(self, slot: int, n_positions: int) -> bool:
+        """Grow ``slot`` to cover logical positions ``[0, n_positions)``.
+        All-or-nothing: returns False (state unchanged) when the pool
+        cannot supply the missing pages — the engine's preempt-or-queue
+        decision point."""
+        enforce(self._active[slot], f"ensure_capacity: slot {slot} not active")
+        enforce(
+            n_positions <= self.max_context,
+            f"sequence needs {n_positions} positions but the slot capacity "
+            f"is {self.max_context} (pages_per_slot * page_size)")
+        have = len(self._slot_pages[slot])
+        need = -(-n_positions // self.page_size) - have  # ceil div
+        if need <= 0:
+            return True
+        grant = self.allocator.alloc(need)
+        if grant is None:
+            return False
+        for i, p in enumerate(grant):
+            self.page_tables[slot, have + i] = p
+        self._slot_pages[slot].extend(grant)
+        return True
+
+    # -- readout -----------------------------------------------------------
+
+    def active_slots(self) -> List[int]:
+        return [s for s in range(self.max_slots) if self._active[s]]
+
+    def slot_page_count(self, slot: int) -> int:
+        return len(self._slot_pages[slot])
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.allocator.in_use
+
+    @property
+    def pages_free(self) -> int:
+        return self.allocator.num_free
+
+    def assert_no_leaks(self) -> None:
+        """Drain invariant: no active slots and every page back in the
+        free list (slot bookkeeping and allocator must agree)."""
+        enforce(not any(self._active),
+                f"active slots after drain: {self.active_slots()}")
+        enforce(sum(len(p) for p in self._slot_pages) == 0,
+                "slot page lists non-empty after drain")
+        self.allocator.assert_empty()

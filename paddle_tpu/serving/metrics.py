@@ -25,7 +25,7 @@ from typing import Dict, Optional
 from paddle_tpu.core import profiler as prof
 from paddle_tpu.observability import metrics as obs_metrics
 
-__all__ = ["ServingMetrics"]
+__all__ = ["ServingMetrics", "DecodeMetrics"]
 
 # distinct default engine labels for every engine built in this process
 _ENGINE_SEQ = itertools.count()
@@ -296,3 +296,208 @@ class ServingMetrics:
         snap["p50_ms"] = _percentile(vals, 50) * 1e3
         snap["p99_ms"] = _percentile(vals, 99) * 1e3
         return snap
+
+
+class DecodeMetrics:
+    """Counters/gauges for one continuous-batching decode engine
+    (``serving.decode.DecodeEngine``) under ``serving.decode.*`` families.
+    Same registry/labeling idiom as :class:`ServingMetrics`: each engine
+    gets an ``engine`` label, histograms register up front, ``snapshot``
+    returns a plain dict for tests and the bench CLI."""
+
+    def __init__(self, engine_label: Optional[str] = None):
+        self._lock = threading.Lock()
+        self.engine_label = engine_label or f"decode{next(_ENGINE_SEQ)}"
+        self._labels = {"engine": self.engine_label}
+        reg = obs_metrics.default_registry()
+        reg.histogram(
+            "serving.decode.step_seconds",
+            help="Wall time of one jitted decode iteration (all slots).",
+            buckets=_LATENCY_BUCKETS)
+        reg.histogram(
+            "serving.decode.prefill_chunk_seconds",
+            help="Wall time of one prefill chunk.",
+            buckets=_LATENCY_BUCKETS)
+        reg.histogram(
+            "serving.decode.batch_occupancy",
+            help="Active slots / max slots per decode iteration.",
+            buckets=obs_metrics.linear_buckets(0.1, 0.1, 10))
+        reg.histogram(
+            "serving.decode.request_latency_seconds",
+            help="End-to-end decode request latency (submit to last token).",
+            buckets=_LATENCY_BUCKETS)
+        self.requests_total = 0
+        self.responses_total = 0
+        self.tokens_total = 0          # generated tokens across all requests
+        self.prefill_chunks_total = 0
+        self.steps_total = 0           # decode iterations run
+        self.admitted_total = 0        # requests that got a slot
+        self.evicted_total = 0         # finished/cancelled slots released
+        self.preempted_total = 0       # evicted on page exhaustion, resumable
+        self.resumed_total = 0         # preempted requests re-admitted
+        self.cancelled_total = 0
+        self.timeouts_total = 0
+        self.errors_total = 0
+        # tenant-quota admission accounting (serving.tenant.* families)
+        self._tenant_admitted: collections.Counter = collections.Counter()
+        self._tenant_shed: collections.Counter = collections.Counter()
+
+    def record_submit(self) -> None:
+        with self._lock:
+            self.requests_total += 1
+        prof.inc_counter("serving.decode.requests_total", labels=self._labels)
+
+    def record_slot_admit(self) -> None:
+        """A request got a decode slot (iteration-level admission; distinct
+        from :meth:`record_admit`, the tenant-quota admission below)."""
+        with self._lock:
+            self.admitted_total += 1
+        prof.inc_counter("serving.decode.admitted_total", labels=self._labels)
+
+    # -- multi-tenant admission interface (the AdmissionController talks to
+    # whichever engine's metrics object it was built with; same contract as
+    # ServingMetrics' serving.tenant.* family) ------------------------------
+
+    def record_admit(self, tenant: str, cls: str) -> None:
+        with self._lock:
+            self._tenant_admitted[(tenant, cls)] += 1
+        prof.inc_counter("serving.tenant.admitted_total",
+                         labels={**self._labels, "tenant": tenant,
+                                 "cls": cls})
+
+    def record_shed(self, tenant: str, cls: str, reason: str) -> None:
+        with self._lock:
+            self._tenant_shed[(tenant, cls, reason)] += 1
+        prof.inc_counter("serving.tenant.shed_total",
+                         labels={**self._labels, "tenant": tenant,
+                                 "cls": cls, "reason": reason})
+
+    def record_tenant_response(self, tenant: str, cls: str,
+                               latency_s: float) -> None:
+        prof.observe("serving.tenant.request_latency_seconds", latency_s,
+                     labels={**self._labels, "tenant": tenant, "cls": cls})
+
+    def set_tenant_depths(self, depths: Dict[str, dict]) -> None:
+        for tenant, d in depths.items():
+            for cls, depth in d.items():
+                if cls == "bytes":
+                    prof.set_gauge(
+                        "serving.tenant.queued_bytes", depth,
+                        labels={**self._labels, "tenant": tenant})
+                else:
+                    prof.set_gauge(
+                        "serving.tenant.queue_depth", depth,
+                        labels={**self._labels, "tenant": tenant,
+                                "cls": cls})
+
+    def set_brownout_level(self, level: int) -> None:
+        prof.set_gauge("serving.brownout_level", level, labels=self._labels)
+
+    def tenant_admitted(self, tenant: str) -> int:
+        with self._lock:
+            return sum(v for (t, _), v in self._tenant_admitted.items()
+                       if t == tenant)
+
+    def tenant_shed(self, tenant: str) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        with self._lock:
+            for (t, _, reason), v in self._tenant_shed.items():
+                if t == tenant:
+                    out[reason] = out.get(reason, 0) + v
+        return out
+
+    def shed_total(self) -> int:
+        with self._lock:
+            return sum(self._tenant_shed.values())
+
+    def record_evict(self, reason: str) -> None:
+        with self._lock:
+            self.evicted_total += 1
+        prof.inc_counter("serving.decode.evicted_total",
+                         labels={**self._labels, "reason": reason})
+
+    def record_preempt(self) -> None:
+        with self._lock:
+            self.preempted_total += 1
+        prof.inc_counter("serving.decode.preempted_total",
+                         labels=self._labels)
+
+    def record_resume(self) -> None:
+        with self._lock:
+            self.resumed_total += 1
+        prof.inc_counter("serving.decode.resumed_total", labels=self._labels)
+
+    def record_cancel(self) -> None:
+        with self._lock:
+            self.cancelled_total += 1
+        prof.inc_counter("serving.decode.cancelled_total",
+                         labels=self._labels)
+
+    def record_timeout(self) -> None:
+        with self._lock:
+            self.timeouts_total += 1
+        prof.inc_counter("serving.decode.timeouts_total", labels=self._labels)
+
+    def record_error(self, n: int = 1) -> None:
+        with self._lock:
+            self.errors_total += n
+        prof.inc_counter("serving.decode.errors_total", n,
+                         labels=self._labels)
+
+    def record_step(self, active: int, max_slots: int,
+                    seconds: float, new_tokens: int) -> None:
+        with self._lock:
+            self.steps_total += 1
+            self.tokens_total += new_tokens
+        prof.inc_counter("serving.decode.steps_total", labels=self._labels)
+        prof.inc_counter("serving.decode.tokens_total", new_tokens,
+                         labels=self._labels)
+        prof.observe("serving.decode.step_seconds", seconds,
+                     labels=self._labels)
+        prof.observe("serving.decode.batch_occupancy",
+                     active / max(max_slots, 1), labels=self._labels)
+
+    def record_prefill_chunk(self, seconds: float) -> None:
+        with self._lock:
+            self.prefill_chunks_total += 1
+        prof.inc_counter("serving.decode.prefill_chunks_total",
+                         labels=self._labels)
+        prof.observe("serving.decode.prefill_chunk_seconds", seconds,
+                     labels=self._labels)
+
+    def record_response(self, latency_s: float) -> None:
+        with self._lock:
+            self.responses_total += 1
+        prof.inc_counter("serving.decode.responses_total",
+                         labels=self._labels)
+        prof.observe("serving.decode.request_latency_seconds", latency_s,
+                     labels=self._labels)
+
+    def set_pages(self, in_use: int, free: int) -> None:
+        prof.set_gauge("serving.decode.pages_in_use", in_use,
+                       labels=self._labels)
+        prof.set_gauge("serving.decode.pages_free", free, labels=self._labels)
+
+    def set_active_slots(self, n: int) -> None:
+        prof.set_gauge("serving.decode.active_slots", n, labels=self._labels)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "engine": self.engine_label,
+                "requests_total": self.requests_total,
+                "responses_total": self.responses_total,
+                "tokens_total": self.tokens_total,
+                "prefill_chunks_total": self.prefill_chunks_total,
+                "steps_total": self.steps_total,
+                "admitted_total": self.admitted_total,
+                "evicted_total": self.evicted_total,
+                "preempted_total": self.preempted_total,
+                "resumed_total": self.resumed_total,
+                "cancelled_total": self.cancelled_total,
+                "timeouts_total": self.timeouts_total,
+                "errors_total": self.errors_total,
+                "mean_step_occupancy": (
+                    self.tokens_total / self.steps_total
+                    if self.steps_total else 0.0),
+            }

@@ -1,0 +1,130 @@
+"""paddle_tpu.serving.kv_cache — paged KV-cache allocator tests.
+
+The continuous-batching decode loop leans entirely on this allocator: a
+slot that cannot grow is preempted, its pages returned, and the freed
+pages must be immediately reusable by whoever preempted it.  These tests
+pin the contract: all-or-nothing allocation, LIFO reuse, double-free
+detection, page 0 reserved as the scratch page, out-of-pages growth
+reported (not raised) so the engine can evict-or-queue, and a drained
+cache holding zero pages (no leaks across acquire/grow/release cycles).
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.serving import SCRATCH_PAGE, PageAllocator, PagedKVCache
+
+
+class TestPageAllocator:
+    def test_scratch_page_reserved(self):
+        alloc = PageAllocator(8)
+        got = alloc.alloc(7)
+        assert sorted(got) == list(range(1, 8))
+        assert SCRATCH_PAGE not in got
+
+    def test_all_or_nothing(self):
+        alloc = PageAllocator(5)  # 4 usable
+        assert alloc.alloc(5) is None
+        assert alloc.num_free == 4  # failed alloc took nothing
+        got = alloc.alloc(4)
+        assert got is not None and alloc.num_free == 0
+        assert alloc.alloc(1) is None
+
+    def test_free_and_reuse(self):
+        alloc = PageAllocator(6)
+        a = alloc.alloc(3)
+        b = alloc.alloc(2)
+        alloc.free(a)
+        assert alloc.num_free == 3
+        c = alloc.alloc(3)
+        assert sorted(c) == sorted(a)  # freed pages come back
+        alloc.free(b)
+        alloc.free(c)
+        alloc.assert_empty()
+
+    def test_double_free_rejected(self):
+        alloc = PageAllocator(4)
+        a = alloc.alloc(2)
+        alloc.free(a)
+        with pytest.raises(Exception):
+            alloc.free(a)
+
+    def test_scratch_free_rejected(self):
+        alloc = PageAllocator(4)
+        with pytest.raises(Exception):
+            alloc.free([SCRATCH_PAGE])
+
+
+class TestPagedKVCache:
+    def _kv(self, **kw):
+        kw.setdefault("max_slots", 3)
+        kw.setdefault("page_size", 4)
+        kw.setdefault("pages_per_slot", 4)  # 16-token contexts
+        kw.setdefault("num_pages", 1 + 3 * 4)
+        return PagedKVCache(**kw)
+
+    def test_acquire_grow_release(self):
+        kv = self._kv()
+        slot = kv.acquire_slot()
+        assert slot is not None
+        assert kv.slot_page_count(slot) == 0
+        assert kv.ensure_capacity(slot, 1)
+        assert kv.slot_page_count(slot) == 1
+        # growing within the same page allocates nothing new
+        assert kv.ensure_capacity(slot, 4)
+        assert kv.slot_page_count(slot) == 1
+        assert kv.ensure_capacity(slot, 5)
+        assert kv.slot_page_count(slot) == 2
+        # page table rows point at real (non-scratch) pages once mapped
+        assert all(p != SCRATCH_PAGE for p in kv.page_tables[slot][:2])
+        kv.release_slot(slot)
+        kv.assert_no_leaks()
+
+    def test_out_of_pages_reports_false(self):
+        kv = self._kv(num_pages=1 + 4)  # starved: 4 usable pages total
+        s0 = kv.acquire_slot()
+        s1 = kv.acquire_slot()
+        assert kv.ensure_capacity(s0, 12)  # takes 3 pages
+        assert kv.ensure_capacity(s1, 4)   # takes the last one
+        # growth now fails softly — the engine's evict-or-queue signal
+        assert not kv.ensure_capacity(s1, 5)
+        assert kv.slot_page_count(s1) == 1  # failed grow changed nothing
+        # resume after a preemption frees pages
+        kv.release_slot(s0)
+        assert kv.ensure_capacity(s1, 12)
+        kv.release_slot(s1)
+        kv.assert_no_leaks()
+
+    def test_slot_exhaustion(self):
+        kv = self._kv(max_slots=2, num_pages=1 + 2 * 4)
+        a, b = kv.acquire_slot(), kv.acquire_slot()
+        assert a is not None and b is not None
+        assert kv.acquire_slot() is None
+        kv.release_slot(a)
+        assert kv.acquire_slot() is not None
+
+    def test_no_leak_after_churn(self):
+        rng = np.random.RandomState(0)
+        kv = self._kv()
+        live = {}
+        for _ in range(200):
+            if live and rng.rand() < 0.4:
+                slot = live.popitem()[0]
+                kv.release_slot(slot)
+            else:
+                slot = kv.acquire_slot()
+                if slot is None:
+                    continue
+                kv.ensure_capacity(slot, int(rng.randint(1, 17)))
+                live[slot] = True
+        for slot in live:
+            kv.release_slot(slot)
+        assert kv.pages_in_use == 0
+        kv.assert_no_leaks()
+
+    def test_deadlock_guard(self):
+        # a pool too small for even one full-context request is a config
+        # error (a lone request could never finish) — rejected up front
+        with pytest.raises(Exception):
+            PagedKVCache(max_slots=2, page_size=4, pages_per_slot=4,
+                         num_pages=4)

@@ -1,0 +1,189 @@
+"""paddle_tpu.serving.decode — continuous-batching decode engine tests.
+
+The acceptance contract from the continuous-batching PR: mixed-length
+requests admitted/evicted at iteration granularity produce tokens
+*exactly* equal to the static :func:`models.transformer_lm.generate`
+path, and the jitted decode step compiles ONCE — the executable-cache
+size stays flat as requests of different prompt lengths and budgets
+enter and leave.  Also covered: preempt/resume continuation under a
+starved page pool, cancel mid-generation, eos stopping, the bf16
+``cache_dtype`` plumbing, and per-token deadline prediction feeding the
+admission controller (satellite of PR 8's latency histograms).
+"""
+
+import time
+import types
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import models
+from paddle_tpu.models.transformer_lm import generate
+from paddle_tpu.serving import (
+    AdmissionRejected,
+    DecodeConfig,
+    DecodeCostModel,
+    DecodeEngine,
+    ServingConfig,
+    TenantConfig,
+)
+
+VOCAB = 97
+
+
+@pytest.fixture(scope="module")
+def lm():
+    """Tiny LM + params + greedy reference outputs for a mixed-length
+    request set sized to force page contention (the expensive part is the
+    per-(Tp, N)-shape generate() compiles, done once here)."""
+    spec = models.get_model("transformer_lm", seq_len=64, vocab=VOCAB,
+                            d_model=32, d_inner=64, num_heads=4, n_layers=2)
+    cfg = spec.extra["cfg"]
+    rng = np.random.RandomState(1)
+    variables = spec.model.init(0, *spec.synth_batch(2, rng))
+    cases = []
+    for _ in range(6):
+        tp = int(rng.randint(4, 12))
+        n = int(rng.randint(12, 24))
+        prompt = rng.randint(1, VOCAB, size=(tp,)).astype(np.int32)
+        ref = np.asarray(generate(variables, jnp.asarray(prompt[None]),
+                                  n, cfg))[0]
+        cases.append((prompt, n, ref))
+    return types.SimpleNamespace(cfg=cfg, variables=variables, cases=cases)
+
+
+@pytest.fixture(scope="module")
+def eng(lm):
+    """One warmed engine over a starved page pool (13 usable pages vs
+    ~21 needed by three fully-grown slots), shared across the tests —
+    metrics/counters only ever grow, so later tests must not assert
+    equality on totals."""
+    engine = DecodeEngine(lm.variables, lm.cfg, decode=DecodeConfig(
+        max_slots=3, page_size=4, max_context=40, prefill_chunk=8,
+        num_pages=14))
+    yield engine
+    engine.close()
+    engine.kv.assert_no_leaks()
+
+
+def test_mixed_lengths_exact_and_compile_once(lm, eng):
+    """The PR's acceptance criterion: continuous batching under slot and
+    page contention reproduces generate() token-for-token, with the step
+    executable compiled exactly once (admit/evict/preempt of requests
+    with six different (prompt_len, budget) shapes adds no entries)."""
+    assert eng.decode_step_cache_size() == 1  # warmup compile only
+    handles = [eng.submit(p, n) for p, n, _ in lm.cases]
+    outs = [h.result(timeout=300) for h in handles]
+    for (prompt, n, ref), out in zip(lm.cases, outs):
+        assert np.array_equal(out.tokens, ref), (
+            f"tokens diverged from generate() for Tp={len(prompt)} N={n}")
+        assert out.finish_reason == "length"
+        assert out.prompt_len == len(prompt)
+    snap = eng.metrics.snapshot()
+    # the pool is starved by construction, so iteration-level eviction
+    # (preempt) and resume both fired — and every resumed request above
+    # still matched the reference exactly
+    assert snap["preempted_total"] >= 1
+    assert snap["resumed_total"] == snap["preempted_total"]
+    assert eng.decode_step_cache_size() == 1
+    assert eng.prefill_cache_size() == 1
+
+
+def test_cancel_mid_generation(lm, eng):
+    h = eng.submit(np.arange(1, 6, dtype=np.int32), 30)  # 5 + 30 <= 40
+    deadline = time.monotonic() + 60
+    while len(h._req.generated) < 3:
+        assert time.monotonic() < deadline, "no tokens generated"
+        time.sleep(0.005)
+    h.cancel()
+    out = h.result(timeout=60)
+    assert out.finish_reason == "cancelled"
+    assert 0 < len(out.tokens) < 30
+
+
+def test_submit_validation(lm, eng):
+    with pytest.raises(Exception):
+        eng.submit(lm.cases[0][0], 1000)  # prompt + budget > max_context
+    with pytest.raises(Exception):
+        eng.submit(np.zeros((0,), np.int32), 4)
+
+
+def test_eos_stops_early(lm):
+    prompt, n, ref = lm.cases[0]
+    eos = int(ref[3])
+    engine = DecodeEngine(lm.variables, lm.cfg, decode=DecodeConfig(
+        max_slots=2, page_size=8, max_context=64, prefill_chunk=8,
+        eos_id=eos))
+    try:
+        out = engine.infer(prompt, n)
+        assert out.finish_reason == "eos"
+        assert np.array_equal(out.tokens, ref[:4])  # eos token included
+    finally:
+        engine.close()
+    engine.kv.assert_no_leaks()
+
+
+def test_cache_dtype_bf16(lm):
+    """Satellite: cache_dtype flows ServingConfig -> engine, and the
+    DecodeConfig override wins; decode still runs end to end on a bf16
+    cache (lower-precision KV, full-precision attention math)."""
+    engine = DecodeEngine(
+        lm.variables, lm.cfg,
+        config=ServingConfig(cache_dtype=jnp.float32),
+        decode=DecodeConfig(max_slots=2, page_size=8, max_context=64,
+                            prefill_chunk=8, cache_dtype=jnp.bfloat16))
+    try:
+        assert engine._k_pages.dtype == jnp.bfloat16
+        assert engine._v_pages.dtype == jnp.bfloat16
+        out = engine.infer(lm.cases[1][0], 8)
+        assert out.finish_reason == "length" and len(out.tokens) == 8
+    finally:
+        engine.close()
+    engine.kv.assert_no_leaks()
+
+
+def test_cost_model_math():
+    cold = DecodeCostModel()
+    assert cold.estimate(2, 10) is None  # cold -> admission falls back
+    cm = DecodeCostModel(step_s=0.01, chunk_s=0.05)
+    # 3 chunks + 20 steps + 4 queued iterations ahead
+    assert cm.estimate(3, 20, queue_cost=4) == pytest.approx(
+        3 * 0.05 + 20 * 0.01 + 4 * 0.01)
+    cm2 = DecodeCostModel(alpha=0.5, step_s=0.1)
+    cm2.observe_step(0.2)
+    assert cm2.snapshot()["step_s"] == pytest.approx(0.15)
+    # no chunk observations: chunk cost falls back to step cost
+    assert cm2.estimate(1, 1) == pytest.approx(0.15 * 2)
+
+
+def test_per_token_deadline_admission(lm):
+    """Satellite: admission predicts service latency from per-token
+    decode cost x the request's token budget (not whole-request latency
+    histograms), so an infeasible (deadline, max_new_tokens) pair is
+    shed at submit; a cold cost model admits everything."""
+    engine = DecodeEngine(
+        lm.variables, lm.cfg,
+        config=ServingConfig(admission=True, tenants=[TenantConfig("t")]),
+        decode=DecodeConfig(max_slots=2, page_size=8, max_context=512,
+                            prefill_chunk=8, warmup=False))
+    try:
+        prompt = lm.cases[0][0]
+        # the wiring itself: chunks * chunk_s + budget * step_s
+        engine.cost = DecodeCostModel(step_s=10.0, chunk_s=10.0)
+        fake = types.SimpleNamespace(prompt=prompt, mnt=30)
+        assert engine._request_cost(fake) == pytest.approx(
+            engine._n_chunks(len(prompt)) * 10.0 + 30 * 10.0)
+        # 30 tokens x 10s/token >> 1s deadline -> shed before queueing
+        with pytest.raises(AdmissionRejected) as ei:
+            engine.submit(prompt, 30, deadline_s=1.0, tenant="t")
+        assert ei.value.reason == "deadline_unmeetable"
+        # a 4-token budget under the same per-token cost is feasible
+        h = engine.submit(prompt, 4, deadline_s=3600.0, tenant="t")
+        h.cancel()
+        # cold model -> no prediction -> admit even tight deadlines
+        engine.cost = DecodeCostModel()
+        h2 = engine.submit(prompt, 30, deadline_s=3600.0, tenant="t")
+        h2.cancel()
+    finally:
+        engine.close()

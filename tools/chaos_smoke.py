@@ -19,6 +19,10 @@ actually recovered:
 - serving ejected the sick replica (circuit breaker), redispatched its
   batches, kept answering every request, and re-admitted the replica after
   the faults stopped;
+- continuous-batching decode survived a failed decode iteration (typed
+  errors for the in-flight requests, the loop kept serving), recovered
+  from page-pool exhaustion via preempt/resume, honoured a cancel
+  mid-generation, and drained with zero pages leaked;
 - under mixed-tenant overload at ~10x capacity (plus a transiently
   failing replica), admission control held the interactive p99 SLO, shed
   batch traffic via typed ``AdmissionRejected`` while batch kept its
@@ -286,6 +290,81 @@ def _serving_phase(seed: int) -> None:
         check(not unjoined, f"threads failed to join on close: {unjoined}")
 
 
+def _decode_phase(seed: int) -> None:
+    """Continuous-batching decode under chaos: one injected decode-step
+    fault must fail exactly the in-flight requests (typed errors, not
+    hangs) while the loop keeps serving; a starved page pool must force
+    preempt/resume; a cancel mid-generation must land; and after the full
+    drain the page pool must hold zero pages."""
+    from paddle_tpu import models
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import DecodeConfig, DecodeEngine
+
+    rng = np.random.RandomState(seed)
+    spec = models.get_model("transformer_lm", seq_len=64, vocab=97,
+                            d_model=32, d_inner=64, num_heads=4, n_layers=2)
+    cfg = spec.extra["cfg"]
+    variables = spec.model.init(0, *spec.synth_batch(2, rng))
+    # 13 usable pages vs ~21 needed by three grown slots: preemption certain
+    engine = DecodeEngine(variables, cfg, decode=DecodeConfig(
+        max_slots=3, page_size=4, max_context=40, prefill_chunk=8,
+        num_pages=14))
+    try:
+        def prompt():
+            return rng.randint(1, 97, size=(int(rng.randint(4, 12)),)
+                               ).astype(np.int32)
+
+        # leg 1: fail one decode iteration; its in-flight requests get the
+        # injected error, the loop itself must survive and keep serving
+        with faults.injected(
+            faults.FaultSpec(faults.DECODE_STEP, "error", after=3),
+            seed=seed,
+        ) as plan:
+            handles = [engine.submit(prompt(), 20) for _ in range(3)]
+            failed = 0
+            for h in handles:
+                try:
+                    h.result(timeout=120)
+                except OSError:
+                    failed += 1
+            check(plan.all_fired(),
+                  f"decode-step fault never fired: {plan.stats()}")
+        check(failed >= 1, "injected decode-step fault failed no request")
+
+        # leg 2: page exhaustion — mixed lengths over the starved pool;
+        # every request must still finish, via preempt/resume
+        handles = [engine.submit(prompt(), int(rng.randint(12, 24)))
+                   for _ in range(6)]
+        outs = [h.result(timeout=300) for h in handles]
+        check(all(o.finish_reason == "length" for o in outs),
+              f"requests lost after fault cleared: "
+              f"{[o.finish_reason for o in outs]}")
+        snap = engine.metrics.snapshot()
+        check(snap["preempted_total"] >= 1,
+              f"starved pool never preempted: {snap}")
+        check(snap["resumed_total"] == snap["preempted_total"],
+              f"preempted != resumed: {snap}")
+
+        # leg 3: cancel mid-generation
+        h = engine.submit(prompt(), 25)
+        deadline = time.monotonic() + 60
+        while len(h._req.generated) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        h.cancel()
+        out = h.result(timeout=60)
+        check(out.finish_reason == "cancelled",
+              f"cancel ignored: {out.finish_reason}")
+        check(engine.decode_step_cache_size() == 1,
+              "decode step recompiled under chaos traffic")
+        print(f"[chaos] decode: step_fault_failed={failed} "
+              f"preempted={snap['preempted_total']} "
+              f"resumed={snap['resumed_total']} cancel=ok")
+    finally:
+        unjoined = engine.close(timeout=30)
+        check(not unjoined, f"decode threads failed to join: {unjoined}")
+    engine.kv.assert_no_leaks()
+
+
 def _overload_phase(work: str, seed: int) -> None:
     """Mixed-tenant overload at ~10x drain capacity with a transiently
     failing replica: interactive p99 must hold its SLO, batch must shed
@@ -479,6 +558,7 @@ def main(argv=None) -> int:
         _corrupt_resume_phase(root)
         _elastic_phase(work, args.seed)
         _serving_phase(args.seed)
+        _decode_phase(args.seed)
         _overload_phase(work, args.seed)
     except ChaosFailure as e:
         print(f"[chaos] FAIL: {e}", file=sys.stderr)
