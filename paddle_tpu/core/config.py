@@ -53,6 +53,14 @@ class Flags:
     # persistent XLA compilation cache (big TPU compile-time win across
     # runs); empty = disabled. Applied at first Executor/jit use.
     compilation_cache_dir: str = ""
+    # kernel autotune store + warmup manifests (paddle_tpu.tune); empty =
+    # derived from compilation_cache_dir (<dir>/tune) when that is set
+    tune_cache_dir: str = ""
+    # consult the autotune store for Pallas kernel block configs
+    autotune: bool = False
+    # replay the persistent warmup manifest before admitting traffic
+    # (serving engines) so a restarted server never compiles under load
+    prewarm: bool = False
     # observability: Prometheus exporter bind port (-1 = disabled, 0 = pick
     # an ephemeral port; see paddle_tpu.observability.ObservabilityConfig)
     metrics_port: int = -1

@@ -55,6 +55,14 @@ class _InstrumentedCompiled:
             prof.inc_counter("executor.compiles_total")
             prof.observe("executor.compile_seconds", dt)
             runlog.emit("compile", target=self._label, seconds=round(dt, 6))
+            from paddle_tpu.tune import warmup as tune_warmup
+
+            # persist the compiled (label, signature) key so restart
+            # tooling knows what to prewarm (no-op when no manifest dir
+            # is configured; see paddle_tpu.tune.warmup)
+            tune_warmup.record_compile(
+                "executor", "executor", target=self._label,
+                signature=tune_warmup.tree_signature((args, kwargs)))
             from paddle_tpu import tracing
 
             # parents under the caller's active span (a trainer step, a

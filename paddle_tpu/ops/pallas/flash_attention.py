@@ -31,7 +31,37 @@ from jax.experimental import pallas as pl
 from paddle_tpu.core.dtypes import NEG_INF
 from paddle_tpu.core.enforce import enforce
 
-__all__ = ["flash_attention", "flash_attention_with_lse", "flash_attention_bwd_block"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_with_lse",
+    "flash_attention_bwd_block",
+    "fit_block",
+    "resolve_blocks",
+    "tuned_blocks",
+]
+
+
+def fit_block(block: int, total: int) -> int:
+    """Largest block <= ``block`` that divides ``total``, preferring
+    MXU/lane-aligned sizes (multiples of 128), then sublane-aligned ones
+    (multiples of 8). A plain ``min(block, total)`` rejects perfectly
+    servable shapes — T=192 with the 128 default used to hard-fail the
+    divisibility enforce; this fits it to 96 instead."""
+    total = int(total)
+    block = max(1, min(int(block), total))
+    if total % block == 0:
+        return block
+    best_8 = best_any = 0
+    for b in range(block, 0, -1):
+        if total % b:
+            continue
+        if b % 128 == 0:
+            return b
+        if not best_8 and b % 8 == 0:
+            best_8 = b
+        if not best_any:
+            best_any = b
+    return best_8 or best_any or 1
 
 
 def _flash_fwd_kernel(
@@ -199,6 +229,24 @@ def tuned_blocks(t_q: int, t_kv: int) -> tuple[int, int]:
     return bq, bk
 
 
+def resolve_blocks(t_q: int, t_kv: int, dtype=None, causal: bool = False,
+                   window: Optional[int] = None) -> tuple[int, int]:
+    """Default-block resolution order: autotune store (when
+    ``flags().autotune`` is on — fingerprint-checked, process-memoized,
+    counted under ``tune.cache.{hit,miss,stale}``), then the checked-in
+    :data:`_TUNED_BLOCKS` table, then 128/128."""
+    from paddle_tpu.core.config import flags
+
+    if flags().autotune:
+        from paddle_tpu.tune import autotune as _autotune
+
+        tuned = _autotune.lookup_blocks(
+            t_q, t_kv, dtype=dtype, causal=causal, window=window)
+        if tuned is not None:
+            return tuned
+    return tuned_blocks(t_q, t_kv)
+
+
 def _kvlen_rows(kv_len, B: int, H: int):
     """[B] lengths → [B*H, 1] i32 so the kernel grid's combined batch*head
     dim indexes it directly."""
@@ -225,8 +273,11 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
     t_kv = k.shape[2]
     enforce(H % h_kv == 0, f"{H} query heads not divisible by {h_kv} kv heads")
     group = H // h_kv
-    block_q = min(block_q, T)
-    block_k = min(block_k, t_kv)
+    # fit rather than reject: a requested block that doesn't divide the
+    # sequence falls back to the largest MXU-friendly divisor (T=192 with
+    # the 128 default runs at 96 instead of hard-failing)
+    block_q = fit_block(block_q, T)
+    block_k = fit_block(block_k, t_kv)
     enforce(T % block_q == 0, f"seq len {T} not divisible by block_q {block_q}")
     enforce(t_kv % block_k == 0, f"kv len {t_kv} not divisible by block_k {block_k}")
 
@@ -447,8 +498,10 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
     h_kv = k.shape[1]
     group = H // h_kv
     t_kv = k.shape[2]
-    block_q = min(block_q, T)
-    block_k = min(block_k, t_kv)
+    # same divisor-fitting fallback as _flash_fwd (the pair must agree so
+    # fwd and fused bwd run the same tiling for a given request)
+    block_q = fit_block(block_q, T)
+    block_k = fit_block(block_k, t_kv)
     enforce(T % block_q == 0, f"seq len {T} not divisible by block_q {block_q}")
     enforce(t_kv % block_k == 0, f"kv len {t_kv} not divisible by block_k {block_k}")
     n_qb = T // block_q
@@ -646,7 +699,7 @@ def flash_attention_with_lse(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if block_q is None or block_k is None:
-        tq, tk = tuned_blocks(q.shape[-2], k.shape[-2])
+        tq, tk = resolve_blocks(q.shape[-2], k.shape[-2], q.dtype, causal, window)
         block_q, block_k = block_q or tq, block_k or tk
     return _flash_fwd(
         q, k, v, causal, float(sm_scale), block_q, block_k, interpret, kv_len,
@@ -690,7 +743,7 @@ def flash_attention_bwd_block(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if block_q is None or block_k is None:
-        tq, tk = tuned_blocks(q.shape[-2], k.shape[-2])
+        tq, tk = resolve_blocks(q.shape[-2], k.shape[-2], q.dtype, causal, window)
         block_q, block_k = block_q or tq, block_k or tk
     return _flash_bwd(
         q, k, v, out, lse, g, causal, float(sm_scale), block_q, block_k,
@@ -722,14 +775,17 @@ def flash_attention(
     keys — sliding-window attention; out-of-window kv blocks are skipped
     entirely, making compute O(T * window) instead of O(T^2/2).
     ``interpret`` defaults to True off-TPU so the same code path runs under
-    the CPU test mesh. ``block_q``/``block_k`` default to the chip-measured
-    :func:`tuned_blocks` table (128/128 until a tune run populates it)."""
+    the CPU test mesh. ``block_q``/``block_k`` default through
+    :func:`resolve_blocks`: the ``paddle_tpu.tune`` autotune store when
+    ``flags().autotune`` is on, else the chip-measured
+    :func:`tuned_blocks` table, else 128/128 — always fitted to the
+    largest MXU-friendly divisor of the sequence lengths."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if block_q is None or block_k is None:
-        tq, tk = tuned_blocks(q.shape[-2], k.shape[-2])
+        tq, tk = resolve_blocks(q.shape[-2], k.shape[-2], q.dtype, causal, window)
         block_q, block_k = block_q or tq, block_k or tk
     if window is not None:
         enforce(causal, "flash_attention: window (sliding-window attention) "

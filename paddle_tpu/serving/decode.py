@@ -60,6 +60,7 @@ from paddle_tpu import observability, tracing
 from paddle_tpu.concurrency import ChannelClosedError, go
 from paddle_tpu.core import config as cfg_mod
 from paddle_tpu.core import logging as ptlog
+from paddle_tpu.core import profiler as prof
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.models.transformer_lm import (
     paged_cache_shape,
@@ -119,6 +120,10 @@ class DecodeConfig:
     cache_dtype: Optional[Any] = None
     # compile the prefill + step executables at init
     warmup: bool = True
+    # with warmup off, compile them anyway when a persisted warmup
+    # manifest (paddle_tpu.tune.warmup) says a previous process did —
+    # replayed before the scheduler loop starts; None = the `prewarm` flag
+    prewarm: Optional[bool] = None
     # idle poll interval on the scheduler when no slot is active
     idle_poll_s: float = 0.02
 
@@ -341,6 +346,9 @@ class DecodeEngine:
 
         if dconf.warmup:
             self._warmup()
+        elif (dconf.prewarm if dconf.prewarm is not None
+              else cfg_mod.flags().prewarm):
+            self.prewarm()
         self._thread = go(self._loop)
 
     # -- startup -----------------------------------------------------------
@@ -369,6 +377,55 @@ class DecodeEngine:
             jnp.zeros((S, P), jnp.int32),
             self._k_pages, self._v_pages, key)
         jax.block_until_ready(out)
+        # persist the compiled keys so a restarted engine can prewarm
+        from paddle_tpu.tune import warmup as tune_warmup
+
+        name = self._manifest_name()
+        tune_warmup.record_compile(
+            name, "prefill_chunk", save=False,
+            chunk=int(dconf.prefill_chunk), page_size=int(dconf.page_size),
+            max_context=int(dconf.max_context))
+        tune_warmup.record_compile(
+            name, "decode_step", save=False,
+            max_slots=int(S), page_size=int(dconf.page_size),
+            pages_per_slot=int(P))
+        path = tune_warmup.manifest_path(name)
+        if path:
+            try:
+                tune_warmup.get_manifest(name, path).save()
+            except Exception as e:
+                ptlog.warning("warmup manifest save failed: %s", e)
+
+    def _manifest_name(self) -> str:
+        """Manifest identity for this engine: model dims + the static
+        decode-shape knobs (a config change must not replay stale keys)."""
+        d = self.decode_config
+        mc = self.model_cfg
+        return ("decode_L{l}_D{dm}_S{s}_P{p}_C{c}".format(
+            l=mc.get("n_layers", 0), dm=mc.get("d_model", 0),
+            s=d.max_slots, p=d.page_size, c=d.prefill_chunk))
+
+    def prewarm(self) -> int:
+        """Replay the persisted warmup manifest: when a previous process
+        recorded this engine's prefill/step keys, compile them now —
+        before the scheduler loop admits traffic — so a restart with a
+        populated persistent compilation cache pays (near-)zero
+        ``compile_seconds``. The jitted step stays compile-once:
+        :meth:`decode_step_cache_size` is 1 after prewarm and stays 1
+        under traffic. Returns the number of manifest keys replayed."""
+        from paddle_tpu.tune import warmup as tune_warmup
+
+        manifest = tune_warmup.get_manifest(self._manifest_name())
+        keys = [e for e in manifest.entries()
+                if e.get("kind") in ("prefill_chunk", "decode_step")]
+        if not keys:
+            return 0
+        with prof.record_event("decode.prewarm"):
+            self._warmup()
+        prof.inc_counter("tune.prewarm.replayed_total", len(keys))
+        runlog.emit("tune", phase="prewarm", engine="decode",
+                    model=self._manifest_name(), keys=len(keys))
+        return len(keys)
 
     def decode_step_cache_size(self) -> int:
         """Compiled-executable count inside the jitted decode step (−1

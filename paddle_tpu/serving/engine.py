@@ -114,6 +114,10 @@ class ServingConfig:
     num_replicas: Optional[int] = None
     # compile every (signature, batch bucket) executable at startup
     warmup: bool = True
+    # with warmup off, replay the persisted warmup manifest (the compiled
+    # keys a previous process recorded — see paddle_tpu.tune.warmup)
+    # before admitting traffic; None = the `prewarm` flag
+    prewarm: Optional[bool] = None
     # abstract-trace the model through paddle_tpu.analysis.lint_model before
     # warm-up and log findings (never fatal); catches stale checkpoints,
     # sharding-rank mistakes and f64 leaks before paying compile time
@@ -341,6 +345,9 @@ class ServingEngine:
             self._lint_model(variables)
         if self.config.warmup:
             self._warmup()
+        elif (self.config.prewarm if self.config.prewarm is not None
+              else cfg.flags().prewarm):
+            self.prewarm()
 
         # per-tenant queues + weighted-fair drain replace the old global
         # FIFO Channel; with no tenants configured one implicit "default"
@@ -430,7 +437,11 @@ class ServingEngine:
 
     def _warmup(self) -> None:
         """AOT-compile every (signature, batch bucket) on every replica so
-        live traffic never pays XLA compile latency."""
+        live traffic never pays XLA compile latency. Every warmed key is
+        recorded into the persistent warmup manifest (paddle_tpu.tune) so
+        a restarted process can :meth:`prewarm` the same set."""
+        from paddle_tpu.tune import warmup as tune_warmup
+
         with prof.record_event("serving.warmup"):
             for sig in self.buckets.all_signatures():
                 for b in self.buckets.batch_buckets:
@@ -439,6 +450,53 @@ class ServingEngine:
                         out = rep.compiled(rep.variables, *args)
                         jax.device_get(out)  # force the compile + run
                         self.metrics.record_warmup()
+                    tune_warmup.record_compile(
+                        self.model.name, "serving", save=False,
+                        sig=[list(s) for s in sig], bucket=int(b))
+        self._save_manifest()
+
+    def _save_manifest(self) -> None:
+        from paddle_tpu.tune import warmup as tune_warmup
+
+        path = tune_warmup.manifest_path(self.model.name)
+        if path:
+            try:
+                tune_warmup.get_manifest(self.model.name, path).save()
+            except Exception as e:  # never let bookkeeping fail startup
+                ptlog.warning("warmup manifest save failed: %s", e)
+
+    def prewarm(self) -> int:
+        """Replay the persisted warmup manifest — compile every (signature,
+        bucket) key a previous process recorded — before traffic is
+        admitted. With the JAX persistent compilation cache populated each
+        replay is a disk hit, so a restarted server's ``compile_seconds``
+        collapses to near-zero. Entries that no longer match the current
+        bucket config are skipped. Returns the number of keys replayed."""
+        from paddle_tpu.tune import warmup as tune_warmup
+
+        manifest = tune_warmup.get_manifest(self.model.name)
+        valid_sigs = set(self.buckets.all_signatures())
+        valid_buckets = set(self.buckets.batch_buckets)
+        n = 0
+        with prof.record_event("serving.prewarm"):
+            for ent in manifest.entries("serving"):
+                try:
+                    sig = tuple(tuple(int(x) for x in s) for s in ent["sig"])
+                    b = int(ent["bucket"])
+                except Exception:
+                    continue
+                if sig not in valid_sigs or b not in valid_buckets:
+                    continue
+                args = self._zeros_for(sig, b)
+                for rep in self._replicas:
+                    jax.device_get(rep.compiled(rep.variables, *args))
+                    self.metrics.record_warmup()
+                n += 1
+        if n:
+            prof.inc_counter("tune.prewarm.replayed_total", n)
+            runlog.emit("tune", phase="prewarm", engine="serving",
+                        model=self.model.name, keys=n)
+        return n
 
     def aot_cache_sizes(self) -> List[int]:
         """Per-replica count of compiled executables inside the jitted

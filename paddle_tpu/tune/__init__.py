@@ -1,0 +1,65 @@
+"""paddle_tpu.tune — Pallas kernel autotuning + persistent warmup.
+
+Two cooperating pieces (ROADMAP item 3):
+
+* **Autotuner** (:mod:`autotune` + :mod:`search`): benchmark a small
+  candidate grid of kernel block configs per (shape-bucket, dtype,
+  variant, device_kind) and persist winners to an atomic, CRC-checked
+  JSON store (:mod:`store`) keyed by a *kernel fingerprint* — a hash of
+  the kernel source plus the config schema, so entries self-invalidate
+  the moment the kernel changes. ``flash_attention`` consults the store
+  at call time through a process-level memoized lookup.
+
+* **Persistent warmup manifest** (:mod:`warmup`): every compiled
+  (signature, bucket) key the Executor / serving engines see is recorded
+  into a per-model manifest next to the JAX persistent compilation cache
+  dir; on restart a ``prewarm()`` pass replays the manifest before
+  traffic is admitted, so ``compile_seconds`` collapses to the disk-cache
+  hit cost and cold-start p99 stops paying compilation.
+"""
+
+from paddle_tpu.tune.store import TuneStore, TuneKey, kernel_fingerprint
+from paddle_tpu.tune.search import (
+    candidate_blocks,
+    shape_bucket,
+    variant_tag,
+    time_fn,
+)
+from paddle_tpu.tune.autotune import (
+    autotune_flash_attention,
+    flash_fingerprint,
+    lookup_blocks,
+    reset_lookup_cache,
+    default_store_path,
+    get_store,
+)
+from paddle_tpu.tune.warmup import (
+    WarmupManifest,
+    manifest_dir,
+    manifest_path,
+    get_manifest,
+    record_compile,
+    reset_manifests,
+)
+
+__all__ = [
+    "TuneStore",
+    "TuneKey",
+    "kernel_fingerprint",
+    "candidate_blocks",
+    "shape_bucket",
+    "variant_tag",
+    "time_fn",
+    "autotune_flash_attention",
+    "flash_fingerprint",
+    "lookup_blocks",
+    "reset_lookup_cache",
+    "default_store_path",
+    "get_store",
+    "WarmupManifest",
+    "manifest_dir",
+    "manifest_path",
+    "get_manifest",
+    "record_compile",
+    "reset_manifests",
+]

@@ -1,0 +1,221 @@
+"""Persistent warmup manifest: remember what this process compiled so the
+next process can compile it *before* traffic arrives.
+
+Every compiled key the runtime sees — serving (signature, batch-bucket)
+pairs, decode-engine prefill/step programs, Executor jit signatures — is
+recorded into a per-model JSON manifest stored next to the JAX persistent
+compilation cache dir (``core/config.apply_compile_cache`` wires that).
+On restart, the engines' ``prewarm()`` passes replay the manifest before
+admitting traffic: with the persistent compilation cache populated, each
+replayed compile is a disk hit, so ``compile_seconds`` collapses to
+near-zero and cold-start p99 stops paying XLA compilation.
+
+Same durability posture as the tune store: atomic writes (tmp +
+``os.replace``), CRC-checked loads, and a corrupt manifest degrades to
+"no prewarm" with a runlog alert — never a crash.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import zlib
+from typing import Dict, List, Optional
+
+from paddle_tpu.core import config as cfg
+from paddle_tpu.core import profiler as prof
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.observability import runlog
+
+__all__ = [
+    "MANIFEST_VERSION",
+    "WarmupManifest",
+    "manifest_dir",
+    "manifest_path",
+    "get_manifest",
+    "record_compile",
+    "reset_manifests",
+    "tree_signature",
+]
+
+MANIFEST_VERSION = 1
+
+
+def manifest_dir() -> Optional[str]:
+    """Where manifests live: ``flags().tune_cache_dir``, else a
+    ``warmup/`` subdir next to the persistent compilation cache, else
+    None (recording disabled)."""
+    fl = cfg.flags()
+    if fl.tune_cache_dir:
+        return os.path.join(fl.tune_cache_dir, "warmup")
+    if fl.compilation_cache_dir:
+        return os.path.join(fl.compilation_cache_dir, "warmup")
+    return None
+
+
+def _safe_name(model: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", str(model)) or "model"
+
+
+def manifest_path(model: str, dir_: Optional[str] = None) -> Optional[str]:
+    d = dir_ or manifest_dir()
+    return os.path.join(d, f"warmup_{_safe_name(model)}.json") if d else None
+
+
+def _entries_crc(entries: List[dict]) -> int:
+    blob = json.dumps(entries, sort_keys=True, separators=(",", ":"))
+    return zlib.crc32(blob.encode("utf-8")) & 0xFFFFFFFF
+
+
+def tree_signature(tree) -> List[list]:
+    """Compact (shape, dtype) signature of a pytree of arrays — what a
+    compiled key looks like from the outside."""
+    import jax
+
+    sig = []
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None:
+            sig.append(["py", type(leaf).__name__])
+        else:
+            sig.append([list(map(int, shape)), str(dtype)])
+    return sig
+
+
+class WarmupManifest:
+    """Ordered, deduped set of compiled-key entries for one model.
+
+    Each entry is ``{"kind": <str>, ...key fields...}``. ``path=None``
+    keeps it in-memory (tests). Loads tolerate corruption; saves are
+    atomic."""
+
+    def __init__(self, model: str, path: Optional[str] = None):
+        self.model = str(model)
+        self.path = path
+        self._lock = threading.Lock()
+        self._entries: List[dict] = []
+        self._seen: set = set()
+        self.corrupt = False
+        if path and os.path.exists(path):
+            self._load()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def record(self, kind: str, **key) -> bool:
+        """Add one compiled-key entry; returns True when it was new."""
+        entry = {"kind": str(kind), **key}
+        canon = json.dumps(entry, sort_keys=True, separators=(",", ":"))
+        with self._lock:
+            if canon in self._seen:
+                return False
+            self._seen.add(canon)
+            self._entries.append(entry)
+        prof.inc_counter("tune.warmup.recorded_total")
+        return True
+
+    def entries(self, kind: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            ents = [dict(e) for e in self._entries]
+        if kind is None:
+            return ents
+        return [e for e in ents if e.get("kind") == kind]
+
+    # -- persistence -------------------------------------------------------
+
+    def save(self, path: Optional[str] = None) -> str:
+        path = path or self.path
+        enforce(path, "WarmupManifest.save needs a path")
+        with self._lock:
+            entries = [dict(e) for e in self._entries]
+        payload = {
+            "version": MANIFEST_VERSION,
+            "model": self.model,
+            "crc": _entries_crc(entries),
+            "entries": entries,
+        }
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path
+
+    def _load(self) -> None:
+        try:
+            with open(self.path) as f:
+                payload = json.load(f)
+            enforce(isinstance(payload, dict) and "entries" in payload,
+                    "malformed warmup manifest")
+            enforce(payload.get("version", 0) <= MANIFEST_VERSION,
+                    "warmup manifest from a newer build")
+            entries = payload["entries"]
+            enforce(isinstance(entries, list), "malformed manifest entries")
+            enforce(_entries_crc(entries) == payload.get("crc"),
+                    "warmup manifest CRC mismatch")
+            for ent in entries:
+                enforce(isinstance(ent, dict) and "kind" in ent,
+                        "malformed manifest entry")
+        except Exception as e:
+            prof.inc_counter("tune.warmup.corrupt_total")
+            runlog.emit("alert", source="tune.warmup", path=str(self.path),
+                        error=str(e)[:200],
+                        action="ignoring corrupt warmup manifest")
+            self.corrupt = True
+            return
+        with self._lock:
+            self._entries = [dict(e) for e in entries]
+            self._seen = {
+                json.dumps(e, sort_keys=True, separators=(",", ":"))
+                for e in self._entries
+            }
+        self.corrupt = False
+
+
+_manifest_lock = threading.Lock()
+_manifests: Dict[tuple, WarmupManifest] = {}
+
+
+def get_manifest(model: str, path: Optional[str] = None) -> WarmupManifest:
+    """Process-level manifest cache. ``path=None`` resolves through
+    :func:`manifest_path`; an unresolvable path yields an in-memory
+    manifest (recording still works, nothing persists)."""
+    path = path or manifest_path(model)
+    key = (str(model), path)
+    with _manifest_lock:
+        m = _manifests.get(key)
+        if m is None:
+            m = _manifests[key] = WarmupManifest(model, path)
+        return m
+
+
+def reset_manifests() -> None:
+    with _manifest_lock:
+        _manifests.clear()
+
+
+def record_compile(model: str, kind: str, save: bool = True, **key) -> bool:
+    """Convenience hook for the runtime: no-op (returns False) unless a
+    manifest location is configured; otherwise records + persists the
+    entry. Persistence failures are swallowed — recording a warmup key
+    must never take down the step that compiled it."""
+    path = manifest_path(model)
+    if path is None:
+        return False
+    m = get_manifest(model, path)
+    if not m.record(kind, **key):
+        return False
+    if save:
+        try:
+            m.save()
+        except Exception as e:
+            runlog.emit("alert", source="tune.warmup", path=str(path),
+                        error=str(e)[:200], action="manifest save failed")
+    return True

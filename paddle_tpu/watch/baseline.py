@@ -50,7 +50,7 @@ HIGHER_BETTER = "higher_better"
 LOWER_BETTER = "lower_better"
 INFO_ONLY = "info"
 
-_HIGHER_SUFFIXES = ("_per_sec", "_per_s", "_throughput")
+_HIGHER_SUFFIXES = ("_per_sec", "_per_s", "_throughput", "_speedup")
 _HIGHER_CONTAINS = ("_per_sec_", "_per_sec")  # e.g. decode_tok_per_sec_bs8
 _HIGHER_EXACT = ("mfu", "goodput_frac")
 _LOWER_SUFFIXES = ("_seconds", "_ms", "_s", "_latency")

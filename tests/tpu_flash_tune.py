@@ -4,11 +4,20 @@ speedups. Run opportunistically when the axon tunnel is up:
 
     python tests/tpu_flash_tune.py
 
-Writes FLASH_TUNE_TPU.json INCREMENTALLY (per measurement) so a tunnel drop
-mid-sweep keeps everything measured so far; ``best`` per T is the
-(block_q, block_k) to check into ``flash_attention.py`` defaults.
-Timing loops sync via device_get (block_until_ready returns early on the
-tunneled backend). Reference discipline: both-places perf/parity,
+The sweep itself is the in-framework autotuner
+(``paddle_tpu.tune.autotune_flash_attention``): this script only supplies
+budget checks and incremental-output plumbing, so the manual chip sweep
+and the framework tuner can never drift. Winners land BOTH in
+FLASH_TUNE_TPU.json (human artifact; ``best`` per T is what gets checked
+into ``flash_attention.py`` defaults) AND in the persistent tune store
+(``.jax_cache/tune/kernel_tune.json``) that ``flags().autotune`` serves
+at call time.
+
+Writes FLASH_TUNE_TPU.json INCREMENTALLY (per measurement) so a tunnel
+drop mid-sweep keeps everything measured so far. Timing syncs via
+device_get (block_until_ready returns early on the tunneled backend) —
+that discipline now lives in ``paddle_tpu.tune.search.time_fn``.
+Reference discipline: both-places perf/parity,
 ``python/paddle/fluid/tests/unittests/op_test.py:368``.
 """
 import json
@@ -34,14 +43,21 @@ try:
 except Exception:
     pass
 
+from paddle_tpu.core.config import set_flags  # noqa: E402
 from paddle_tpu.ops.pallas import flash_attention  # noqa: E402
 from paddle_tpu.ops.pallas.flash_attention import _reference_attention  # noqa: E402
+from paddle_tpu.tune import autotune as tune_autotune  # noqa: E402
+from paddle_tpu.tune import search as tune_search  # noqa: E402
 
 assert jax.default_backend() == "tpu", jax.default_backend()
+
+# winners also persist to the call-time tune store, next to the compile cache
+set_flags(tune_cache_dir=os.path.join(_REPO, ".jax_cache", "tune"))
 
 BUDGET_S = float(os.environ.get("PT_TUNE_BUDGET_S", "900"))
 _T0 = time.monotonic()
 OUT = {"artifact": "flash_tune", "device_kind": jax.devices()[0].device_kind,
+       "fingerprint": tune_autotune.flash_fingerprint(),
        "sweep": {}, "gqa": {}, "window": {}, "best": {}}
 ART = os.path.join(_REPO, "FLASH_TUNE_TPU.json")
 
@@ -57,19 +73,8 @@ def _write():
         f.write(json.dumps(OUT) + "\n")
 
 
-def sync(tree):
-    leaf = jax.tree_util.tree_leaves(tree)[0]
-    return float(jax.device_get(leaf.ravel()[0]))
-
-
-def time_fn(g, args, iters=10):
-    out = g(*args)
-    sync(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = g(*args)
-    sync(out)
-    return (time.perf_counter() - t0) / iters
+def time_ms(g, *args, iters=10):
+    return tune_search.time_fn(g, *args, iters=iters, warmup=1)
 
 
 for T in (1024, 4096, 8192):
@@ -77,55 +82,56 @@ for T in (1024, 4096, 8192):
         OUT["sweep"][str(T)] = {"skipped": "budget"}
         continue
     B, H, d = (4, 16, 64) if T <= 2048 else (1, 16, 64)
+    sweep = OUT["sweep"].setdefault(str(T), {})
+
     rng = np.random.RandomState(0)
     mk = lambda: jax.device_put(jnp.asarray(rng.randn(B, H, T, d).astype(np.float32)).astype(jnp.bfloat16))
     q, k, v = mk(), mk(), mk()
-    sweep = OUT["sweep"].setdefault(str(T), {})
-
     g_ref = jax.jit(jax.grad(lambda a, b, c: _reference_attention(a, b, c, True, d ** -0.5).astype(jnp.float32).sum(), (0, 1, 2)))
     try:
-        t_ref = time_fn(g_ref, (q, k, v))
-        sweep["xla_ms"] = round(t_ref * 1e3, 3)
-        print(f"T={T}: xla composed fwd+bwd {t_ref*1e3:.3f} ms")
+        t_ref = time_ms(g_ref, q, k, v)
+        sweep["xla_ms"] = round(t_ref, 3)
+        print(f"T={T}: xla composed fwd+bwd {t_ref:.3f} ms")
     except Exception as e:
         t_ref = None
         sweep["xla_error"] = f"{type(e).__name__}: {e}"[:150]
     _write()
 
-    best = None
-    for bq in (128, 256, 512):
-        for bk in (128, 256, 512):
-            if bq > T or bk > T:
-                continue
-            if _left() < 30:
-                # budget expired mid-sweep: mark it so a partial 'best' is
-                # never mistaken for a tuned default
-                sweep["partial"] = True
-                continue
-            try:
-                fn = lambda a, b, c, bq=bq, bk=bk: flash_attention(
-                    a, b, c, causal=True, block_q=bq, block_k=bk, interpret=False
-                ).astype(jnp.float32).sum()
-                g = jax.jit(jax.grad(fn, (0, 1, 2)))
-                t = time_fn(g, (q, k, v))
-                sweep[f"bq{bq}_bk{bk}_ms"] = round(t * 1e3, 3)
-                if best is None or t < best[0]:
-                    best = (t, bq, bk)
-                msg = f"T={T} bq={bq} bk={bk}: {t*1e3:.3f} ms"
-                if t_ref:
-                    msg += f"  speedup_vs_xla={t_ref/t:.2f}x"
-                print(msg)
-            except Exception as e:
-                sweep[f"bq{bq}_bk{bk}_error"] = f"{type(e).__name__}: {str(e)[:120]}"
-                print(f"T={T} bq={bq} bk={bk}: FAILED {type(e).__name__}: {str(e)[:120]}")
-            _write()
-    if best:
-        OUT["best"][str(T)] = {
-            "block_q": best[1], "block_k": best[2], "ms": round(best[0] * 1e3, 3),
-            "speedup_vs_xla": round(t_ref / best[0], 3) if t_ref else None,
-            "partial_sweep": bool(sweep.get("partial")),
-        }
+    def progress(row, sweep=sweep, T=T, t_ref=t_ref):
+        bq, bk = row["block_q"], row["block_k"]
+        if "ms" in row:
+            sweep[f"bq{bq}_bk{bk}_ms"] = row["ms"]
+            msg = f"T={T} bq={bq} bk={bk}: {row['ms']:.3f} ms"
+            if t_ref:
+                msg += f"  speedup_vs_xla={t_ref/row['ms']:.2f}x"
+            print(msg)
+        else:
+            sweep[f"bq{bq}_bk{bk}_error"] = row["error"]
+            print(f"T={T} bq={bq} bk={bk}: FAILED {row['error']}")
         _write()
+
+    res = tune_autotune.autotune_flash_attention(
+        shapes=((B, H, T, d),), causal=True, dtype=jnp.bfloat16,
+        include_bwd=True, iters=10, warmup=1, interpret=False,
+        progress=progress, should_stop=lambda: _left() < 30,
+    )
+    ((key, info),) = res.items()
+    if info["partial"]:
+        # budget expired (or a candidate failed) mid-sweep: mark it so a
+        # partial 'best' is never mistaken for a tuned default
+        sweep["partial"] = True
+    if "best" in info:
+        OUT["best"][str(T)] = {
+            "block_q": info["best"]["block_q"],
+            "block_k": info["best"]["block_k"],
+            "ms": info["best"]["ms"],
+            "speedup_vs_xla": (round(t_ref / info["best"]["ms"], 3)
+                               if t_ref else None),
+            "speedup_vs_default": info.get("speedup_vs_default"),
+            "store_key": key,
+            "partial_sweep": info["partial"],
+        }
+    _write()
 
 # ---- feature speedups: GQA and sliding window at T=8192 ----
 T, B, H, d = 8192, 1, 16, 64
@@ -138,9 +144,9 @@ k, v = mk(H), mk(H)
 t_full = None
 if _left() > 60:
     try:
-        t_full = time_fn(g_full, (q, k, v))
-        OUT["gqa"]["full_ms"] = round(t_full * 1e3, 3)
-        print(f"T={T} full-head flash fwd+bwd: {t_full*1e3:.3f} ms")
+        t_full = time_ms(g_full, q, k, v)
+        OUT["gqa"]["full_ms"] = round(t_full, 3)
+        print(f"T={T} full-head flash fwd+bwd: {t_full:.3f} ms")
     except Exception as e:
         OUT["gqa"]["full_error"] = f"{type(e).__name__}: {str(e)[:120]}"
     _write()
@@ -151,11 +157,11 @@ for hkv in (4, 1):
     kg, vg = mk(hkv), mk(hkv)
     g_gqa = jax.jit(jax.grad(lambda a, b, c: flash_attention(a, b, c, causal=True).astype(jnp.float32).sum(), (0, 1, 2)))
     try:
-        t = time_fn(g_gqa, (q, kg, vg))
-        OUT["gqa"][f"hkv{hkv}_ms"] = round(t * 1e3, 3)
+        t = time_ms(g_gqa, q, kg, vg)
+        OUT["gqa"][f"hkv{hkv}_ms"] = round(t, 3)
         if t_full:
             OUT["gqa"][f"hkv{hkv}_speedup_vs_full"] = round(t_full / t, 3)
-        print(f"T={T} GQA h_kv={hkv}: {t*1e3:.3f} ms")
+        print(f"T={T} GQA h_kv={hkv}: {t:.3f} ms")
     except Exception as e:
         OUT["gqa"][f"hkv{hkv}_error"] = f"{type(e).__name__}: {str(e)[:120]}"
     _write()
@@ -165,11 +171,11 @@ for w in (1024, 2048):
         continue
     g_win = jax.jit(jax.grad(lambda a, b, c: flash_attention(a, b, c, causal=True, window=w).astype(jnp.float32).sum(), (0, 1, 2)))
     try:
-        t = time_fn(g_win, (q, k, v))
-        OUT["window"][f"w{w}_ms"] = round(t * 1e3, 3)
+        t = time_ms(g_win, q, k, v)
+        OUT["window"][f"w{w}_ms"] = round(t, 3)
         if t_full:
             OUT["window"][f"w{w}_speedup_vs_full"] = round(t_full / t, 3)
-        print(f"T={T} window={w}: {t*1e3:.3f} ms")
+        print(f"T={T} window={w}: {t:.3f} ms")
     except Exception as e:
         OUT["window"][f"w{w}_error"] = f"{type(e).__name__}: {str(e)[:120]}"
     _write()

@@ -128,6 +128,45 @@ def _serving_phase(seed: int) -> list:
     return trace_ids
 
 
+def _tune_phase(work: str) -> None:
+    """Call-time kernel-tune lookups: one miss against an empty store, one
+    hit against a persisted winner — populating the ``tune.cache.*``
+    counter families the scrape phase asserts on ``/metrics``."""
+    import importlib
+
+    import paddle_tpu as pt
+    from paddle_tpu.core import profiler as prof
+    from paddle_tpu.tune import autotune as tune_autotune
+    from paddle_tpu.tune import search as tune_search
+    from paddle_tpu.tune.store import TuneKey
+
+    fa = importlib.import_module("paddle_tpu.ops.pallas.flash_attention")
+    pt.core.config.set_flags(tune_cache_dir=os.path.join(work, "tune"),
+                             autotune=True)
+    try:
+        tune_autotune.reset_lookup_cache()
+        check(fa.resolve_blocks(256, 256) == fa.tuned_blocks(256, 256),
+              "empty-store lookup must fall back to the tuned table")
+        st = tune_autotune.get_store()
+        key = TuneKey.render(
+            tune_autotune.KERNEL, tune_search.shape_bucket(256), "-",
+            tune_search.variant_tag(False), tune_autotune.device_kind())
+        st.put(key, tune_autotune.flash_fingerprint(),
+               {"block_q": 256, "block_k": 128}, ms=1.0, candidates=1)
+        st.save()
+        tune_autotune.reset_lookup_cache()
+        check(fa.resolve_blocks(256, 256) == (256, 128),
+              "persisted tune winner not served at call time")
+    finally:
+        pt.core.config.set_flags(tune_cache_dir="", autotune=False)
+        tune_autotune.reset_lookup_cache()
+    c = prof.counters()
+    check(c.get("tune.cache.miss", 0) >= 1, "tune.cache.miss never counted")
+    check(c.get("tune.cache.hit", 0) >= 1, "tune.cache.hit never counted")
+    print(f"[obs] tune: miss={c.get('tune.cache.miss', 0):.0f} "
+          f"hit={c.get('tune.cache.hit', 0):.0f}")
+
+
 def _scrape_phase() -> None:
     import paddle_tpu as pt
     from paddle_tpu.observability.exporter import parse_text_exposition
@@ -149,6 +188,8 @@ def _scrape_phase() -> None:
         ("trainer_steps_total", "counter"),
         ("serving_responses_total", "counter"),
         ("executor_compiles_total", "counter"),
+        ("tune_cache_hit", "counter"),
+        ("tune_cache_miss", "counter"),
         ("checkpoint_saves_total", "counter"),
         ("trainer_mfu", "gauge"),
         ("trainer_goodput_frac", "gauge"),
@@ -294,6 +335,7 @@ def main(argv=None) -> int:
     try:
         _train_phase(work, args.seed)
         serving_traces = _serving_phase(args.seed)
+        _tune_phase(work)
         _scrape_phase()
         _runlog_phase(work)
         _trace_phase(work, serving_traces)
