@@ -708,6 +708,10 @@ def serve_decode_main(n_requests: int = 24) -> dict:
 
     - **continuous**: ``serving.DecodeEngine`` (paged KV cache, iteration-
       level admission; a finished request's slot refills next step);
+    - **continuous + journal**: the same engine with the durable token
+      journal enabled (``decode_serve_journal_tok_per_sec``) — the delta
+      against the first leg is the zero-loss WAL overhead, gated so it
+      stays a tax and never becomes a regression;
     - **static**: the ``generate()`` path batched ``max_slots`` at a time,
       prompts padded to a 16-token bucket and every batch member running
       to the slowest member's budget — the pre-PR serving discipline.
@@ -765,6 +769,26 @@ def serve_decode_main(n_requests: int = 24) -> dict:
         eng.close()
         eng.kv.assert_no_leaks()
 
+        # -- continuous + durable journal: same traffic with the WAL on --
+        # the delta vs the leg above is the whole journaling tax (CRC +
+        # buffered append + batched fsync, all off the jitted step path)
+        import shutil
+        import tempfile
+        jdir = tempfile.mkdtemp(prefix="paddle_tpu_bench_wal_")
+        eng = DecodeEngine(variables, cfg, decode=DecodeConfig(
+            max_slots=slots, page_size=16, max_context=128,
+            prefill_chunk=16,
+            journal_path=os.path.join(jdir, "decode.wal")))
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, mnt) for p, mnt in reqs]
+        outs_j = [h.result(timeout=600) for h in handles]
+        dt_journal = time.perf_counter() - t0
+        gen_journal = sum(len(o.tokens) for o in outs_j)
+        journal_records = eng.metrics.snapshot()["journal_records_total"]
+        eng.close()
+        eng.kv.assert_no_leaks()
+        shutil.rmtree(jdir, ignore_errors=True)
+
         # -- static: generate() in admission-order batches of `slots` -----
         def bucket(n, q=16):
             return -(-n // q) * q
@@ -786,6 +810,12 @@ def serve_decode_main(n_requests: int = 24) -> dict:
         dt_static = time.perf_counter() - t0
 
         result["value"] = round(gen_cont / dt_cont, 1)
+        result["decode_serve_journal_tok_per_sec"] = round(
+            gen_journal / dt_journal, 1)
+        result["journal_overhead_pct"] = round(
+            100.0 * (1.0 - (gen_journal / dt_journal)
+                     / max(gen_cont / dt_cont, 1e-9)), 1)
+        result["journal_records_total"] = journal_records
         result["decode_serve_static_tok_per_sec"] = round(
             total_tokens / dt_static, 1)
         result["speedup_vs_static"] = round(

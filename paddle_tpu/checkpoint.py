@@ -131,6 +131,7 @@ def save_checkpoint(
     retry_call(
         write_and_publish,
         retries=2, base_delay=0.02, max_delay=0.5,
+        decorrelated=True, budget="default",
         what=f"checkpoint save (serial {serial})",
     )
     save_s = time.perf_counter() - t0

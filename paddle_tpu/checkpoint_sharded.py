@@ -159,6 +159,7 @@ def _write_publish_local(root: str, step: int, shard_data, manifest, max_num: in
     retry_call(
         write_and_publish,
         retries=2, base_delay=0.02, max_delay=0.5,
+        decorrelated=True, budget="default",
         what=f"sharded checkpoint save (step {step})",
     )
     save_s = time.perf_counter() - t0

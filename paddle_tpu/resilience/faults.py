@@ -58,9 +58,11 @@ __all__ = [
     "TRAINER_STEP",
     "SERVING_DISPATCH",
     "DECODE_STEP",
+    "DECODE_RECOVER",
     "DEVICE_LOST",
     "PREEMPT_NOTICE",
     "DeviceLostError",
+    "registered_points",
 ]
 
 # the named injection points wired into the framework
@@ -73,6 +75,10 @@ SERVING_DISPATCH = "serving.dispatch"
 # around the jitted decode step, so chaos runs can fail one iteration and
 # assert the loop keeps serving the surviving requests
 DECODE_STEP = "serving.decode.step"
+# the recovery path itself (quarantine + re-admission after a failed decode
+# iteration): failing *here* proves recovery is not a single point of
+# failure — a fault during recovery escalates to migration/journal replay
+DECODE_RECOVER = "serving.decode.recover"
 # elastic-training points (trainer step loop): a replica/device vanishing
 # mid-step, and the scheduler's advance preemption notice — both are
 # hardware/cluster events in production, injectable here so the whole
@@ -81,6 +87,23 @@ DEVICE_LOST = "device.lost"
 PREEMPT_NOTICE = "preempt.notice"
 
 _KINDS = ("error", "nan", "stall", "preempt")
+
+
+def registered_points() -> List[str]:
+    """Every named injection point wired into the framework, in
+    declaration order. ``tools/chaos_smoke.py`` uses this as its coverage
+    universe: a new point shipping without a chaos leg fails CI there."""
+    return [
+        CHECKPOINT_SAVE,
+        CHECKPOINT_LOAD,
+        READER_NEXT,
+        TRAINER_STEP,
+        SERVING_DISPATCH,
+        DECODE_STEP,
+        DECODE_RECOVER,
+        DEVICE_LOST,
+        PREEMPT_NOTICE,
+    ]
 
 
 class DeviceLostError(RuntimeError):

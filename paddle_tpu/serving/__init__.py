@@ -35,6 +35,18 @@ instead of idling until the slowest request in a static batch drains::
     eng = DecodeEngine(variables, cfg, decode=DecodeConfig(max_slots=8))
     out = eng.infer(prompt_ids, max_new_tokens=64)   # DecodeOutput
     eng.close()
+
+Zero-loss serving (``serving.recovery``) layers three safety rings over
+the decode engine — step-fault quarantine + re-admission, cross-engine
+migration behind per-engine circuit breakers (:class:`DecodeFleet`), and
+a durable request journal whose replay resumes in-flight generations
+after a process restart::
+
+    decode = DecodeConfig(journal_path="j/decode.wal")
+    fleet = DecodeFleet([DecodeEngine(v, cfg, decode=decode), ...])
+    h = fleet.submit(prompt_ids, 64)         # routed to a healthy engine
+    # after a restart over the same journal:
+    handles = resume_incomplete(new_engine, "j/decode.wal")
 """
 
 from paddle_tpu.serving.admission import (
@@ -66,6 +78,15 @@ from paddle_tpu.serving.kv_cache import (
     PagedKVCache,
 )
 from paddle_tpu.serving.metrics import DecodeMetrics, ServingMetrics
+from paddle_tpu.serving.recovery import (
+    DecodeFleet,
+    EngineUnhealthy,
+    RequestJournal,
+    RescuePacket,
+    RetriesExhausted,
+    replay_journal,
+    resume_incomplete,
+)
 from paddle_tpu.serving.scheduler import (
     BATCH,
     INTERACTIVE,
@@ -99,4 +120,11 @@ __all__ = [
     "PagedKVCache",
     "PageAllocator",
     "SCRATCH_PAGE",
+    "DecodeFleet",
+    "EngineUnhealthy",
+    "RequestJournal",
+    "RescuePacket",
+    "RetriesExhausted",
+    "replay_journal",
+    "resume_incomplete",
 ]

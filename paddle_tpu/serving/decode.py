@@ -48,6 +48,8 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import itertools
+import os
 import threading
 import time
 from collections import deque
@@ -61,6 +63,7 @@ from paddle_tpu.concurrency import ChannelClosedError, go
 from paddle_tpu.core import config as cfg_mod
 from paddle_tpu.core import logging as ptlog
 from paddle_tpu.core import profiler as prof
+from paddle_tpu.core import retry as retry_mod
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.models.transformer_lm import (
     paged_cache_shape,
@@ -69,6 +72,7 @@ from paddle_tpu.models.transformer_lm import (
 )
 from paddle_tpu.observability import runlog
 from paddle_tpu.resilience import faults
+from paddle_tpu.resilience.circuit import CircuitBreaker
 from paddle_tpu.serving import admission as admission_mod
 from paddle_tpu.serving import scheduler as sched_mod
 from paddle_tpu.serving.admission import AdmissionRejected, TenantConfig
@@ -80,6 +84,12 @@ from paddle_tpu.serving.engine import (
 )
 from paddle_tpu.serving.kv_cache import SCRATCH_PAGE, PagedKVCache
 from paddle_tpu.serving.metrics import DecodeMetrics
+from paddle_tpu.serving.recovery import (
+    EngineUnhealthy,
+    RequestJournal,
+    RescuePacket,
+    RetriesExhausted,
+)
 
 __all__ = [
     "DecodeConfig",
@@ -88,6 +98,10 @@ __all__ = [
     "DecodeHandle",
     "DecodeOutput",
 ]
+
+# request-id salt: keeps rids unique across processes sharing one journal
+# (engine labels restart from decode0 in every process)
+_RID_SALT = os.urandom(3).hex()
 
 
 @dataclasses.dataclass
@@ -126,13 +140,39 @@ class DecodeConfig:
     prewarm: Optional[bool] = None
     # idle poll interval on the scheduler when no slot is active
     idle_poll_s: float = 0.02
+    # -- zero-loss recovery (serving.recovery) ----------------------------
+    # survive decode-step faults by quarantining the poisoned iteration
+    # and re-admitting live requests through the proven resume path
+    # (False = the pre-recovery behavior: one step fault fails every
+    # in-flight request)
+    recovery: bool = True
+    # per-request quarantine budget over its LIFETIME (not reset on
+    # progress — re-prefill samples one token per cycle, so a progress
+    # reset would let a deterministic poison loop forever): past this
+    # many re-admissions the request fails with RetriesExhausted
+    recovery_retries: int = 8
+    # decorrelated-jitter backoff between faulted iterations (core.retry)
+    recovery_base_delay_s: float = 0.002
+    recovery_max_delay_s: float = 0.1
+    # consecutive faulted decode iterations before this engine declares
+    # itself unhealthy: trips its CircuitBreaker and — inside a
+    # DecodeFleet — drains live requests to a healthy engine
+    unhealthy_after: int = 3
+    breaker_cooldown_s: float = 0.25
+    breaker_max_cooldown_s: float = 5.0
+    # durable request journal (WAL): records admissions + every
+    # generated token; recovery.replay_journal()/resume_incomplete()
+    # rebuild in-flight work after a process restart. None = off.
+    journal_path: Optional[str] = None
+    journal_fsync_every: int = 16
 
 
 @dataclasses.dataclass
 class DecodeOutput:
     """One finished generation. ``tokens`` holds the generated ids
     (including ``eos_id`` when that ended it); ``finish_reason`` is
-    ``"eos"`` | ``"length"`` | ``"cancelled"``."""
+    ``"eos"`` | ``"length"`` | ``"cancelled"`` | ``"drain_timeout"``
+    (close() deadline enforced: partial tokens returned)."""
 
     tokens: np.ndarray
     finish_reason: str
@@ -158,7 +198,8 @@ class _DecodeRequest:
     __slots__ = ("prompt", "mnt", "n", "bytes", "tenant", "cls", "deadline",
                  "t_submit", "handle", "generated", "slot", "phase", "seq",
                  "chunks_done", "cur_len", "last_tok", "cancelled",
-                 "n_preemptions", "trace", "t_enqueue_pc", "t_admit_pc")
+                 "n_preemptions", "trace", "t_enqueue_pc", "t_admit_pc",
+                 "rid", "recoveries")
 
     def __init__(self, prompt: np.ndarray, mnt: int, n_chunks: int,
                  deadline: Optional[float], t_submit: float,
@@ -186,6 +227,8 @@ class _DecodeRequest:
         self.trace: Optional[tracing.SpanContext] = None
         self.t_enqueue_pc: Optional[float] = None
         self.t_admit_pc: Optional[float] = None
+        self.rid: Optional[str] = None   # journal/migration identity
+        self.recoveries = 0              # quarantine cycles survived
 
 
 class DecodeCostModel:
@@ -340,6 +383,22 @@ class DecodeEngine:
         self._pending_admit: Deque[_DecodeRequest] = deque()
         self._closed = False
         self._close_lock = threading.Lock()
+        # zero-loss recovery state (serving.recovery)
+        self._breaker = CircuitBreaker(
+            failure_threshold=dconf.unhealthy_after,
+            cooldown_s=dconf.breaker_cooldown_s,
+            max_cooldown_s=dconf.breaker_max_cooldown_s)
+        self._rescue_sink: Optional[Callable[..., int]] = None  # DecodeFleet
+        self._consec_faults = 0
+        self._recover_prev_delay = 0.0
+        self._breaker_dirty = False
+        self._journal: Optional[RequestJournal] = None
+        if dconf.journal_path:
+            self._journal = RequestJournal(
+                dconf.journal_path, fsync_every=dconf.journal_fsync_every)
+        self._rid_seq = itertools.count()
+        self._killed = False
+        self._drain_abort = False
         self._loop_trace: Optional[tracing.SpanContext] = None
         if tracing.tracing_enabled():
             self._loop_trace = tracing.SpanContext.new_trace()
@@ -510,18 +569,25 @@ class DecodeEngine:
         req = _DecodeRequest(prompt, int(max_new_tokens),
                              self._n_chunks(int(prompt.size)),
                              deadline, now, tenant=tname, cls=rcls)
+        req.rid = (f"{self.metrics.engine_label}-{_RID_SALT}-"
+                   f"{next(self._rid_seq)}")
         if tracing.tracing_enabled():
             req.trace = tracing.SpanContext.new_trace()
             req.handle.trace = req.trace
             req.t_enqueue_pc = time.perf_counter()
+        # journal BEFORE enqueue: the loop may start generating (and
+        # journaling tokens) the instant the scheduler has the request
+        self._j_admit(req)
         try:
             if self._admission is not None:
                 self._admission.admit(req)
             else:
                 self._queue.send(req, timeout=timeout)
         except ChannelClosedError:
+            self._j_fin(req, "shed")
             raise EngineClosedError("engine is closed") from None
         except AdmissionRejected:
+            self._j_fin(req, "shed")
             if req.trace is not None:
                 self._finish_trace(req, time.perf_counter(), status="shed")
             raise
@@ -531,6 +597,24 @@ class DecodeEngine:
     def infer(self, prompt, max_new_tokens: int, **kwargs) -> DecodeOutput:
         """Synchronous decode: submit + wait."""
         return self.submit(prompt, max_new_tokens, **kwargs).result()
+
+    # -- journal hooks (no-ops with journaling off) ------------------------
+
+    def _j_admit(self, req: _DecodeRequest) -> None:
+        if self._journal is not None and req.rid is not None:
+            self._journal.log_admit(req.rid, req.prompt, req.mnt,
+                                    req.generated, req.tenant, req.cls)
+            self.metrics.record_journal_records(1)
+
+    def _j_tok(self, req: _DecodeRequest, tok: int) -> None:
+        if self._journal is not None and req.rid is not None:
+            self._journal.log_token(req.rid, tok)
+            self.metrics.record_journal_records(1)
+
+    def _j_fin(self, req: _DecodeRequest, reason: str) -> None:
+        if self._journal is not None and req.rid is not None:
+            self._journal.log_finish(req.rid, reason)
+            self.metrics.record_journal_records(1)
 
     # -- completion paths (loop thread, except _expire) --------------------
 
@@ -549,6 +633,7 @@ class DecodeEngine:
         generation (loop check)."""
         self.metrics.record_timeout()
         self.metrics.record_evict("deadline")
+        self._j_fin(req, "deadline")
         self._finish_trace(req, time.perf_counter(),
                            status="deadline_exceeded")
         req.handle._fail(DeadlineExceeded(
@@ -565,6 +650,7 @@ class DecodeEngine:
 
     def _finish(self, req: _DecodeRequest, reason: str) -> None:
         self._release(req)
+        self._j_fin(req, reason)
         self.metrics.record_evict(reason)
         if reason == "cancelled":
             self.metrics.record_cancel()
@@ -582,6 +668,7 @@ class DecodeEngine:
 
     def _fail(self, req: _DecodeRequest, exc: BaseException) -> None:
         self._release(req)
+        self._j_fin(req, "error")
         self.metrics.record_error()
         self.metrics.record_evict("error")
         self._finish_trace(req, time.perf_counter(), status="error",
@@ -606,6 +693,11 @@ class DecodeEngine:
     def _loop_body(self) -> None:
         dconf = self.decode_config
         while True:
+            if self._killed:
+                return  # abrupt death: kill() resolves the handles
+            if self._drain_abort:
+                self._force_drain()
+                break
             self._sweep_cancel_deadline()
             self._admit()
             t0 = time.perf_counter()
@@ -736,6 +828,7 @@ class DecodeEngine:
     def _append_token(self, req: _DecodeRequest, tok: int) -> None:
         """Host-side finish checks for one sampled token."""
         req.generated.append(tok)
+        self._j_tok(req, tok)
         eos = self.decode_config.eos_id
         if eos is not None and tok == eos:
             self._finish(req, "eos")
@@ -783,7 +876,7 @@ class DecodeEngine:
                 last_chunk = (c == n_chunks - 1)
                 tok = int(tok) if last_chunk else 0
             except Exception as e:
-                self._fail(req, e)
+                self._recover_request(req, e)
                 continue
             t1 = time.perf_counter()
             self.metrics.record_prefill_chunk(t1 - t0)
@@ -845,7 +938,10 @@ class DecodeEngine:
             nxt = np.asarray(nxt)
         except Exception as e:
             # a failed step loses this iteration's K/V writes for every
-            # in-flight sequence; fail them all, keep the loop serving
+            # in-flight sequence
+            if self.decode_config.recovery:
+                self._recover_step_fault(e)
+                return True
             runlog.emit("decode_step_error", error=repr(e),
                         engine=self.metrics.engine_label)
             ptlog.error("decode step failed: %r", e)
@@ -853,6 +949,7 @@ class DecodeEngine:
                 self._fail(req, e)
             return True
         t1 = time.perf_counter()
+        self._note_step_ok()
         self.metrics.record_step(len(decoding), S, t1 - t0, len(decoding))
         self.cost.observe_step(t1 - t0)
         for req in list(decoding):
@@ -861,22 +958,346 @@ class DecodeEngine:
             self._append_token(req, int(nxt[req.slot]))
         return True
 
+    # -- zero-loss recovery (serving.recovery) -----------------------------
+
+    @property
+    def breaker(self) -> CircuitBreaker:
+        """This engine's health breaker: tripped on ``unhealthy_after``
+        consecutive step faults; a DecodeFleet routes around OPEN
+        breakers and spends half-open probes to re-admit."""
+        return self._breaker
+
+    def _note_step_ok(self) -> None:
+        """A clean decode iteration: the device is serving again."""
+        if not self._consec_faults and not self._breaker_dirty:
+            return
+        self._consec_faults = 0
+        self._recover_prev_delay = 0.0
+        self.metrics.set_consecutive_faults(0)
+        self._breaker_dirty = False
+        if self._breaker.record_success():
+            runlog.emit("engine_recovered",
+                        engine=self.metrics.engine_label)
+
+    def _recover_step_fault(self, exc: BaseException) -> None:
+        """A jitted decode step failed: only that iteration's KV writes
+        are lost, and every live request is reconstructible from host
+        state. Ladder: quarantine + re-admit (per-request budget) →
+        after ``unhealthy_after`` consecutive faults, migrate everything
+        to a healthy engine via the fleet's rescue sink. A fault inside
+        recovery itself (DECODE_RECOVER) escalates one rung."""
+        dconf = self.decode_config
+        self.metrics.record_step_fault()
+        self._consec_faults += 1
+        self.metrics.set_consecutive_faults(self._consec_faults)
+        self._breaker_dirty = True
+        tripped = self._breaker.record_failure()
+        runlog.emit("decode_step_error", error=repr(exc), recovering=True,
+                    consecutive=self._consec_faults, tripped=tripped,
+                    engine=self.metrics.engine_label)
+        ptlog.warning(
+            "decode step failed (%r); recovering %d request(s) "
+            "(consecutive fault %d)", exc, len(self._active),
+            self._consec_faults)
+        try:
+            faults.inject(faults.DECODE_RECOVER,
+                          engine=self.metrics.engine_label)
+            if (self._consec_faults >= dconf.unhealthy_after
+                    and self._rescue_sink is not None):
+                self._migrate_out(exc)
+                return
+            self._quarantine(exc)
+        except Exception as rexc:
+            # recovery itself faulted: escalate straight to migration
+            # when a fleet can take the work, else the pre-recovery
+            # fail-everything behavior (never hang the handles)
+            ptlog.error("decode recovery failed: %r", rexc)
+            if self._rescue_sink is not None:
+                self._migrate_out(rexc)
+            else:
+                for req in list(self._active):
+                    self._fail(req, rexc)
+                self._kv.release_all()
+            return
+        # spread repeated quarantine cycles out (decorrelated so engines
+        # sharing a sick host don't re-synchronize on the device)
+        d = retry_mod.decorrelated_backoff(
+            self._recover_prev_delay, dconf.recovery_base_delay_s,
+            dconf.recovery_max_delay_s)
+        self._recover_prev_delay = d
+        time.sleep(d)
+
+    def _quarantine(self, exc: BaseException) -> None:
+        """Release every slot (the poisoned iteration's KV writes are
+        untrusted) and send live requests back through the proven
+        resume/re-prefill path — token-exact, per the preemption
+        contract. A request past its lifetime recovery budget fails with
+        a typed RetriesExhausted instead of looping."""
+        requeued = 0
+        for req in list(self._active):
+            self._release(req)
+            req.recoveries += 1
+            if req.recoveries > self.decode_config.recovery_retries:
+                self.metrics.record_retries_exhausted()
+                err = RetriesExhausted(
+                    f"request {req.rid}: recovery budget "
+                    f"({self.decode_config.recovery_retries}) exhausted "
+                    f"(last fault: {exc!r})", request_id=req.rid)
+                err.__cause__ = exc
+                self._fail(req, err)
+                continue
+            req.phase = "queued"
+            req.seq = None
+            req.chunks_done = 0
+            req.cur_len = 0
+            self._resume.append(req)
+            requeued += 1
+            runlog.emit(
+                "request_recovered", rid=req.rid,
+                recoveries=req.recoveries, generated=len(req.generated),
+                engine=self.metrics.engine_label,
+                trace_id=req.trace.trace_id if req.trace else None)
+        self._kv.release_all()  # nothing survives the poisoned iteration
+        if requeued:
+            self.metrics.record_recover(requeued)
+
+    def _recover_request(self, req: _DecodeRequest,
+                         exc: BaseException) -> None:
+        """A prefill chunk failed for ONE request (garbage confined to
+        its slot's pages): quarantine just that request through the
+        resume path, on the same lifetime budget. Does not count toward
+        engine-level consecutive faults — a single poison prompt must
+        exhaust its own budget, not condemn the engine."""
+        if not self.decode_config.recovery:
+            self._fail(req, exc)
+            return
+        self.metrics.record_step_fault()
+        self._release(req)
+        req.recoveries += 1
+        if req.recoveries > self.decode_config.recovery_retries:
+            self.metrics.record_retries_exhausted()
+            err = RetriesExhausted(
+                f"request {req.rid}: recovery budget "
+                f"({self.decode_config.recovery_retries}) exhausted "
+                f"(last fault: {exc!r})", request_id=req.rid)
+            err.__cause__ = exc
+            self._fail(req, err)
+            return
+        req.phase = "queued"
+        req.seq = None
+        req.chunks_done = 0
+        req.cur_len = 0
+        self._resume.append(req)
+        self.metrics.record_recover(1)
+        runlog.emit("request_recovered", rid=req.rid,
+                    recoveries=req.recoveries, generated=len(req.generated),
+                    engine=self.metrics.engine_label,
+                    trace_id=req.trace.trace_id if req.trace else None)
+
+    def _drain_packets(self) -> List[RescuePacket]:
+        """Drain every live request's host state (active slots, parked
+        queues, and the scheduler backlog) into RescuePackets. Slots are
+        released and each rid closes in the journal with "migrated" so a
+        replay of THIS engine's journal won't resurrect them — the
+        adopting engine journals them afresh."""
+        drained: List[_DecodeRequest] = []
+        for req in list(self._active):
+            self._release(req)
+            drained.append(req)
+        while self._resume:
+            drained.append(self._resume.popleft())
+        while self._pending_admit:
+            drained.append(self._pending_admit.popleft())
+        while True:
+            try:
+                req, ok = self._queue.recv(timeout=0)
+            except Exception:
+                break
+            if not ok:
+                break
+            drained.append(req)
+        self._kv.release_all()
+        packets: List[RescuePacket] = []
+        for req in drained:
+            self._j_fin(req, "migrated")
+            packets.append(RescuePacket(
+                rid=req.rid or "", prompt=req.prompt, mnt=req.mnt,
+                generated=list(req.generated), tenant=req.tenant,
+                cls=req.cls, deadline=req.deadline, t_submit=req.t_submit,
+                n_preemptions=req.n_preemptions, handle=req.handle,
+                trace=req.trace, cancelled=req.cancelled))
+        return packets
+
+    def _migrate_out(self, exc: BaseException) -> None:
+        """Declare this engine unhealthy: trip the breaker (the fleet
+        stops routing here until a half-open probe succeeds) and hand
+        every live request to the rescue sink for adoption elsewhere."""
+        self._breaker.trip()
+        self._breaker_dirty = True
+        packets = self._drain_packets()
+        runlog.emit("engine_unhealthy", engine=self.metrics.engine_label,
+                    error=repr(exc), in_flight=len(packets),
+                    consecutive=self._consec_faults)
+        ptlog.error(
+            "engine %s unhealthy after %d consecutive step faults; "
+            "migrating %d request(s)", self.metrics.engine_label,
+            self._consec_faults, len(packets))
+        adopted = self._rescue_sink(self, packets) if packets else 0
+        self.metrics.record_migrate(adopted)
+        self._consec_faults = 0
+        self._recover_prev_delay = 0.0
+        self.metrics.set_consecutive_faults(0)
+
+    def adopt_rescue(self, packet: RescuePacket,
+                     from_engine: Optional[str] = None) -> DecodeHandle:
+        """Adopt a request drained from an unhealthy engine (or rebuilt
+        by journal replay): generation continues token-exactly from its
+        ``prompt + generated`` host state through the resume path. The
+        client's original handle — when the packet carries one — is
+        repointed here, so ``result()``/``cancel()`` keep working across
+        the migration. Returns the (possibly fresh) handle."""
+        if self._closed:
+            raise EngineClosedError("engine is closed")
+        prompt = np.asarray(packet.prompt, np.int32).reshape(-1)
+        req = _DecodeRequest(
+            prompt, int(packet.mnt),
+            self._n_chunks(int(prompt.size) + len(packet.generated)),
+            packet.deadline, packet.t_submit or time.monotonic(),
+            tenant=packet.tenant, cls=packet.cls)
+        req.generated = [int(t) for t in packet.generated]
+        req.n_preemptions = packet.n_preemptions
+        req.cancelled = packet.cancelled
+        req.rid = packet.rid or (
+            f"{self.metrics.engine_label}-{_RID_SALT}-"
+            f"{next(self._rid_seq)}")
+        if packet.handle is not None:
+            req.handle = packet.handle
+            packet.handle._req = req  # cancel() must target the new req
+        req.trace = packet.trace
+        if req.trace is None and tracing.tracing_enabled():
+            req.trace = tracing.SpanContext.new_trace()
+        if req.trace is not None:
+            req.handle.trace = req.trace
+            req.t_enqueue_pc = time.perf_counter()
+        # already satisfied (e.g. crash landed between the last token and
+        # its fin record): complete without re-decoding a single token
+        eos = self.decode_config.eos_id
+        done_eos = (eos is not None and req.generated
+                    and req.generated[-1] == eos)
+        if done_eos or len(req.generated) >= req.mnt:
+            reason = "eos" if done_eos else "length"
+            self._j_admit(req)
+            self._j_fin(req, reason)
+            req.handle._complete(DecodeOutput(
+                tokens=np.asarray(req.generated, dtype=np.int32),
+                finish_reason=reason, prompt_len=int(req.prompt.size),
+                n_preemptions=req.n_preemptions))
+            return req.handle
+        self._j_admit(req)
+        self.metrics.record_submit()
+        if from_engine is not None:
+            runlog.emit(
+                "request_migrated", rid=req.rid, from_engine=from_engine,
+                to_engine=self.metrics.engine_label,
+                generated=len(req.generated),
+                trace_id=req.trace.trace_id if req.trace else None)
+        # front-of-line with the resumed: the request already waited once
+        self._resume.append(req)
+        return req.handle
+
+    def kill(self) -> None:
+        """Simulate abrupt engine death (chaos/testing): no drain, no
+        journal fin records — exactly the state a crashed process leaves
+        behind. In-flight handles fail with :class:`EngineUnhealthy`;
+        the journal file still names every incomplete request, which is
+        what ``recovery.resume_incomplete()`` rebuilds from."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+        # the "crash" happens NOW: nothing more reaches the WAL (in
+        # particular no fin records for in-flight requests)
+        journal, self._journal = self._journal, None
+        self._killed = True
+        self._queue.close()
+        self._thread.join(5.0)
+        if journal is not None:
+            journal.close()  # release the fd; on-disk bytes stay as-is
+        exc = EngineUnhealthy(
+            f"engine {self.metrics.engine_label} killed")
+        drained = (list(self._active) + list(self._resume)
+                   + list(self._pending_admit))
+        self._active.clear()
+        self._resume.clear()
+        self._pending_admit.clear()
+        while True:
+            try:
+                req, ok = self._queue.recv(timeout=0)
+            except Exception:
+                break
+            if not ok:
+                break
+            drained.append(req)
+        self._kv.release_all()
+        for req in drained:
+            if not req.handle.done():
+                req.handle._fail(exc)
+        if self._admission is not None:
+            admission_mod.uninstall(self._admission)
+
     # -- shutdown ----------------------------------------------------------
+
+    # grace period for the loop to notice _drain_abort at an iteration
+    # boundary once the close() timeout has been overrun
+    _DRAIN_ABORT_GRACE_S = 5.0
+
+    def _force_drain(self) -> None:
+        """The close() drain deadline passed: complete every in-flight
+        request with the tokens it has (``finish_reason="drain_timeout"``)
+        instead of leaving its handle hanging forever, then prove no KV
+        page leaked."""
+        drained = (list(self._active) + list(self._resume)
+                   + list(self._pending_admit))
+        self._resume.clear()
+        self._pending_admit.clear()
+        while True:
+            try:
+                req, ok = self._queue.recv(timeout=0)
+            except Exception:
+                break
+            if not ok:
+                break
+            drained.append(req)
+        for req in drained:
+            self._finish(req, "drain_timeout")
+        self._kv.assert_no_leaks()
 
     def close(self, timeout: Optional[float] = None) -> List[str]:
         """Graceful drain: stop intake, run every accepted request to
-        completion, then stop the loop. Returns unjoined thread names
-        (empty = clean)."""
+        completion, then stop the loop. The drain deadline is ENFORCED:
+        when ``timeout`` is overrun, the loop force-finishes stragglers
+        with ``finish_reason="drain_timeout"`` (partial tokens returned,
+        no handle left waiting forever) and the page-leak check still
+        runs. Returns unjoined thread names (empty = clean)."""
         with self._close_lock:
             if self._closed:
                 return []
             self._closed = True
         self._queue.close()
         self._thread.join(timeout)
+        if timeout is not None and self._thread.is_alive():
+            ptlog.error(
+                "DecodeEngine.close: drain exceeded %ss; force-finishing "
+                "in-flight requests with finish_reason=drain_timeout",
+                timeout)
+            self._drain_abort = True
+            self._thread.join(self._DRAIN_ABORT_GRACE_S)
         unjoined = [self._thread.name] if self._thread.is_alive() else []
         if unjoined:
             ptlog.error("DecodeEngine.close: loop failed to join within %s",
                         timeout)
+        if self._journal is not None:
+            self._journal.close()
         if self._admission is not None:
             admission_mod.uninstall(self._admission)
         return unjoined

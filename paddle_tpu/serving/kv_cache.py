@@ -155,6 +155,18 @@ class PagedKVCache:
         self._active[slot] = False
         return n
 
+    def release_all(self) -> int:
+        """Release every active slot (quarantine after a poisoned decode
+        iteration, or engine teardown). Returns the number of slots freed.
+        The device pages are untouched — their contents are garbage once
+        the tables point back at scratch, which is exactly the semantics
+        recovery wants: the faulted iteration's KV writes are lost and
+        every sequence re-prefills from host-side tokens."""
+        slots = self.active_slots()
+        for s in slots:
+            self.release_slot(s)
+        return len(slots)
+
     def ensure_capacity(self, slot: int, n_positions: int) -> bool:
         """Grow ``slot`` to cover logical positions ``[0, n_positions)``.
         All-or-nothing: returns False (state unchanged) when the pool

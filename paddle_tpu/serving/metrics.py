@@ -338,6 +338,13 @@ class DecodeMetrics:
         self.cancelled_total = 0
         self.timeouts_total = 0
         self.errors_total = 0
+        # zero-loss recovery accounting (serving.recovery.* families)
+        self.step_faults_total = 0       # poisoned decode/prefill iterations
+        self.recovered_total = 0         # requests re-admitted after a fault
+        self.migrated_total = 0          # requests drained to another engine
+        self.retries_exhausted_total = 0  # requests past their retry budget
+        self.journal_records_total = 0   # WAL records appended
+        self.journal_replayed_total = 0  # requests resumed from the journal
         # tenant-quota admission accounting (serving.tenant.* families)
         self._tenant_admitted: collections.Counter = collections.Counter()
         self._tenant_shed: collections.Counter = collections.Counter()
@@ -473,6 +480,51 @@ class DecodeMetrics:
         prof.observe("serving.decode.request_latency_seconds", latency_s,
                      labels=self._labels)
 
+    # -- zero-loss recovery (serving.recovery.* families) --------------------
+
+    def record_step_fault(self) -> None:
+        with self._lock:
+            self.step_faults_total += 1
+        prof.inc_counter("serving.recovery.step_faults_total",
+                         labels=self._labels)
+
+    def record_recover(self, n: int = 1) -> None:
+        with self._lock:
+            self.recovered_total += n
+        prof.inc_counter("serving.recovery.recovered_total", n,
+                         labels=self._labels)
+
+    def record_migrate(self, n: int = 1) -> None:
+        with self._lock:
+            self.migrated_total += n
+        prof.inc_counter("serving.recovery.migrated_total", n,
+                         labels=self._labels)
+
+    def record_retries_exhausted(self) -> None:
+        with self._lock:
+            self.retries_exhausted_total += 1
+        prof.inc_counter("serving.recovery.retries_exhausted_total",
+                         labels=self._labels)
+
+    def record_journal_records(self, n: int = 1) -> None:
+        with self._lock:
+            self.journal_records_total += n
+        prof.inc_counter("serving.recovery.journal_records_total", n,
+                         labels=self._labels)
+
+    def record_journal_replayed(self, n: int = 1) -> None:
+        with self._lock:
+            self.journal_replayed_total += n
+        prof.inc_counter("serving.recovery.journal_replayed_total", n,
+                         labels=self._labels)
+
+    def set_consecutive_faults(self, n: int) -> None:
+        """Consecutive faulted iterations on this engine — the series the
+        watch layer's unhealthy-engine rule subscribes to; resets to 0 on
+        every clean iteration."""
+        prof.set_gauge("serving.recovery.consecutive_faults", n,
+                       labels=self._labels)
+
     def set_pages(self, in_use: int, free: int) -> None:
         prof.set_gauge("serving.decode.pages_in_use", in_use,
                        labels=self._labels)
@@ -497,6 +549,12 @@ class DecodeMetrics:
                 "cancelled_total": self.cancelled_total,
                 "timeouts_total": self.timeouts_total,
                 "errors_total": self.errors_total,
+                "step_faults_total": self.step_faults_total,
+                "recovered_total": self.recovered_total,
+                "migrated_total": self.migrated_total,
+                "retries_exhausted_total": self.retries_exhausted_total,
+                "journal_records_total": self.journal_records_total,
+                "journal_replayed_total": self.journal_replayed_total,
                 "mean_step_occupancy": (
                     self.tokens_total / self.steps_total
                     if self.steps_total else 0.0),

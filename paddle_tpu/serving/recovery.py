@@ -1,0 +1,380 @@
+"""Zero-loss decode: request recovery, durable journal, engine migration.
+
+PR 9's continuous-batching engine treated any decode-step fault as fatal
+to every in-flight request, even though its own preempt/resume path
+already proves a generation is reconstructible token-exactly from
+``prompt + generated``. This module finishes that story — the MapReduce/
+GFS insight (re-execute from durable state instead of gang-failing)
+applied to autoregressive serving. Three nested safety rings:
+
+1. **Step-fault recovery** (innermost, in ``serving.decode``): a failed
+   jitted iteration poisons only that iteration's KV writes. The engine
+   quarantines the batch — every slot released, every live request
+   re-admitted through the proven resume path — under a per-request
+   retry budget with decorrelated-jitter backoff. Deterministic poison
+   surfaces a typed :class:`RetriesExhausted` instead of looping.
+2. **Cross-engine migration**: K consecutive faulted iterations declare
+   the engine unhealthy — its ``CircuitBreaker`` trips, live requests
+   drain into host-side :class:`RescuePacket`\\ s, and a
+   :class:`DecodeFleet` resubmits them on a healthy engine where greedy
+   decode continues token-exactly. Half-open probing re-admits the
+   engine after cooldown.
+3. **Durable journal** (outermost, survives the process): an append-only
+   :class:`RequestJournal` WAL — CRC per record, torn-tail tolerant,
+   batched fsync off the step path — records admission and every
+   generated token. :func:`replay_journal` reconstructs state after a
+   restart; :func:`resume_incomplete` resubmits unfinished requests, and
+   idempotent request ids let clients dedupe tokens already delivered.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.core import logging as ptlog
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.observability import runlog
+from paddle_tpu.resilience.circuit import CLOSED
+
+__all__ = [
+    "DecodeFleet",
+    "EngineUnhealthy",
+    "ReplayedRequest",
+    "RequestJournal",
+    "RescuePacket",
+    "RetriesExhausted",
+    "replay_journal",
+    "resume_incomplete",
+]
+
+
+class RetriesExhausted(RuntimeError):
+    """A request burned through its recovery budget — the fault follows
+    it across quarantine cycles, so it is the poison (or rides a dead
+    device with nowhere to migrate). Carries the request id so clients
+    can correlate with journal/runlog records."""
+
+    def __init__(self, message: str, request_id: Optional[str] = None):
+        super().__init__(message)
+        self.request_id = request_id
+
+
+class EngineUnhealthy(RuntimeError):
+    """No healthy engine could take the work (fleet exhausted, or the
+    engine was killed)."""
+
+
+@dataclasses.dataclass
+class RescuePacket:
+    """Everything needed to continue one generation on another engine:
+    pure host-side state (the KV cache is rebuilt by re-prefill, which
+    the preempt/resume path proves token-exact). ``handle`` is the
+    client's original future — migration repoints it at the adopting
+    engine's request so ``result()``/``cancel()`` keep working; None
+    (journal replay: the old process's futures died with it) makes the
+    adopter mint a fresh handle."""
+
+    rid: str
+    prompt: np.ndarray
+    mnt: int
+    generated: List[int]
+    tenant: str = "default"
+    cls: str = "interactive"
+    deadline: Optional[float] = None
+    t_submit: float = 0.0
+    n_preemptions: int = 0
+    handle: Optional[Any] = None
+    trace: Optional[Any] = None
+    cancelled: bool = False
+
+
+# -- the durable request journal (WAL) --------------------------------------
+
+_J_ADMIT = "admit"
+_J_TOK = "tok"
+_J_FIN = "fin"
+
+
+def _encode_record(obj: Dict[str, Any]) -> bytes:
+    payload = json.dumps(obj, separators=(",", ":"), sort_keys=True)
+    crc = zlib.crc32(payload.encode("utf-8")) & 0xFFFFFFFF
+    return f"{crc:08x}|{payload}\n".encode("utf-8")
+
+
+def _decode_record(line: bytes) -> Optional[Dict[str, Any]]:
+    """One journal line -> record dict, or None when the line is torn or
+    corrupt (bad CRC, truncated json, missing separator)."""
+    try:
+        text = line.decode("utf-8")
+        crc_hex, payload = text.split("|", 1)
+        payload = payload.rstrip("\n")
+        if int(crc_hex, 16) != (zlib.crc32(payload.encode("utf-8"))
+                                & 0xFFFFFFFF):
+            return None
+        obj = json.loads(payload)
+        return obj if isinstance(obj, dict) else None
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
+class RequestJournal:
+    """Append-only WAL of request admissions, generated tokens, and
+    terminal outcomes. Same durability discipline as
+    ``observability.runlog``: one self-validating record per line
+    (``<crc32-hex>|<compact-json>``), written append-only so a crash can
+    only tear the final line — :func:`replay_journal` stops at the first
+    bad record and trusts everything before it.
+
+    fsync policy: records are buffered through the OS and fsync'd every
+    ``fsync_every`` appends (and on :meth:`flush`/:meth:`close`), keeping
+    the syscall off the per-token hot path. The window between fsyncs is
+    the only durability gap — at most ``fsync_every`` tokens re-decode
+    after a crash, which re-prefill makes token-exact anyway."""
+
+    def __init__(self, path: str, fsync_every: int = 16):
+        enforce(fsync_every >= 1,
+                f"fsync_every must be >= 1, got {fsync_every}")
+        self.path = path
+        self.fsync_every = int(fsync_every)
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._f = open(path, "ab")
+        self._lock = threading.Lock()
+        self._unsynced = 0
+        self.records_total = 0
+
+    def _append(self, obj: Dict[str, Any]) -> None:
+        data = _encode_record(obj)
+        with self._lock:
+            if self._f.closed:
+                return  # journal detached mid-flight (engine killed)
+            self._f.write(data)
+            self.records_total += 1
+            self._unsynced += 1
+            if self._unsynced >= self.fsync_every:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                self._unsynced = 0
+
+    def log_admit(self, rid: str, prompt: np.ndarray, mnt: int,
+                  gen_prefix: List[int], tenant: str, cls: str) -> None:
+        """Request accepted (or adopted with an already-generated prefix
+        after migration/replay — ``gen_prefix`` keeps the journal
+        self-contained without rewriting token records)."""
+        self._append({
+            "k": _J_ADMIT, "rid": rid,
+            "prompt": [int(t) for t in np.asarray(prompt).reshape(-1)],
+            "mnt": int(mnt), "gen": [int(t) for t in gen_prefix],
+            "tenant": tenant, "cls": cls,
+        })
+
+    def log_token(self, rid: str, tok: int) -> None:
+        self._append({"k": _J_TOK, "rid": rid, "t": int(tok)})
+
+    def log_finish(self, rid: str, reason: str) -> None:
+        self._append({"k": _J_FIN, "rid": rid, "reason": reason})
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._f.closed:
+                return
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._unsynced = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f.closed:
+                return
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._f.close()
+
+
+@dataclasses.dataclass
+class ReplayedRequest:
+    """One request reconstructed from the journal."""
+
+    rid: str
+    prompt: np.ndarray
+    mnt: int
+    generated: List[int]
+    tenant: str = "default"
+    cls: str = "interactive"
+    finished: bool = False
+    reason: Optional[str] = None
+
+
+def replay_journal(path: str) -> Dict[str, ReplayedRequest]:
+    """Reconstruct request state from a journal file, in admission order.
+    Torn-tail tolerant: reading stops at the first corrupt record (a
+    crash mid-append can only damage the tail; anything after a bad
+    record is untrusted). A re-``admit`` of a known rid (migration across
+    engines sharing a journal, or an adopted prefix) resets that
+    request's token prefix to the record's ``gen`` — admission records
+    are authoritative snapshots, token records are increments."""
+    out: Dict[str, ReplayedRequest] = {}
+    if not os.path.exists(path):
+        return out
+    n_bad = 0
+    with open(path, "rb") as f:
+        for line in f:
+            rec = _decode_record(line)
+            if rec is None:
+                n_bad += 1
+                break  # torn tail: trust nothing past the first bad record
+            kind, rid = rec.get("k"), rec.get("rid")
+            if kind == _J_ADMIT:
+                out[rid] = ReplayedRequest(
+                    rid=rid,
+                    prompt=np.asarray(rec.get("prompt", []), np.int32),
+                    mnt=int(rec.get("mnt", 0)),
+                    generated=[int(t) for t in rec.get("gen", [])],
+                    tenant=rec.get("tenant", "default"),
+                    cls=rec.get("cls", "interactive"),
+                )
+            elif kind == _J_TOK and rid in out:
+                out[rid].generated.append(int(rec.get("t", 0)))
+            elif kind == _J_FIN and rid in out:
+                out[rid].finished = True
+                out[rid].reason = rec.get("reason")
+    if n_bad:
+        ptlog.warning("journal %s: stopped at a torn/corrupt record "
+                      "(%d request(s) recovered before it)", path, len(out))
+    return out
+
+
+def resume_incomplete(engine, path: str) -> Dict[str, Tuple[Any, int]]:
+    """Resubmit every journaled-but-unfinished request on ``engine``
+    (typically a fresh process over the same journal file). Returns
+    ``rid -> (handle, n_delivered)`` where ``n_delivered`` is how many
+    tokens the journal proves were already produced — the idempotent-id
+    dedup contract: the resumed output's first ``n_delivered`` tokens are
+    exactly the ones a client may already have received, so a delivery
+    layer replays ``tokens[n_delivered:]`` only."""
+    replayed = replay_journal(path)
+    out: Dict[str, Tuple[Any, int]] = {}
+    for rid, rr in replayed.items():
+        if rr.finished:
+            continue
+        packet = RescuePacket(
+            rid=rid, prompt=rr.prompt, mnt=rr.mnt,
+            generated=list(rr.generated), tenant=rr.tenant, cls=rr.cls,
+            t_submit=time.monotonic(),
+        )
+        handle = engine.adopt_rescue(packet)
+        out[rid] = (handle, len(rr.generated))
+    engine.metrics.record_journal_replayed(len(out))
+    runlog.emit("journal_replay", engine=engine.metrics.engine_label,
+                path=path, resumed=len(out),
+                finished=len(replayed) - len(out))
+    return out
+
+
+# -- cross-engine migration --------------------------------------------------
+
+class DecodeFleet:
+    """A set of ``DecodeEngine``\\ s behind one submit surface, with
+    health-aware routing and rescue. Each engine keeps its own
+    ``CircuitBreaker``; routing round-robins over CLOSED breakers and
+    spends at most one half-open probe per pick on a cooled-down OPEN
+    one, so a recovered device earns its traffic back one request at a
+    time. When an engine declares itself unhealthy it drains its live
+    requests into :class:`RescuePacket`\\ s and hands them here —
+    :meth:`_rescue` re-places each on a healthy peer with the client's
+    original handle intact."""
+
+    def __init__(self, engines: List[Any]):
+        enforce(len(engines) >= 1, "DecodeFleet needs at least one engine")
+        self.engines = list(engines)
+        self._rr = 0
+        self._lock = threading.Lock()
+        self.rescued_total = 0
+        self.rescue_failed_total = 0
+        for eng in self.engines:
+            eng._rescue_sink = self._rescue
+
+    def _order(self) -> List[Any]:
+        with self._lock:
+            k = self._rr
+            self._rr += 1
+        n = len(self.engines)
+        return [self.engines[(k + i) % n] for i in range(n)]
+
+    def _pick(self, exclude: Optional[Any] = None) -> Optional[Any]:
+        order = [e for e in self._order()
+                 if e is not exclude and not e.closed]
+        # spend a half-open probe the moment one is available — even with
+        # healthy engines around, one risked request is how an ejected
+        # engine earns its capacity back (a failed probe just re-opens
+        # the breaker, and recovery/migration makes the request itself
+        # zero-loss). allow() takes the single probe token atomically.
+        healthy = None
+        for eng in order:
+            if eng.breaker.state == CLOSED:
+                if healthy is None:
+                    healthy = eng
+            elif eng.breaker.retry_in() == 0.0 and eng.breaker.allow():
+                return eng
+        return healthy
+
+    def submit(self, prompt, max_new_tokens: int, **kwargs):
+        eng = self._pick()
+        if eng is None:
+            raise EngineUnhealthy(
+                "no healthy decode engine (all breakers open or cooling)")
+        return eng.submit(prompt, max_new_tokens, **kwargs)
+
+    def _rescue(self, src, packets: List[RescuePacket]) -> int:
+        """Re-place drained requests anywhere but ``src``. A packet with
+        no healthy destination fails its handle with
+        :class:`EngineUnhealthy` — zero-loss holds as long as one healthy
+        engine exists."""
+        adopted = 0
+        for packet in packets:
+            dst = self._pick(exclude=src)
+            if dst is None:
+                self.rescue_failed_total += 1
+                if packet.handle is not None:
+                    packet.handle._fail(EngineUnhealthy(
+                        f"request {packet.rid}: engine "
+                        f"{src.metrics.engine_label} unhealthy and no "
+                        f"healthy engine to migrate to"))
+                continue
+            dst.adopt_rescue(packet, from_engine=src.metrics.engine_label)
+            adopted += 1
+            self.rescued_total += 1
+        return adopted
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "engines": [
+                {"engine": e.metrics.engine_label,
+                 "breaker": e.breaker.snapshot(),
+                 "closed": e.closed}
+                for e in self.engines
+            ],
+            "rescued_total": self.rescued_total,
+            "rescue_failed_total": self.rescue_failed_total,
+        }
+
+    def close(self, timeout: Optional[float] = None) -> List[str]:
+        unjoined: List[str] = []
+        for eng in self.engines:
+            unjoined.extend(eng.close(timeout))
+        return unjoined
+
+    def __enter__(self) -> "DecodeFleet":
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> bool:
+        self.close()
+        return False

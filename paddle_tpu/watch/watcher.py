@@ -116,6 +116,12 @@ def default_rules() -> List[WatchRule]:
                   det_mod.EwmaDetector(alpha=0.2, z_threshold=6.0,
                                        min_samples=8),
                   invert=True),
+        # decode engines reset this gauge to 0 on every clean iteration,
+        # so a sustained climb means an engine is in a quarantine loop
+        # and about to trip its breaker / migrate its requests
+        WatchRule("serving.recovery.consecutive_faults",
+                  det_mod.EwmaDetector(alpha=0.3, z_threshold=6.0,
+                                       min_samples=8)),
     ]
 
 

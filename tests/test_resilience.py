@@ -20,7 +20,15 @@ import paddle_tpu as pt
 from paddle_tpu import checkpoint as ckpt_mod
 from paddle_tpu import checkpoint_sharded as cks
 from paddle_tpu.core.enforce import EnforceError
-from paddle_tpu.core.retry import backoff_delays, next_backoff, retry_call
+from paddle_tpu.core.retry import (
+    RetryBudget,
+    backoff_delays,
+    decorrelated_backoff,
+    default_budget,
+    next_backoff,
+    retry_call,
+    set_default_budget,
+)
 from paddle_tpu.resilience import ResilienceConfig, faults
 from paddle_tpu.resilience.circuit import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
 from paddle_tpu.resilience.integrity import CheckpointCorruptError
@@ -101,6 +109,85 @@ def test_retry_call_recovers_and_exhausts():
     assert calls["n"] == 1
 
 
+def test_decorrelated_backoff_bounds():
+    import random
+
+    rng = random.Random(11)
+    # first retry: exactly the base
+    assert decorrelated_backoff(0.0, base_delay=0.1, max_delay=2.0) == \
+        pytest.approx(0.1)
+    # subsequent draws live in [base, min(max, prev*3)]
+    prev = 0.1
+    for _ in range(32):
+        d = decorrelated_backoff(prev, base_delay=0.1, max_delay=2.0, rng=rng)
+        assert 0.1 <= d <= min(2.0, max(0.1, prev * 3.0)) + 1e-12
+        prev = d
+    # the cap binds
+    assert decorrelated_backoff(100.0, base_delay=0.1, max_delay=2.0,
+                                rng=rng) <= 2.0
+    with pytest.raises(EnforceError):
+        decorrelated_backoff(-0.5)
+
+
+def test_retry_budget_token_bucket_fake_clock():
+    now = [0.0]
+    b = RetryBudget(rate_per_s=2.0, burst=3.0, clock=lambda: now[0])
+    assert b.available() == pytest.approx(3.0)
+    assert b.try_take() and b.try_take() and b.try_take()
+    assert not b.try_take()  # dry
+    assert b.exhausted_total == 1 and b.taken_total == 3
+    now[0] = 1.0  # refills 2 tokens
+    assert b.try_take() and b.try_take() and not b.try_take()
+    now[0] = 100.0  # refill caps at burst
+    assert b.available() == pytest.approx(3.0)
+
+
+def test_retry_call_budget_exhaustion_stops_retrying():
+    now = [0.0]
+    budget = RetryBudget(rate_per_s=0.0, burst=2.0, clock=lambda: now[0])
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise OSError("down")
+
+    # 2 tokens: attempt + 2 budgeted retries, then the budget (not the
+    # retries=10 ladder) surfaces the error immediately — no sleeps left
+    slept = []
+    with pytest.raises(OSError, match="down"):
+        retry_call(always, retries=10, budget=budget, sleep=slept.append)
+    assert calls["n"] == 3 and len(slept) == 2
+    assert budget.exhausted_total == 1
+
+    # first attempts are never charged: a healthy call leaves it dry-safe
+    calls["n"] = 0
+    assert retry_call(lambda: "ok", retries=10, budget=budget) == "ok"
+
+
+def test_retry_call_decorrelated_delays_and_default_budget():
+    slept = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise OSError("transient")
+        return "ok"
+
+    out = retry_call(flaky, retries=5, decorrelated=True, base_delay=0.01,
+                     max_delay=0.05, sleep=slept.append, budget="default")
+    assert out == "ok" and len(slept) == 3
+    assert slept[0] == pytest.approx(0.01)
+    for d in slept:
+        assert 0.01 <= d <= 0.05 + 1e-12
+    # "default" resolves to the process-wide bucket (and is swappable)
+    prev = set_default_budget(RetryBudget(rate_per_s=1.0, burst=1.0))
+    try:
+        assert default_budget().burst == 1.0
+    finally:
+        set_default_budget(prev)
+
+
 # ---- resilience.faults ----------------------------------------------------
 
 
@@ -116,6 +203,19 @@ def test_fault_window_and_restore():
         assert plan.stats() == {"p": 2} and plan.all_fired()
     assert faults.active_plan() is None  # restored
     assert faults.inject("p") is None  # no plan: pure no-op
+
+
+def test_registered_points_is_the_chaos_coverage_universe():
+    """chaos_smoke's coverage gate diffs its schedule against this list —
+    it must stay in sync with the module's point constants."""
+    pts = faults.registered_points()
+    assert len(pts) == len(set(pts))  # no duplicates
+    for p in (faults.CHECKPOINT_SAVE, faults.CHECKPOINT_LOAD,
+              faults.READER_NEXT, faults.TRAINER_STEP,
+              faults.SERVING_DISPATCH, faults.DECODE_STEP,
+              faults.DECODE_RECOVER, faults.DEVICE_LOST,
+              faults.PREEMPT_NOTICE):
+        assert p in pts
 
 
 def test_fault_context_match_and_kinds():

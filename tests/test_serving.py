@@ -326,6 +326,42 @@ def test_serving_circuit_breaker_ejects_redispatches_recovers():
     assert unjoined == []
 
 
+def test_circuit_breaker_half_open_probe_single_admission_under_race():
+    """Two (and then many) threads racing a cooled-down OPEN breaker:
+    exactly ONE may carry the half-open probe — a double admission would
+    send two live batches to a possibly-sick replica and double the
+    blast radius of a failed probe. allow() must take the probe token
+    atomically."""
+    from paddle_tpu.resilience.circuit import HALF_OPEN, CircuitBreaker
+
+    for trial in range(8):  # the race is probabilistic: hammer it
+        br = CircuitBreaker(failure_threshold=1, cooldown_s=0.0,
+                            jitter=0.0)
+        br.record_failure()  # OPEN, cooldown 0 → probe ready immediately
+        n_threads = 8
+        admitted = []
+        start = threading.Barrier(n_threads)
+
+        def racer():
+            start.wait()
+            if br.allow():
+                admitted.append(threading.get_ident())
+
+        threads = [threading.Thread(target=racer) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(admitted) == 1, (
+            f"trial {trial}: {len(admitted)} threads won the single "
+            f"half-open probe")
+        assert br.state == HALF_OPEN
+        # the probe outcome resolves the race for everyone else
+        assert not br.allow()
+        br.record_success()
+        assert br.allow()  # CLOSED again
+
+
 def test_serving_worker_death_fails_fast_and_survivor_serves():
     """A replica worker dying with a BaseException (simulated runtime
     abort) must fail its in-flight callers immediately — never hang them —

@@ -426,11 +426,18 @@ def test_engine_retry_budget_token_bucket(model_and_vars):
         retry_budget_per_s=0.0, retry_budget_burst=3.0)
     try:
         release = threading.Event()
+        wedged = threading.Event()
         orig_flush = engine._batcher._flush
-        engine._batcher._flush = lambda g: (release.wait(30), orig_flush(g))
+        engine._batcher._flush = lambda g: (wedged.set(), release.wait(30),
+                                            orig_flush(g))
         x0 = np.zeros((1, D_IN), np.float32)
-        accepted = []
-        while True:  # wedge the pipeline + fill the 1-slot quota
+        # wedge first, THEN fill: if the quota probe below ran before the
+        # batcher blocked inside _flush, its dequeue could free the one
+        # queue slot mid-retry and a retried submit would legitimately
+        # succeed (the race this test used to flake on)
+        accepted = [engine.submit({"x": x0}, tenant="t")]
+        assert wedged.wait(10), "batcher never reached the wedged flush"
+        while True:  # batcher provably blocked: fill the 1-slot quota
             try:
                 accepted.append(engine.submit({"x": x0}, tenant="t"))
             except AdmissionRejected:

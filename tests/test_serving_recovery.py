@@ -1,0 +1,334 @@
+"""paddle_tpu.serving.recovery — zero-loss decode acceptance tests.
+
+The acceptance contract (ISSUE 11): with mixed-length in-flight
+generations, (a) a transient ``DECODE_STEP`` fault storm and (b) an
+engine declared unhealthy mid-generation both end with ZERO failed
+requests and token-exact outputs vs. a fault-free run; (c) a simulated
+process restart replays the durable journal, resumes incomplete
+requests to completion, and dedupes already-delivered tokens. The
+jitted decode step must stay compile-once (``decode_step_cache_size()
+== 1``) through every recovery path. Also covered: the typed
+``RetriesExhausted`` outcome for deterministic poison, journal CRC /
+torn-tail discipline, the enforced ``close()`` drain deadline, and
+fault-during-recovery escalation to migration.
+"""
+
+import os
+import time
+import types
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import models
+from paddle_tpu.models.transformer_lm import generate
+from paddle_tpu.resilience import faults
+from paddle_tpu.resilience.circuit import CLOSED, OPEN
+from paddle_tpu.serving import (
+    DecodeConfig,
+    DecodeEngine,
+    DecodeFleet,
+    EngineUnhealthy,
+    RequestJournal,
+    RetriesExhausted,
+    replay_journal,
+    resume_incomplete,
+)
+from paddle_tpu.serving.recovery import _decode_record, _encode_record
+
+VOCAB = 97
+
+# small backoffs + page-starved pool: recovery AND preemption both fire
+DC = dict(max_slots=3, page_size=4, max_context=40, prefill_chunk=8,
+          num_pages=14, recovery_base_delay_s=0.001,
+          recovery_max_delay_s=0.005, breaker_cooldown_s=0.05,
+          breaker_max_cooldown_s=0.2)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def lm():
+    """Tiny LM + greedy fault-free references for mixed-length requests
+    (same shapes as test_serving_decode so jit/persistent caches are
+    shared across the files)."""
+    spec = models.get_model("transformer_lm", seq_len=64, vocab=VOCAB,
+                            d_model=32, d_inner=64, num_heads=4, n_layers=2)
+    cfg = spec.extra["cfg"]
+    rng = np.random.RandomState(1)
+    variables = spec.model.init(0, *spec.synth_batch(2, rng))
+    cases = []
+    for _ in range(3):
+        tp = int(rng.randint(4, 12))
+        n = int(rng.randint(8, 16))
+        prompt = rng.randint(1, VOCAB, size=(tp,)).astype(np.int32)
+        ref = np.asarray(generate(variables, jnp.asarray(prompt[None]),
+                                  n, cfg))[0]
+        cases.append((prompt, n, ref))
+    return types.SimpleNamespace(cfg=cfg, variables=variables, cases=cases)
+
+
+def _engine(lm, **over):
+    kw = dict(DC)
+    kw.update(over)
+    return DecodeEngine(lm.variables, lm.cfg, decode=DecodeConfig(**kw))
+
+
+# ---- (a) step-fault storm: zero loss, token-exact -------------------------
+
+
+def test_step_fault_storm_zero_loss_token_exact(lm):
+    eng = _engine(lm)
+    try:
+        with faults.injected(
+            faults.FaultSpec(faults.DECODE_STEP, "error", after=2, times=3)
+        ) as plan:
+            handles = [eng.submit(p, n) for p, n, _ in lm.cases]
+            outs = [h.result(timeout=120) for h in handles]
+            assert plan.all_fired()
+        for (_, _, ref), out in zip(lm.cases, outs):
+            assert np.array_equal(out.tokens, ref)  # token-exact, zero lost
+        snap = eng.metrics.snapshot()
+        assert snap["errors_total"] == 0, snap
+        assert snap["step_faults_total"] >= 3, snap
+        assert snap["recovered_total"] >= 1, snap
+        # the recovery path re-admits through the SAME jitted step
+        assert eng.decode_step_cache_size() == 1
+        assert eng.breaker.state == CLOSED  # clean steps reset health
+    finally:
+        eng.close(timeout=30)
+
+
+def test_recovery_disabled_preserves_fail_fast(lm):
+    """recovery=False pins the pre-recovery contract: one poisoned
+    iteration fails its in-flight requests with the injected error."""
+    eng = _engine(lm, recovery=False)
+    try:
+        with faults.injected(
+            faults.FaultSpec(faults.DECODE_STEP, "error", after=1)
+        ):
+            h = eng.submit(lm.cases[0][0], lm.cases[0][1])
+            with pytest.raises(OSError):
+                h.result(timeout=60)
+    finally:
+        eng.close(timeout=30)
+
+
+def test_deterministic_poison_surfaces_retries_exhausted(lm):
+    """A fault that follows the request across quarantine cycles must
+    burn the per-request budget and fail TYPED — not loop forever (the
+    re-prefill path makes one token of progress per cycle, which is why
+    the budget never resets on progress)."""
+    eng = _engine(lm, recovery_retries=3)
+    try:
+        with faults.injected(
+            faults.FaultSpec(faults.DECODE_STEP, "error", times=10 ** 9)
+        ):
+            h = eng.submit(lm.cases[0][0], lm.cases[0][1])
+            with pytest.raises(RetriesExhausted) as ei:
+                h.result(timeout=120)
+            assert ei.value.request_id is not None
+        assert eng.metrics.snapshot()["retries_exhausted_total"] == 1
+    finally:
+        eng.close(timeout=30)
+
+
+def test_prefill_fault_recovers_single_request(lm):
+    """A failed prefill chunk quarantines ONE request through the resume
+    path; the others never notice and every output stays token-exact."""
+    eng = _engine(lm)
+    fails = {"n": 2}
+    real = eng._prefill
+
+    def flaky_prefill(*a, **kw):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise OSError("injected prefill fault")
+        return real(*a, **kw)
+
+    eng._prefill = flaky_prefill
+    try:
+        handles = [eng.submit(p, n) for p, n, _ in lm.cases]
+        outs = [h.result(timeout=120) for h in handles]
+        for (_, _, ref), out in zip(lm.cases, outs):
+            assert np.array_equal(out.tokens, ref)
+        assert eng.metrics.snapshot()["errors_total"] == 0
+        assert eng.metrics.snapshot()["recovered_total"] >= 1
+    finally:
+        eng._prefill = real
+        eng.close(timeout=30)
+
+
+# ---- (b) cross-engine migration -------------------------------------------
+
+
+def test_unhealthy_engine_migrates_token_exact_then_readmits(lm):
+    """Engine A goes permanently sick mid-generation: after
+    ``unhealthy_after`` consecutive faults its breaker trips and every
+    live request finishes on B with exactly the fault-free tokens, on
+    the client's ORIGINAL handles. When the fault clears, the fleet's
+    half-open probe re-admits A."""
+    ea = _engine(lm)
+    eb = _engine(lm)
+    fleet = DecodeFleet([ea, eb])
+    try:
+        with faults.injected(
+            faults.FaultSpec(faults.DECODE_STEP, "error", after=1,
+                             times=10 ** 9,
+                             match={"engine": ea.metrics.engine_label})
+        ):
+            handles = [ea.submit(p, n) for p, n, _ in lm.cases]  # pin to A
+            outs = [h.result(timeout=120) for h in handles]
+            for (_, _, ref), out in zip(lm.cases, outs):
+                assert np.array_equal(out.tokens, ref)
+            assert ea.breaker.state == OPEN
+            assert ea.metrics.snapshot()["migrated_total"] == len(lm.cases)
+            assert eb.metrics.snapshot()["errors_total"] == 0
+            assert eb.decode_step_cache_size() == 1
+        # fault gone: routed traffic spends the half-open probe on A
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and ea.breaker.state != CLOSED:
+            p, n, ref = lm.cases[0]
+            out = fleet.submit(p, n).result(timeout=60)
+            assert np.array_equal(out.tokens, ref)
+            time.sleep(0.02)
+        assert ea.breaker.state == CLOSED
+        assert ea.breaker.recoveries_total >= 1
+    finally:
+        fleet.close(timeout=30)
+
+
+def test_fault_during_recovery_escalates_to_migration(lm):
+    """DECODE_RECOVER firing inside the quarantine path must escalate
+    one rung (migrate via the fleet) rather than lose requests."""
+    ea = _engine(lm)
+    eb = _engine(lm)
+    fleet = DecodeFleet([ea, eb])
+    try:
+        with faults.injected(
+            faults.FaultSpec(faults.DECODE_STEP, "error", after=1,
+                             match={"engine": ea.metrics.engine_label}),
+            faults.FaultSpec(faults.DECODE_RECOVER, "error",
+                             match={"engine": ea.metrics.engine_label}),
+        ) as plan:
+            handles = [ea.submit(p, n) for p, n, _ in lm.cases]
+            outs = [h.result(timeout=120) for h in handles]
+            assert plan.all_fired()
+        for (_, _, ref), out in zip(lm.cases, outs):
+            assert np.array_equal(out.tokens, ref)
+        assert ea.metrics.snapshot()["migrated_total"] == len(lm.cases)
+    finally:
+        fleet.close(timeout=30)
+
+
+def test_fleet_no_healthy_engine_rejects_typed(lm):
+    eng = _engine(lm)
+    fleet = DecodeFleet([eng])
+    try:
+        eng.breaker.trip()
+        with pytest.raises(EngineUnhealthy):
+            fleet.submit(lm.cases[0][0], 4)
+    finally:
+        fleet.close(timeout=30)
+
+
+# ---- (c) durable journal: replay after restart ----------------------------
+
+
+def test_journal_records_crc_and_torn_tail(tmp_path):
+    path = os.fspath(tmp_path / "j.wal")
+    j = RequestJournal(path, fsync_every=2)
+    j.log_admit("r1", np.array([5, 6], np.int32), 4, [], "default",
+                "interactive")
+    j.log_token("r1", 7)
+    j.log_token("r1", 8)
+    j.log_finish("r1", "length")
+    j.log_admit("r2", np.array([9], np.int32), 3, [1], "default",
+                "interactive")
+    j.log_token("r2", 2)
+    j.close()
+    rep = replay_journal(path)
+    assert rep["r1"].finished and rep["r1"].generated == [7, 8]
+    assert not rep["r2"].finished and rep["r2"].generated == [1, 2]
+    # torn tail: a partial append must not poison the prefix...
+    with open(path, "ab") as f:
+        f.write(b"deadbeef|{\"k\":\"tok\",\"rid\":\"r2\"")  # no newline/crc
+    rep = replay_journal(path)
+    assert rep["r2"].generated == [1, 2]
+    # ...and a bit-flip mid-file cuts trust at that record, not before
+    rec = _encode_record({"k": "tok", "rid": "r2", "t": 3})
+    assert _decode_record(rec) is not None
+    assert _decode_record(rec[:-5] + b"X" + rec[-4:]) is None
+
+
+def test_process_restart_replays_journal_resumes_and_dedupes(lm, tmp_path):
+    """Kill an engine mid-generation (no drain, no fin records — a real
+    crash), then rebuild from the journal on a fresh engine: every
+    incomplete request resumes to completion token-exactly, and the
+    journaled prefix equals the delivered-token count for dedup."""
+    path = os.fspath(tmp_path / "decode.wal")
+    e1 = _engine(lm, journal_path=path, journal_fsync_every=4)
+    handles = [e1.submit(p, n) for p, n, _ in lm.cases]
+    deadline = time.monotonic() + 60
+    while (e1.metrics.snapshot()["tokens_total"] < 6
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    e1.kill()
+    for h in handles:  # the crashed process's futures die typed, not hang
+        with pytest.raises(Exception):
+            h.result(timeout=10)
+
+    rep = replay_journal(path)
+    assert len(rep) == len(lm.cases)
+    assert not any(r.finished for r in rep.values())  # crash wrote no fins
+
+    e2 = _engine(lm, journal_path=path)
+    try:
+        resumed = resume_incomplete(e2, path)
+        assert len(resumed) == len(lm.cases)
+        by_prompt = {tuple(p.tolist()): ref for p, _, ref in lm.cases}
+        for rid, (handle, n_delivered) in resumed.items():
+            out = handle.result(timeout=120)
+            ref = by_prompt[tuple(rep[rid].prompt.tolist())]
+            assert np.array_equal(out.tokens, ref)  # token-exact resume
+            # idempotent-id dedup: the first n_delivered tokens are
+            # exactly what the journal proves was already produced
+            assert out.tokens[:n_delivered].tolist() == \
+                rep[rid].generated[:n_delivered]
+        assert e2.metrics.snapshot()["journal_replayed_total"] == \
+            len(lm.cases)
+        # a second replay over the now-finished journal resumes nothing
+        e2._journal.flush()  # a restart-reader only runs post-writer
+        rep2 = replay_journal(path)
+        assert all(r.finished for r in rep2.values())
+        assert resume_incomplete(e2, path) == {}
+        assert e2.decode_step_cache_size() == 1
+    finally:
+        e2.close(timeout=30)
+
+
+# ---- close() drain deadline (satellite) ------------------------------------
+
+
+def test_close_enforces_drain_deadline_force_finishes(lm):
+    """A drain that cannot complete within close(timeout) must not hang
+    the handles: stragglers complete with finish_reason="drain_timeout"
+    and the page-leak invariant still holds."""
+    eng = _engine(lm)
+    with faults.injected(
+        faults.FaultSpec(faults.DECODE_STEP, "stall", stall_s=0.4,
+                         times=10 ** 9)
+    ):
+        h = eng.submit(lm.cases[0][0], lm.cases[0][1])
+        time.sleep(0.05)  # let it admit and start stepping
+        unjoined = eng.close(timeout=0.05)
+        assert unjoined == []  # the deadline was ENFORCED, not just logged
+        out = h.result(timeout=10)
+        assert out.finish_reason == "drain_timeout"
+        assert len(out.tokens) < lm.cases[0][1]  # partial, not hung
+    eng.kv.assert_no_leaks()

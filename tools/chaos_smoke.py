@@ -19,19 +19,29 @@ actually recovered:
 - serving ejected the sick replica (circuit breaker), redispatched its
   batches, kept answering every request, and re-admitted the replica after
   the faults stopped;
-- continuous-batching decode survived a failed decode iteration (typed
-  errors for the in-flight requests, the loop kept serving), recovered
-  from page-pool exhaustion via preempt/resume, honoured a cancel
-  mid-generation, and drained with zero pages leaked;
+- continuous-batching decode came through a transient decode-step storm
+  with ZERO failed requests and token-exact outputs (quarantine +
+  re-admission through the preempt/resume path), migrated every live
+  request off a permanently sick engine — even with a fault injected
+  inside the recovery path itself — still token-exact on the original
+  handles, and replayed a simulated process crash from the durable token
+  journal on a fresh engine with already-delivered tokens deduped; plus
+  the PR 9 invariants (preempt/resume under page starvation, cancel
+  mid-generation, compile-once decode step, zero leaked pages);
 - under mixed-tenant overload at ~10x capacity (plus a transiently
   failing replica), admission control held the interactive p99 SLO, shed
   batch traffic via typed ``AdmissionRejected`` while batch kept its
   guaranteed drain share, and no request was silently dropped — verified
   from ``/metrics``, ``/tenants``, and the runlog.
 
-Exit code 0 = every fault fired AND every recovery held; 1 = any
-unrecovered fault. CI-registered next to ``tools/lint_program.py
---verify`` (see README "Resilience").
+Every phase routes its schedule through :func:`_inject`, so the gate can
+prove coverage as well as recovery: a fault point registered in
+``paddle_tpu.resilience.faults`` that no leg exercises FAILS the run —
+new fault points must arrive with their chaos leg.
+
+Exit code 0 = every fault fired AND every recovery held AND every
+registered fault point was exercised; 1 = anything less. CI-registered
+next to ``tools/lint_program.py --verify`` (see README "Resilience").
 
 Usage:
     python tools/chaos_smoke.py [--seed N] [--dir DIR] [--keep]
@@ -71,6 +81,17 @@ def check(cond, msg: str) -> None:
         raise ChaosFailure(msg)
 
 
+_EXERCISED_POINTS = set()
+
+
+def _inject(*specs, **kw):
+    """``faults.injected`` plus coverage bookkeeping: main() fails the run
+    if any ``faults.registered_points()`` entry was never scheduled."""
+    from paddle_tpu.resilience import faults
+    _EXERCISED_POINTS.update(s.point for s in specs)
+    return faults.injected(*specs, **kw)
+
+
 def _reader(n_batches=8, bs=8, seed=0):
     def reader():
         rng = np.random.RandomState(seed)
@@ -91,7 +112,7 @@ def _train_phase(root: str, seed: int) -> None:
         return pt.layers.mean((pred - y) ** 2)
 
     losses = []
-    with faults.injected(
+    with _inject(
         # one save fails with an IO error — retry_call must republish
         faults.FaultSpec(faults.CHECKPOINT_SAVE, "error", after=1, times=1),
         # two NaN-gradient steps — skip_step must drop them and continue
@@ -125,8 +146,9 @@ def _train_phase(root: str, seed: int) -> None:
               f"{trainer.bad_steps} skipped, faults={plan.stats()}")
 
 
-def _corrupt_resume_phase(root: str) -> None:
+def _corrupt_resume_phase(root: str, seed: int) -> None:
     import paddle_tpu as pt
+    from paddle_tpu.resilience import faults
     from paddle_tpu.trainer import CheckpointConfig, Trainer
 
     def net(x, y):
@@ -143,11 +165,20 @@ def _corrupt_resume_phase(root: str) -> None:
     with open(npz, "r+b") as f:  # torn write: truncate the shard mid-file
         f.truncate(max(1, os.path.getsize(npz) // 2))
 
-    trainer = Trainer(
-        lambda: net, lambda: pt.optimizer.SGD(learning_rate=0.1),
-        checkpoint_config=CheckpointConfig(root, step_interval=1000),
-    )
-    trainer.train(num_epochs=3, reader=_reader())
+    with _inject(
+        # the latest serial ALSO throws an injected IO error on load (on
+        # top of the torn write): either failure mode must quarantine it
+        # and fall back to the previous good serial
+        faults.FaultSpec(faults.CHECKPOINT_LOAD, "error", times=1),
+        seed=seed,
+    ) as plan:
+        trainer = Trainer(
+            lambda: net, lambda: pt.optimizer.SGD(learning_rate=0.1),
+            checkpoint_config=CheckpointConfig(root, step_interval=1000),
+        )
+        trainer.train(num_epochs=3, reader=_reader())
+        check(plan.all_fired(),
+              f"checkpoint-load fault never fired: {plan.stats()}")
     quarantined = [d for d in os.listdir(root) if ".corrupt" in d]
     check(bool(quarantined), f"corrupt serial not quarantined: {os.listdir(root)}")
     check(np.isfinite(float(np.asarray(trainer.variables.params["fc/w"]).sum())),
@@ -185,7 +216,7 @@ def _elastic_phase(work: str, seed: int) -> None:
         # the survivors and resume from the freshest snapshot, losing at
         # most one checkpoint interval of steps
         root = os.path.join(work, "elastic_ckpt")
-        with faults.injected(
+        with _inject(
             faults.FaultSpec(
                 faults.DEVICE_LOST, "error", after=5, times=1,
                 exc=DeviceLostError("chaos: device reclaimed",
@@ -215,7 +246,7 @@ def _elastic_phase(work: str, seed: int) -> None:
         # finish the step, drain a final save, exit cleanly with a resume
         # marker, and a fresh trainer must auto-resume from it
         root2 = os.path.join(work, "elastic_preempt")
-        with faults.injected(
+        with _inject(
             faults.FaultSpec(faults.PREEMPT_NOTICE, "preempt", after=2, times=1),
             seed=seed,
         ) as plan:
@@ -257,7 +288,7 @@ def _serving_phase(seed: int) -> None:
     try:
         check(engine.num_replicas == 2, "chaos serving phase needs 2 replicas")
         x = rng.randn(1, 5).astype(np.float32)
-        with faults.injected(
+        with _inject(
             # replica 0 fails EVERY batch: breaker must eject it and the
             # engine must keep serving on replica 1
             faults.FaultSpec(faults.SERVING_DISPATCH, "error",
@@ -290,46 +321,89 @@ def _serving_phase(seed: int) -> None:
         check(not unjoined, f"threads failed to join on close: {unjoined}")
 
 
-def _decode_phase(seed: int) -> None:
-    """Continuous-batching decode under chaos: one injected decode-step
-    fault must fail exactly the in-flight requests (typed errors, not
-    hangs) while the loop keeps serving; a starved page pool must force
-    preempt/resume; a cancel mid-generation must land; and after the full
-    drain the page pool must hold zero pages."""
+def _decode_phase(work: str, seed: int) -> None:
+    """Zero-loss continuous-batching decode under chaos — the three
+    acceptance legs of the recovery subsystem, each asserting ZERO failed
+    requests and token-exact outputs against fault-free references:
+
+    1. a transient decode-step storm (quarantine + re-admission through
+       the preempt/resume re-prefill path);
+    2. an engine gone permanently sick mid-generation, with a second
+       fault injected inside its recovery path — breaker trips, every
+       live request migrates to the healthy engine on its ORIGINAL
+       handle;
+    3. a simulated process crash (``kill()``: no drain, no finish
+       records) replayed from the durable token journal on a fresh
+       engine, already-delivered tokens deduped.
+
+    Plus the PR 9 invariants: preempt/resume under page starvation,
+    cancel mid-generation, compile-once decode step, zero leaked pages.
+    """
+    import jax.numpy as jnp
     from paddle_tpu import models
+    from paddle_tpu.models.transformer_lm import generate
     from paddle_tpu.resilience import faults
-    from paddle_tpu.serving import DecodeConfig, DecodeEngine
+    from paddle_tpu.resilience.circuit import OPEN
+    from paddle_tpu.serving import (
+        DecodeConfig,
+        DecodeEngine,
+        DecodeFleet,
+        replay_journal,
+        resume_incomplete,
+    )
 
     rng = np.random.RandomState(seed)
     spec = models.get_model("transformer_lm", seq_len=64, vocab=97,
                             d_model=32, d_inner=64, num_heads=4, n_layers=2)
     cfg = spec.extra["cfg"]
     variables = spec.model.init(0, *spec.synth_batch(2, rng))
-    # 13 usable pages vs ~21 needed by three grown slots: preemption certain
-    engine = DecodeEngine(variables, cfg, decode=DecodeConfig(
-        max_slots=3, page_size=4, max_context=40, prefill_chunk=8,
-        num_pages=14))
-    try:
-        def prompt():
-            return rng.randint(1, 97, size=(int(rng.randint(4, 12)),)
-                               ).astype(np.int32)
 
-        # leg 1: fail one decode iteration; its in-flight requests get the
-        # injected error, the loop itself must survive and keep serving
-        with faults.injected(
-            faults.FaultSpec(faults.DECODE_STEP, "error", after=3),
+    # 13 usable pages vs ~21 needed by three grown slots: page starvation
+    # and fault recovery get exercised on the same pool
+    def mk_engine(**over):
+        kw = dict(max_slots=3, page_size=4, max_context=40, prefill_chunk=8,
+                  num_pages=14, recovery_base_delay_s=0.001,
+                  recovery_max_delay_s=0.005)
+        kw.update(over)
+        return DecodeEngine(variables, cfg, decode=DecodeConfig(**kw))
+
+    # mixed-length cases with fault-free greedy references — "token-exact"
+    # in every leg below means equal to these
+    cases = []
+    for _ in range(3):
+        p = rng.randint(1, 97, size=(int(rng.randint(4, 12)),)).astype(np.int32)
+        n = int(rng.randint(10, 20))
+        ref = np.asarray(generate(variables, jnp.asarray(p[None]), n, cfg))[0]
+        cases.append((p, n, ref))
+
+    def check_exact(outs, tag):
+        for (_, _, ref), out in zip(cases, outs):
+            check(np.array_equal(out.tokens, ref),
+                  f"{tag}: output not token-exact "
+                  f"(got {list(out.tokens)}, want {ref.tolist()})")
+
+    def prompt():
+        return rng.randint(1, 97, size=(int(rng.randint(4, 12)),)
+                           ).astype(np.int32)
+
+    engine = mk_engine()
+    try:
+        # leg 1: transient decode-step storm — zero failed requests,
+        # every output token-exact
+        with _inject(
+            faults.FaultSpec(faults.DECODE_STEP, "error", after=2, times=3),
             seed=seed,
         ) as plan:
-            handles = [engine.submit(prompt(), 20) for _ in range(3)]
-            failed = 0
-            for h in handles:
-                try:
-                    h.result(timeout=120)
-                except OSError:
-                    failed += 1
+            handles = [engine.submit(p, n) for p, n, _ in cases]
+            outs = [h.result(timeout=300) for h in handles]
             check(plan.all_fired(),
-                  f"decode-step fault never fired: {plan.stats()}")
-        check(failed >= 1, "injected decode-step fault failed no request")
+                  f"decode-step storm never fired: {plan.stats()}")
+        check_exact(outs, "storm")
+        snap = engine.metrics.snapshot()
+        check(snap["errors_total"] == 0,
+              f"decode-step storm failed requests: {snap}")
+        check(snap["recovered_total"] >= 1,
+              f"storm never took the recovery path: {snap}")
 
         # leg 2: page exhaustion — mixed lengths over the starved pool;
         # every request must still finish, via preempt/resume
@@ -337,13 +411,16 @@ def _decode_phase(seed: int) -> None:
                    for _ in range(6)]
         outs = [h.result(timeout=300) for h in handles]
         check(all(o.finish_reason == "length" for o in outs),
-              f"requests lost after fault cleared: "
+              f"requests lost under page starvation: "
               f"{[o.finish_reason for o in outs]}")
         snap = engine.metrics.snapshot()
         check(snap["preempted_total"] >= 1,
               f"starved pool never preempted: {snap}")
-        check(snap["resumed_total"] == snap["preempted_total"],
-              f"preempted != resumed: {snap}")
+        # recovery re-admits ride the same resume path as preemptions, so
+        # the conservation law is: every resume is a preempt or a recover
+        check(snap["resumed_total"]
+              == snap["preempted_total"] + snap["recovered_total"],
+              f"resumed != preempted + recovered: {snap}")
 
         # leg 3: cancel mid-generation
         h = engine.submit(prompt(), 25)
@@ -356,13 +433,91 @@ def _decode_phase(seed: int) -> None:
               f"cancel ignored: {out.finish_reason}")
         check(engine.decode_step_cache_size() == 1,
               "decode step recompiled under chaos traffic")
-        print(f"[chaos] decode: step_fault_failed={failed} "
+        print(f"[chaos] decode: storm recovered={snap['recovered_total']} "
               f"preempted={snap['preempted_total']} "
-              f"resumed={snap['resumed_total']} cancel=ok")
+              f"resumed={snap['resumed_total']} cancel=ok, 0 failed")
     finally:
         unjoined = engine.close(timeout=30)
         check(not unjoined, f"decode threads failed to join: {unjoined}")
     engine.kv.assert_no_leaks()
+
+    # leg 4: engine death mid-generation — permanent step faults on A plus
+    # one inside A's own recovery path (DECODE_RECOVER escalates a rung):
+    # the breaker must trip and every live request must finish on B,
+    # token-exact, on the handle the client already holds
+    ea, eb = mk_engine(), mk_engine()
+    fleet = DecodeFleet([ea, eb])
+    try:
+        with _inject(
+            faults.FaultSpec(faults.DECODE_STEP, "error", after=1,
+                             times=10 ** 9,
+                             match={"engine": ea.metrics.engine_label}),
+            faults.FaultSpec(faults.DECODE_RECOVER, "error",
+                             match={"engine": ea.metrics.engine_label}),
+            seed=seed,
+        ) as plan:
+            handles = [ea.submit(p, n) for p, n, _ in cases]
+            outs = [h.result(timeout=300) for h in handles]
+            check(plan.all_fired(),
+                  f"migration faults never fired: {plan.stats()}")
+        check_exact(outs, "migration")
+        check(ea.breaker.state == OPEN,
+              f"sick engine's breaker not open: {ea.breaker.state}")
+        check(ea.metrics.snapshot()["migrated_total"] == len(cases),
+              f"not every request migrated: {ea.metrics.snapshot()}")
+        check(eb.metrics.snapshot()["errors_total"] == 0,
+              f"rescue engine failed requests: {eb.metrics.snapshot()}")
+        check(eb.decode_step_cache_size() == 1,
+              "rescue engine recompiled for adopted requests")
+        print(f"[chaos] decode: migrated "
+              f"{ea.metrics.snapshot()['migrated_total']} requests "
+              f"{ea.metrics.engine_label} -> {eb.metrics.engine_label}, "
+              f"0 failed")
+    finally:
+        fleet.close(timeout=30)
+
+    # leg 5: process crash + journal replay — kill() mid-generation (no
+    # drain, no finish records), then a fresh engine resumes every
+    # incomplete request from the WAL, deduping delivered tokens
+    wal = os.path.join(work, "decode.wal")
+    e1 = mk_engine(journal_path=wal, journal_fsync_every=4)
+    handles = [e1.submit(p, n) for p, n, _ in cases]
+    deadline = time.monotonic() + 120
+    while (e1.metrics.snapshot()["tokens_total"] < 6
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    e1.kill()
+    rep = replay_journal(wal)
+    check(len(rep) == len(cases), f"journal lost admits: {len(rep)}")
+    check(not any(r.finished for r in rep.values()),
+          "crash left finish records in the journal")
+    e2 = mk_engine(journal_path=wal)
+    try:
+        resumed = resume_incomplete(e2, wal)
+        check(len(resumed) == len(cases),
+              f"resumed {len(resumed)}/{len(cases)} after replay")
+        by_prompt = {tuple(p.tolist()): ref for p, _, ref in cases}
+        for rid, (rh, n_delivered) in resumed.items():
+            out = rh.result(timeout=300)
+            ref = by_prompt[tuple(rep[rid].prompt.tolist())]
+            check(np.array_equal(out.tokens, ref),
+                  f"replayed request {rid} not token-exact")
+            check(out.tokens[:n_delivered].tolist()
+                  == rep[rid].generated[:n_delivered],
+                  f"dedup prefix mismatch for {rid}")
+        e2._journal.flush()
+        check(all(r.finished for r in replay_journal(wal).values()),
+              "resumed requests never finished in the journal")
+        check(resume_incomplete(e2, wal) == {},
+              "second replay re-resumed finished requests (dedup broken)")
+        check(e2.decode_step_cache_size() == 1,
+              "replay engine recompiled for adopted requests")
+        print(f"[chaos] decode: crash-replayed {len(resumed)} requests "
+              f"from the journal, token-exact with dedup")
+    finally:
+        unjoined = e2.close(timeout=30)
+        check(not unjoined, f"replay engine threads failed to join: {unjoined}")
+    e2.kv.assert_no_leaks()
 
 
 def _overload_phase(work: str, seed: int) -> None:
@@ -460,7 +615,7 @@ def _overload_phase(work: str, seed: int) -> None:
                 bump("batch", "ok")
 
     try:
-        with faults.injected(
+        with _inject(
             # replica 0 drops a few batches mid-overload: redispatch must
             # absorb it without surfacing request errors
             faults.FaultSpec(faults.SERVING_DISPATCH, "error",
@@ -555,18 +710,26 @@ def main(argv=None) -> int:
     root = os.path.join(work, "ckpt")
     try:
         _train_phase(root, args.seed)
-        _corrupt_resume_phase(root)
+        _corrupt_resume_phase(root, args.seed)
         _elastic_phase(work, args.seed)
         _serving_phase(args.seed)
-        _decode_phase(args.seed)
+        _decode_phase(work, args.seed)
         _overload_phase(work, args.seed)
+
+        # coverage gate: a fault point nobody injects is a recovery path
+        # nobody proves — new points must arrive with their chaos leg
+        from paddle_tpu.resilience import faults
+        missing = set(faults.registered_points()) - _EXERCISED_POINTS
+        check(not missing,
+              f"registered fault points never exercised: {sorted(missing)}")
     except ChaosFailure as e:
         print(f"[chaos] FAIL: {e}", file=sys.stderr)
         return 1
     finally:
         if not args.keep and args.dir is None:
             shutil.rmtree(work, ignore_errors=True)
-    print("[chaos] OK: every injected fault fired and every recovery held")
+    print(f"[chaos] OK: every injected fault fired, every recovery held, "
+          f"all {len(_EXERCISED_POINTS)} registered fault points exercised")
     return 0
 
 
