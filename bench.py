@@ -714,7 +714,14 @@ def serve_decode_main(n_requests: int = 24) -> dict:
       stays a tax and never becomes a regression;
     - **static**: the ``generate()`` path batched ``max_slots`` at a time,
       prompts padded to a 16-token bucket and every batch member running
-      to the slowest member's budget — the pre-PR serving discipline.
+      to the slowest member's budget — the pre-PR serving discipline;
+    - **speculative**: the same traffic through draft-and-verify
+      (``spec_vs_plain_tok_per_sec``, plus the per-slot mean accepted
+      tokens per verify step — > 1.0 means each verify iteration lands
+      more than a plain step's single token);
+    - **prefix**: shared-system-prompt traffic with the radix prefix
+      cache on (``prefix_prefill_tokens_saved_frac`` — the fraction of
+      admitted prompt tokens whose prefill the tree absorbed).
 
     Prints ONE JSON line: generated tokens/sec for both paths, the ratio,
     mean step occupancy, preemption count, and whether the jitted decode
@@ -809,6 +816,50 @@ def serve_decode_main(n_requests: int = 24) -> dict:
             np.asarray(generate(variables, prompts, mnt_max, cfg))
         dt_static = time.perf_counter() - t0
 
+        # -- speculative: same traffic, draft-and-verify (self-draft) -----
+        # the ratio vs the plain continuous leg is the rolling baseline;
+        # the per-slot accepted-tokens-per-verify-step mean is the
+        # acceptance criterion (> 1.0 means speculation lands more than
+        # the one token a plain step would)
+        eng = DecodeEngine(variables, cfg, decode=DecodeConfig(
+            max_slots=slots, page_size=16, max_context=128,
+            prefill_chunk=16, spec_tokens=4),
+            draft_variables=variables, draft_cfg=cfg)
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, mnt) for p, mnt in reqs]
+        outs_s = [h.result(timeout=600) for h in handles]
+        dt_spec = time.perf_counter() - t0
+        gen_spec = sum(len(o.tokens) for o in outs_s)
+        snap_s = eng.metrics.snapshot()
+        spec_exact = all(np.array_equal(a.tokens, b.tokens)
+                         for a, b in zip(outs, outs_s))
+        spec_compile_flat = eng.verify_step_cache_size() == 1
+        k = eng.spec_tokens
+        eng.close()
+        eng.kv.assert_no_leaks()
+
+        # -- prefix cache: shared-system-prompt traffic, hot vs cold ------
+        # every prompt opens with the same 48-token (3-page) preamble;
+        # after the first prefill the radix tree serves those pages and
+        # the saved fraction of prompt tokens is the rolling baseline
+        preamble = rng.randint(1, vocab, size=(48,)).astype(np.int32)
+        preqs = []
+        for _ in range(n_requests):
+            tail = rng.randint(
+                1, vocab, size=(int(rng.randint(4, 17)),)).astype(np.int32)
+            preqs.append((np.concatenate([preamble, tail]),
+                          int(rng.randint(8, 33))))
+        eng = DecodeEngine(variables, cfg, decode=DecodeConfig(
+            max_slots=slots, page_size=16, max_context=128,
+            prefill_chunk=16, prefix_cache=True))
+        handles = [eng.submit(p, mnt) for p, mnt in preqs]
+        for h in handles:
+            h.result(timeout=600)
+        snap_p = eng.metrics.snapshot()
+        prefix_saved = eng.metrics.prefix_saved_frac()
+        eng.close()
+        eng.kv.assert_no_leaks()
+
         result["value"] = round(gen_cont / dt_cont, 1)
         result["decode_serve_journal_tok_per_sec"] = round(
             gen_journal / dt_journal, 1)
@@ -820,6 +871,19 @@ def serve_decode_main(n_requests: int = 24) -> dict:
             total_tokens / dt_static, 1)
         result["speedup_vs_static"] = round(
             (gen_cont / dt_cont) / max(total_tokens / dt_static, 1e-9), 2)
+        result["decode_serve_spec_tok_per_sec"] = round(
+            gen_spec / dt_spec, 1)
+        result["spec_vs_plain_tok_per_sec"] = round(
+            (gen_spec / dt_spec) / max(gen_cont / dt_cont, 1e-9), 3)
+        # per-slot mean: tokens landed per (slot, verify step) pair — the
+        # aggregate gauge can exceed K+1 when several slots verify at once
+        slot_steps = snap_s["spec_drafts_proposed_total"] / max(k, 1)
+        result["spec_accepted_tokens_per_verify_step"] = round(
+            snap_s["spec_tokens_total"] / max(slot_steps, 1e-9), 2)
+        result["spec_accept_rate"] = round(snap_s["spec_accept_rate"], 3)
+        result["prefix_prefill_tokens_saved_frac"] = round(prefix_saved, 3)
+        result["prefix_hit_tokens_total"] = snap_p["prefix_hit_tokens_total"]
+        result["cow_copies_total"] = snap_p["cow_copies_total"]
         result["requests"] = len(reqs)
         result["tokens_generated"] = gen_cont
         result["mean_step_occupancy"] = round(snap["mean_step_occupancy"], 2)
@@ -827,6 +891,10 @@ def serve_decode_main(n_requests: int = 24) -> dict:
         result["compile_flat"] = compile_flat
         if not compile_flat:
             result["notes"].append("decode step recompiled under traffic")
+        if not spec_compile_flat:
+            result["notes"].append("verify step recompiled under traffic")
+        if not spec_exact:
+            result["notes"].append("speculative tokens diverged from plain")
     except Exception as e:  # same robustness contract as main(): always JSON
         result["notes"].append(
             f"serve_decode_failed: {type(e).__name__}: {e}"[:300])

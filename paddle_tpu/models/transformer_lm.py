@@ -37,7 +37,8 @@ from paddle_tpu.models.transformer import (
 
 __all__ = ["get_model", "lm_forward", "generate", "generate_beam",
            "stack_decode_params", "BASE_CFG",
-           "paged_cache_shape", "paged_prefill_chunk", "paged_decode_step"]
+           "paged_cache_shape", "paged_prefill_chunk", "paged_decode_step",
+           "paged_verify_step"]
 
 
 def _ring_core(ring_mesh, window=None):
@@ -931,6 +932,106 @@ def paged_decode_step(
 
     nxt = sample(logits_of(x), rng, temperature, top_k, top_p)
     return nxt, k_pages, v_pages
+
+
+def paged_verify_step(
+    params,
+    tokens: jax.Array,
+    positions: jax.Array,
+    page_tables: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    *,
+    cfg: dict,
+    page_size: int,
+):
+    """One speculative verify iteration for ``S`` sequences: score a block
+    of ``K+1`` tokens per slot against the paged cache in a single jitted
+    call. ``tokens`` [S, K+1] holds slot ``s``'s last sampled token followed
+    by its ``K`` draft proposals; they occupy absolute positions
+    ``positions[s] .. positions[s]+K``. All K+1 K/V rows are written into
+    the slot's pages, the block attends causally over the gathered context
+    (token ``j`` sees every earlier position plus drafts ``< j`` written
+    this same call, exactly like a prefill chunk), and the return value
+    ``out`` [S, K+1] is the greedy argmax after each position — i.e.
+    ``out[s, j]`` is what sequential decode would have sampled after
+    consuming ``tokens[s, :j+1]``. The engine accepts the longest prefix
+    with ``draft[j] == out[s, j-1]``, which makes greedy speculative decode
+    token-exact by construction.
+
+    Greedy only: acceptance compares argmaxes, so sampling temperature
+    would break exactness — the engine enforces ``temperature == 0``.
+    Shapes depend only on (S, K, table width, page size, model config), so
+    this compiles once ever, same as :func:`paged_decode_step`. Rejected
+    draft positions need no device-side rollback: their K/V rows sit past
+    the accepted frontier, masked (``t > q_pos``) until the next block
+    overwrites them.
+    """
+    from paddle_tpu.models.transformer import sinusoid_position_encoding
+
+    params = params.params if hasattr(params, "params") else params
+    _paged_enforce(cfg, 0.0, None)
+    S, K1 = tokens.shape
+    P = page_tables.shape[1]
+    t_eff = P * page_size
+    D, H = cfg["d_model"], cfg["num_heads"]
+    dh = D // H
+    H_kv = cfg.get("num_kv_heads") or H
+    G = H // H_kv
+    L = cfg["n_layers"]
+    rope = cfg.get("pos_encoding", "sinusoid") == "rope"
+    window = cfg.get("attention_window")
+    scale = 1.0 / np.sqrt(dh)
+    cdt = k_pages.dtype
+    p, ln, proj, ffn, logits_of, _ = _paged_ops(params, cfg)
+
+    x = jnp.take(p("emb/embedding/word_emb"), tokens, axis=0) * (D ** 0.5)
+    pos = positions[:, None] + jnp.arange(K1, dtype=jnp.int32)  # [S, K1]
+    if rope:
+        from paddle_tpu.ops.attention import rope_tables
+
+        rope_cos, rope_sin = rope_tables(dh, max(cfg["max_len"], t_eff))
+        cos, sin = rope_cos[pos], rope_sin[pos]  # [S, K1, dh//2]
+
+        def rot(y):  # [S, K1, n, dh] rotated at each token's own position
+            half = dh // 2
+            y1, y2 = y[..., :half], y[..., half:]
+            c, s_ = cos[:, :, None, :], sin[:, :, None, :]
+            yf1, yf2 = y1.astype(jnp.float32), y2.astype(jnp.float32)
+            return jnp.concatenate(
+                [yf1 * c - yf2 * s_, yf1 * s_ + yf2 * c], -1
+            ).astype(y.dtype)
+    else:
+        pe = sinusoid_position_encoding(max(cfg["max_len"], t_eff), D)
+        x = x + pe[pos]
+    phys = page_tables[jnp.arange(S)[:, None], pos // page_size]  # [S, K1]
+    off = pos % page_size
+    live = _paged_live_mask(pos, t_eff, window)  # [S, K1, T_eff]
+
+    for i in range(L):
+        pfx = f"layer_{i}/self_attn"
+        q = proj(x, f"{pfx}/q").reshape(S, K1, H, dh)
+        k = proj(x, f"{pfx}/k").reshape(S, K1, H_kv, dh)
+        v = proj(x, f"{pfx}/v").reshape(S, K1, H_kv, dh)
+        if rope:
+            q, k = rot(q), rot(k)
+        k_pages = k_pages.at[i, phys, :, off].set(k.astype(cdt))
+        v_pages = v_pages.at[i, phys, :, off].set(v.astype(cdt))
+        kl = k_pages[i][page_tables].transpose(0, 2, 1, 3, 4).reshape(
+            S, H_kv, t_eff, dh)
+        vl = v_pages[i][page_tables].transpose(0, 2, 1, 3, 4).reshape(
+            S, H_kv, t_eff, dh)
+        qg = q.transpose(0, 2, 1, 3).reshape(S, H_kv, G, K1, dh)
+        s = jnp.einsum("skgqd,sktd->skgqt", qg, kl) * scale
+        s = jnp.where(live[:, None, None], s, -1e9)
+        ctx = jnp.einsum("skgqt,sktd->skgqd", jax.nn.softmax(s, -1), vl)
+        ctx = ctx.reshape(S, H, K1, dh).transpose(0, 2, 1, 3).reshape(
+            S, K1, D)
+        x = ln(x + proj(ctx, f"{pfx}/out"), f"layer_{i}/layer_norm")
+        x = ln(x + ffn(x, i), f"layer_{i}/layer_norm_1")
+
+    out = jnp.argmax(logits_of(x), -1).astype(jnp.int32)  # [S, K1]
+    return out, k_pages, v_pages
 
 
 BASE_CFG = dict(

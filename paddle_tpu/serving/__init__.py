@@ -78,6 +78,7 @@ from paddle_tpu.serving.kv_cache import (
     PagedKVCache,
 )
 from paddle_tpu.serving.metrics import DecodeMetrics, ServingMetrics
+from paddle_tpu.serving.prefix_cache import RadixPrefixCache
 from paddle_tpu.serving.recovery import (
     DecodeFleet,
     EngineUnhealthy,
@@ -119,6 +120,7 @@ __all__ = [
     "DecodeMetrics",
     "PagedKVCache",
     "PageAllocator",
+    "RadixPrefixCache",
     "SCRATCH_PAGE",
     "DecodeFleet",
     "EngineUnhealthy",

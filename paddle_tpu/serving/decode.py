@@ -69,6 +69,7 @@ from paddle_tpu.models.transformer_lm import (
     paged_cache_shape,
     paged_decode_step,
     paged_prefill_chunk,
+    paged_verify_step,
 )
 from paddle_tpu.observability import runlog
 from paddle_tpu.resilience import faults
@@ -84,6 +85,7 @@ from paddle_tpu.serving.engine import (
 )
 from paddle_tpu.serving.kv_cache import SCRATCH_PAGE, PagedKVCache
 from paddle_tpu.serving.metrics import DecodeMetrics
+from paddle_tpu.serving.prefix_cache import RadixPrefixCache
 from paddle_tpu.serving.recovery import (
     EngineUnhealthy,
     RequestJournal,
@@ -140,6 +142,18 @@ class DecodeConfig:
     prewarm: Optional[bool] = None
     # idle poll interval on the scheduler when no slot is active
     idle_poll_s: float = 0.02
+    # -- speculative decoding (draft-and-verify) --------------------------
+    # draft tokens proposed per verify iteration; takes effect when the
+    # engine is built with draft model params (greedy only — acceptance
+    # compares argmaxes, so temperature must stay 0.0)
+    spec_tokens: int = 4
+    # -- radix prefix cache (serving.prefix_cache) ------------------------
+    # share prompt-prefix KV pages across requests: a hit skips whole
+    # prefill chunks; pages are refcounted with copy-on-write
+    prefix_cache: bool = False
+    # page budget for the tree (LRU-evicted past it); None = unbounded,
+    # evicted only under allocator pressure
+    prefix_cache_pages: Optional[int] = None
     # -- zero-loss recovery (serving.recovery) ----------------------------
     # survive decode-step faults by quarantining the poisoned iteration
     # and re-admitting live requests through the proven resume path
@@ -240,11 +254,19 @@ class DecodeCostModel:
     the traffic that builds the model."""
 
     def __init__(self, alpha: float = 0.2, step_s: Optional[float] = None,
-                 chunk_s: Optional[float] = None):
+                 chunk_s: Optional[float] = None,
+                 verify_s: Optional[float] = None,
+                 accepted_per_step: Optional[float] = None):
         enforce(0.0 < alpha <= 1.0, f"alpha must be in (0, 1], got {alpha}")
         self.alpha = float(alpha)
         self._step_s = step_s
         self._chunk_s = chunk_s
+        # speculative decoding: per-verify-iteration cost and how many
+        # tokens one iteration lands on average (1 + accepted drafts).
+        # Without these, estimate() assumes 1 token/step — wildly
+        # pessimistic under speculation.
+        self._verify_s = verify_s
+        self._accepted = accepted_per_step
         self._lock = threading.Lock()
 
     def observe_step(self, seconds: float) -> None:
@@ -259,13 +281,35 @@ class DecodeCostModel:
                              self.alpha * seconds +
                              (1 - self.alpha) * self._chunk_s)
 
+    def observe_verify(self, seconds: float, accepted_tokens: float) -> None:
+        """One draft-and-verify iteration: its wall cost (drafting
+        included) and the tokens it landed per participating slot."""
+        with self._lock:
+            self._verify_s = (seconds if self._verify_s is None else
+                              self.alpha * seconds +
+                              (1 - self.alpha) * self._verify_s)
+            self._accepted = (accepted_tokens if self._accepted is None else
+                              self.alpha * accepted_tokens +
+                              (1 - self.alpha) * self._accepted)
+
     def estimate(self, n_chunks: int, max_new_tokens: int,
                  queue_cost: int = 0) -> Optional[float]:
-        """Predicted service latency: prefill chunks + one step per new
-        token, plus ``queue_cost`` iterations already queued ahead. None
-        while cold."""
+        """Predicted service latency: prefill chunks + decode iterations,
+        plus ``queue_cost`` iterations already queued ahead. Under
+        speculation an iteration is one verify step landing
+        ``accepted_per_step`` tokens; otherwise one step = one token.
+        None while cold."""
         with self._lock:
             step_s, chunk_s = self._step_s, self._chunk_s
+            verify_s, accepted = self._verify_s, self._accepted
+        if verify_s is not None:
+            per_iter = verify_s
+            tokens_per_iter = max(accepted if accepted else 1.0, 1.0)
+            if chunk_s is None:
+                chunk_s = verify_s
+            iters = max_new_tokens / tokens_per_iter
+            return (n_chunks * chunk_s + iters * per_iter
+                    + queue_cost * per_iter)
         if step_s is None:
             return None
         if chunk_s is None:
@@ -275,7 +319,9 @@ class DecodeCostModel:
 
     def snapshot(self) -> Dict[str, Optional[float]]:
         with self._lock:
-            return {"step_s": self._step_s, "chunk_s": self._chunk_s}
+            return {"step_s": self._step_s, "chunk_s": self._chunk_s,
+                    "verify_s": self._verify_s,
+                    "accepted_per_step": self._accepted}
 
 
 class DecodeEngine:
@@ -290,6 +336,19 @@ class DecodeEngine:
         h = eng.submit(prompt_ids, 128)                  # async
         h.cancel()                                       # mid-generation
         eng.close()                                      # graceful drain
+
+    Passing ``draft_variables`` (plus its ``draft_cfg`` when the draft is
+    a different architecture) turns on draft-and-verify speculative
+    decoding: each iteration the draft proposes ``DecodeConfig.spec_tokens``
+    tokens sequentially, one jitted ``paged_verify_step`` scores all of
+    them (plus the bonus position) against the target's paged cache, and
+    the longest draft prefix matching the target's own greedy choices is
+    accepted — token-exact vs ``generate()`` by construction. The draft
+    shares the slot page tables and allocator geometry with its own page
+    arrays, so admission/preemption bookkeeping stays single-sourced.
+    ``DecodeConfig.prefix_cache=True`` adds the radix prefix cache: hot
+    prompt prefixes prefill once and later requests adopt the shared
+    pages (refcounted, copy-on-write).
     """
 
     def __init__(
@@ -299,6 +358,8 @@ class DecodeEngine:
         *,
         config: Optional[ServingConfig] = None,
         decode: Optional[DecodeConfig] = None,
+        draft_variables=None,
+        draft_cfg: Optional[dict] = None,
     ):
         self.config = config or ServingConfig()
         self.decode_config = dconf = decode or DecodeConfig()
@@ -345,6 +406,53 @@ class DecodeEngine:
             page_size=dconf.page_size, **sample_kw))
         self._rng = (jax.random.PRNGKey(dconf.rng_seed)
                      if dconf.temperature > 0.0 else None)
+
+        # -- speculative decoding (draft-and-verify) ----------------------
+        self._spec_k = 0
+        self._draft_params = None
+        if draft_variables is not None:
+            enforce(dconf.spec_tokens >= 1,
+                    f"spec_tokens must be >= 1 with a draft model, "
+                    f"got {dconf.spec_tokens}")
+            enforce(dconf.temperature == 0.0,
+                    "speculative decoding is greedy-only: acceptance "
+                    "compares argmaxes, so temperature must be 0.0")
+            self.draft_cfg = dict(draft_cfg) if draft_cfg else self.model_cfg
+            enforce(self.draft_cfg.get("vocab") == self.model_cfg.get("vocab"),
+                    "draft and target models must share a vocabulary "
+                    f"({self.draft_cfg.get('vocab')} vs "
+                    f"{self.model_cfg.get('vocab')})")
+            dp = (draft_variables.params
+                  if hasattr(draft_variables, "params") else draft_variables)
+            self._draft_params = jax.device_put(dp)
+            self._spec_k = int(dconf.spec_tokens)
+            # the draft reads/writes THROUGH the same page tables: its own
+            # page arrays, same (num_pages, page_size) geometry, so slot
+            # bookkeeping (grow/preempt/trim) covers both caches at once
+            dshape = paged_cache_shape(self.draft_cfg, num_pages,
+                                       dconf.page_size)
+            self._dk_pages = jnp.zeros(dshape, self._cache_dtype)
+            self._dv_pages = jnp.zeros(dshape, self._cache_dtype)
+            self._draft_step = jax.jit(functools.partial(
+                paged_decode_step, cfg=self.draft_cfg,
+                page_size=dconf.page_size, temperature=0.0))
+            self._draft_prefill = jax.jit(functools.partial(
+                paged_prefill_chunk, cfg=self.draft_cfg,
+                page_size=dconf.page_size, temperature=0.0))
+            self._verify = jax.jit(functools.partial(
+                paged_verify_step, cfg=self.model_cfg,
+                page_size=dconf.page_size))
+
+        # -- radix prefix cache -------------------------------------------
+        self._prefix: Optional[RadixPrefixCache] = None
+        if dconf.prefix_cache:
+            self._prefix = RadixPrefixCache(
+                self._kv.allocator, dconf.page_size,
+                max_pages=dconf.prefix_cache_pages)
+            # device-side page copy for CoW; src/dst are traced scalars so
+            # this compiles once per page-array shape
+            self._copy_page = jax.jit(
+                lambda pages, src, dst: pages.at[:, dst].set(pages[:, src]))
 
         # tenants / scheduler / admission — same wiring as ServingEngine,
         # but deadline feasibility runs through the per-token cost model
@@ -436,6 +544,32 @@ class DecodeEngine:
             jnp.zeros((S, P), jnp.int32),
             self._k_pages, self._v_pages, key)
         jax.block_until_ready(out)
+        if self._spec_k:
+            _, self._dk_pages, self._dv_pages = self._draft_prefill(
+                self._draft_params,
+                jnp.zeros((dconf.prefill_chunk,), jnp.int32),
+                jnp.int32(0), jnp.int32(0), table0,
+                self._dk_pages, self._dv_pages, None)
+            _, self._dk_pages, self._dv_pages = self._draft_step(
+                self._draft_params, jnp.zeros((S,), jnp.int32),
+                jnp.zeros((S,), jnp.int32),
+                jnp.zeros((S, P), jnp.int32),
+                self._dk_pages, self._dv_pages, None)
+            vout, self._k_pages, self._v_pages = self._verify(
+                self._params,
+                jnp.zeros((S, self._spec_k + 1), jnp.int32),
+                jnp.zeros((S,), jnp.int32),
+                jnp.zeros((S, P), jnp.int32),
+                self._k_pages, self._v_pages)
+            jax.block_until_ready(vout)
+        if self._prefix is not None:
+            # scratch -> scratch: harmless, compiles the CoW copy
+            z = jnp.int32(SCRATCH_PAGE)
+            self._k_pages = self._copy_page(self._k_pages, z, z)
+            self._v_pages = self._copy_page(self._v_pages, z, z)
+            if self._spec_k:
+                self._dk_pages = self._copy_page(self._dk_pages, z, z)
+                self._dv_pages = self._copy_page(self._dv_pages, z, z)
         # persist the compiled keys so a restarted engine can prewarm
         from paddle_tpu.tune import warmup as tune_warmup
 
@@ -448,6 +582,11 @@ class DecodeEngine:
             name, "decode_step", save=False,
             max_slots=int(S), page_size=int(dconf.page_size),
             pages_per_slot=int(P))
+        if self._spec_k:
+            tune_warmup.record_compile(
+                name, "verify_step", save=False,
+                max_slots=int(S), spec_tokens=int(self._spec_k),
+                page_size=int(dconf.page_size), pages_per_slot=int(P))
         path = tune_warmup.manifest_path(name)
         if path:
             try:
@@ -476,7 +615,8 @@ class DecodeEngine:
 
         manifest = tune_warmup.get_manifest(self._manifest_name())
         keys = [e for e in manifest.entries()
-                if e.get("kind") in ("prefill_chunk", "decode_step")]
+                if e.get("kind") in ("prefill_chunk", "decode_step",
+                                     "verify_step")]
         if not keys:
             return 0
         with prof.record_event("decode.prewarm"):
@@ -498,9 +638,31 @@ class DecodeEngine:
         return (self._prefill._cache_size()
                 if hasattr(self._prefill, "_cache_size") else -1)
 
+    def verify_step_cache_size(self) -> int:
+        """Compiled-executable count inside the jitted verify step: 0 with
+        speculation off, and pinned at 1 under mixed traffic — the block
+        shape ``[max_slots, spec_tokens + 1]`` is static config, so the
+        verify step compiles exactly once ever."""
+        if not self._spec_k:
+            return 0
+        return (self._verify._cache_size()
+                if hasattr(self._verify, "_cache_size") else -1)
+
     @property
     def kv(self) -> PagedKVCache:
         return self._kv
+
+    @property
+    def prefix(self) -> Optional[RadixPrefixCache]:
+        """The engine's radix prefix cache (None unless
+        ``DecodeConfig.prefix_cache`` is set)."""
+        return self._prefix
+
+    @property
+    def spec_tokens(self) -> int:
+        """Draft tokens proposed per verify iteration (0 = speculation
+        off: no draft model configured)."""
+        return self._spec_k
 
     @property
     def admission(self) -> Optional[admission_mod.AdmissionController]:
@@ -723,6 +885,8 @@ class DecodeEngine:
             if not ok:
                 break  # closed AND drained, nothing in flight
             self._pending_admit.append(req)
+        if self._prefix is not None:
+            self._prefix.clear()  # drained: drop the tree's page refs
         self.metrics.set_active_slots(0)
         self.metrics.set_pages(self._kv.pages_in_use, self._kv.pages_free)
 
@@ -776,6 +940,7 @@ class DecodeEngine:
                                        np.asarray(req.generated, np.int32)])
                        if req.generated else req.prompt)
             req.chunks_done = 0
+            self._maybe_prefix_adopt(req)
             req.t_admit_pc = time.perf_counter()
             self._active.append(req)
             if resumed:
@@ -793,12 +958,69 @@ class DecodeEngine:
                         "serving.decode.queue_wait", req.t_enqueue_pc,
                         req.t_admit_pc, parent=req.trace)
 
+    def _maybe_prefix_adopt(self, req: _DecodeRequest) -> None:
+        """Consult the radix prefix cache at slot assignment: adopt the
+        longest cached page run of ``req.seq`` (capped at ``len(seq)-1`` —
+        the final token must always prefill so its logits seed the first
+        generated token) and skip the prefill chunks it fully covers.
+        When the hit boundary is not chunk-aligned, the continuation chunk
+        would write into shared pages, so the straddled pages are
+        copied-on-write first (device-side page copy; the chunk then
+        rewrites the straddled span with identical values into the private
+        pages). If the pool cannot supply the CoW pages, the hit shrinks
+        to the chunk-aligned boundary instead — never a partial adopt."""
+        if self._prefix is None:
+            return
+        self.metrics.record_prompt_tokens(len(req.seq))
+        ps = self.decode_config.page_size
+        C = self.decode_config.prefill_chunk
+        max_pages = min((len(req.seq) - 1) // ps, self._kv.pages_per_slot)
+        if max_pages <= 0:
+            return
+        pages = self._prefix.match(req.seq, max_pages)
+        m = len(pages)
+        while m > 0:
+            c0 = (m * ps) // C
+            lo = (c0 * C) // ps  # first logical page the next chunk touches
+            n_cow = 0 if (m * ps) % C == 0 else m - lo
+            if n_cow == 0 or self._kv.allocator.num_free >= n_cow:
+                break
+            m = lo  # drop the straddled tail; strictly decreasing
+        if m <= 0:
+            return
+        import jax.numpy as jnp
+
+        self._kv.adopt_pages(req.slot, pages[:m])
+        c0 = (m * ps) // C
+        cow_done = 0
+        if (m * ps) % C != 0:
+            for li in range((c0 * C) // ps, m):
+                src, dst = self._kv.private_copy(req.slot, li)
+                s, d = jnp.int32(src), jnp.int32(dst)
+                self._k_pages = self._copy_page(self._k_pages, s, d)
+                self._v_pages = self._copy_page(self._v_pages, s, d)
+                if self._spec_k:
+                    self._dk_pages = self._copy_page(self._dk_pages, s, d)
+                    self._dv_pages = self._copy_page(self._dv_pages, s, d)
+                cow_done += 1
+        req.chunks_done = c0
+        self._kv.seq_lens[req.slot] = m * ps
+        if cow_done:
+            self.metrics.record_cow(cow_done)
+        self.metrics.record_prefix_hit(m * ps, saved_chunks=c0)
+        runlog.emit("decode_prefix_hit", hit_tokens=m * ps,
+                    saved_chunks=c0, cow=cow_done,
+                    engine=self.metrics.engine_label)
+
     def _ensure_pages(self, req: _DecodeRequest, n_positions: int) -> bool:
-        """Grow ``req``'s slot to ``n_positions``, preempting the most
-        recently admitted OTHER request (LIFO) while the pool is short.
-        The kv-cache deadlock guard guarantees a lone request can always
-        grow to max_context, so this terminates."""
+        """Grow ``req``'s slot to ``n_positions``, evicting prefix-cache
+        pages first and then preempting the most recently admitted OTHER
+        request (LIFO) while the pool is short. The kv-cache deadlock
+        guard guarantees a lone request can always grow to max_context
+        once the tree is drained, so this terminates."""
         while not self._kv.ensure_capacity(req.slot, n_positions):
+            if self._prefix is not None and self._prefix.evict(1) > 0:
+                continue  # tree pages are cheaper to reclaim than preempts
             victim = next((r for r in reversed(self._active) if r is not req),
                           None)
             if victim is None:  # unreachable per the pool-size guard
@@ -868,11 +1090,20 @@ class DecodeEngine:
             last = len(req.seq) - 1 - c * C
             t0 = time.perf_counter()
             try:
+                table_row = jnp.asarray(self._kv.page_tables[req.slot])
                 tok, self._k_pages, self._v_pages = self._prefill(
                     self._params, jnp.asarray(chunk),
                     jnp.int32(c * C), jnp.int32(max(last, 0)),
-                    jnp.asarray(self._kv.page_tables[req.slot]),
+                    table_row,
                     self._k_pages, self._v_pages, self._next_key())
+                if self._spec_k:
+                    # the draft's cache must cover the same prefix so its
+                    # proposals attend real context (sampled token unused)
+                    _, self._dk_pages, self._dv_pages = self._draft_prefill(
+                        self._draft_params, jnp.asarray(chunk),
+                        jnp.int32(c * C), jnp.int32(max(last, 0)),
+                        table_row,
+                        self._dk_pages, self._dv_pages, None)
                 last_chunk = (c == n_chunks - 1)
                 tok = int(tok) if last_chunk else 0
             except Exception as e:
@@ -889,6 +1120,13 @@ class DecodeEngine:
             budget -= 1
             progressed = True
             if last_chunk:
+                if self._prefix is not None:
+                    # every fully-written page is immutable from here on
+                    # (decode writes land past len(seq)) — publish them
+                    n_full = len(req.seq) // dconf.page_size
+                    if n_full:
+                        self._prefix.insert(
+                            req.seq, self._kv.slot_pages(req.slot)[:n_full])
                 req.phase = "decode"
                 req.cur_len = len(req.seq)
                 # the final chunk's sample IS the next token after the
@@ -898,13 +1136,35 @@ class DecodeEngine:
         return progressed
 
     def _decode_step(self) -> bool:
-        """One jitted iteration over every decode-phase slot. Slots that
-        are inactive or mid-prefill get a scratch table row and position
-        0, so their garbage writes land on the scratch page and their
-        outputs are ignored — no per-slot branching inside the step."""
+        """One decode iteration: with a draft model configured, slots with
+        headroom for a full ``spec_tokens + 1`` block go through the
+        draft-and-verify path; the rest (within ``spec_tokens`` positions
+        of ``max_context``) fall back to the plain one-token step, which
+        is always exact. Both substeps keep the scratch-page discipline:
+        uninvolved slots get scratch table rows and position 0."""
+        did = False
+        handled: set = set()
+        if self._spec_k:
+            limit = self.decode_config.max_context - self._spec_k - 1
+            spec = [r for r in self._active
+                    if r.phase == "decode" and r.cur_len <= limit]
+            if spec:
+                handled = {id(r) for r in spec}
+                did = self._verify_decode_step(spec) or did
+        rest = [r for r in self._active
+                if r.phase == "decode" and id(r) not in handled]
+        if rest:
+            did = self._plain_decode_step(rest) or did
+        return did
+
+    def _plain_decode_step(self, decoding: List[_DecodeRequest]) -> bool:
+        """One jitted iteration over the given decode-phase slots. Slots
+        that are inactive or mid-prefill get a scratch table row and
+        position 0, so their garbage writes land on the scratch page and
+        their outputs are ignored — no per-slot branching inside the
+        step."""
         import jax.numpy as jnp
 
-        decoding = [r for r in self._active if r.phase == "decode"]
         if not decoding:
             return False
         for req in list(decoding):
@@ -956,6 +1216,102 @@ class DecodeEngine:
             req.cur_len += 1
             self._kv.seq_lens[req.slot] = req.cur_len
             self._append_token(req, int(nxt[req.slot]))
+        return True
+
+    def _verify_decode_step(self, spec: List[_DecodeRequest]) -> bool:
+        """One draft-and-verify iteration: K sequential draft steps
+        propose a block, one jitted verify step scores all K+1 positions
+        against the target's paged cache, and each slot accepts the
+        longest draft prefix matching the target's own greedy argmaxes
+        plus the bonus token — at least 1, at most K+1 tokens per slot
+        per iteration, token-exact vs sequential decode.
+
+        Rollback is host-side only: rejected positions sit past the
+        accepted frontier, masked until the next block overwrites them
+        (both caches), so :meth:`PagedKVCache.trim` just returns the
+        surplus pages granted for the block."""
+        import jax.numpy as jnp
+
+        K = self._spec_k
+        for req in list(spec):
+            if req not in self._active:
+                # preempted as the victim of an earlier grow this iteration
+                spec.remove(req)
+                continue
+            if not self._ensure_pages(req, req.cur_len + K + 1):
+                spec.remove(req)
+        spec = [r for r in spec if r in self._active]
+        if not spec:
+            return False
+        S = self.decode_config.max_slots
+        P = self._kv.pages_per_slot
+        tokens = np.zeros((S,), np.int32)
+        positions = np.zeros((S,), np.int32)
+        tables = np.full((S, P), SCRATCH_PAGE, np.int32)
+        for req in spec:
+            tokens[req.slot] = req.last_tok
+            positions[req.slot] = req.cur_len
+            tables[req.slot] = self._kv.page_tables[req.slot]
+        t0 = time.perf_counter()
+        try:
+            faults.inject(faults.DECODE_STEP,
+                          engine=self.metrics.engine_label)
+            tables_j = jnp.asarray(tables)
+            pos = jnp.asarray(positions)
+            cur = jnp.asarray(tokens)
+            cols = []
+            for j in range(K):
+                cur, self._dk_pages, self._dv_pages = self._draft_step(
+                    self._draft_params, cur, pos + j, tables_j,
+                    self._dk_pages, self._dv_pages, None)
+                cols.append(cur)
+            draft_mat = np.stack([np.asarray(c) for c in cols], 1)  # [S, K]
+            block = np.concatenate([tokens[:, None], draft_mat], 1)
+            out, self._k_pages, self._v_pages = self._verify(
+                self._params, jnp.asarray(block), pos, tables_j,
+                self._k_pages, self._v_pages)
+            out = np.asarray(out)
+        except Exception as e:
+            # same contract as the plain step: the iteration's K/V writes
+            # (draft and target) are lost; recovery re-prefills from host
+            if self.decode_config.recovery:
+                self._recover_step_fault(e)
+                return True
+            runlog.emit("decode_step_error", error=repr(e),
+                        engine=self.metrics.engine_label)
+            ptlog.error("verify step failed: %r", e)
+            for req in list(self._active):
+                self._fail(req, e)
+            return True
+        t1 = time.perf_counter()
+        self._note_step_ok()
+        new_tokens = 0
+        drafts_accepted = 0
+        for req in list(spec):
+            row = out[req.slot]
+            n_acc = 0
+            while (n_acc < K
+                   and int(draft_mat[req.slot, n_acc]) == int(row[n_acc])):
+                n_acc += 1
+            drafts_accepted += n_acc
+            for j in range(n_acc + 1):
+                if req not in self._active:
+                    break  # finished (eos / budget) mid-block
+                req.cur_len += 1
+                self._kv.seq_lens[req.slot] = req.cur_len
+                self._append_token(req, int(row[j]))
+                new_tokens += 1
+            if req in self._active:
+                # roll back pages granted for rejected draft positions
+                self._kv.trim(req.slot, req.cur_len)
+        self.metrics.record_verify_step(
+            len(spec), S, t1 - t0, new_tokens,
+            drafts_proposed=len(spec) * K, drafts_accepted=drafts_accepted)
+        self.cost.observe_verify(t1 - t0, new_tokens / len(spec))
+        if self._loop_trace is not None:
+            tracing.record_span(
+                "serving.decode.verify", t0, t1, parent=self._loop_trace,
+                slots=len(spec), accepted=new_tokens)
         return True
 
     # -- zero-loss recovery (serving.recovery) -----------------------------
@@ -1239,6 +1595,8 @@ class DecodeEngine:
                 break
             drained.append(req)
         self._kv.release_all()
+        if self._prefix is not None:
+            self._prefix.clear()
         for req in drained:
             if not req.handle.done():
                 req.handle._fail(exc)
@@ -1270,6 +1628,8 @@ class DecodeEngine:
             drained.append(req)
         for req in drained:
             self._finish(req, "drain_timeout")
+        if self._prefix is not None:
+            self._prefix.clear()
         self._kv.assert_no_leaks()
 
     def close(self, timeout: Optional[float] = None) -> List[str]:

@@ -54,7 +54,10 @@ class PageAllocator:
         self.num_pages = int(num_pages)
         # LIFO free list: recently-freed (cache-warm) pages are reused first
         self._free: List[int] = list(range(num_pages - 1, SCRATCH_PAGE, -1))
-        self._allocated = [False] * num_pages
+        # refcount per page: 0 = free. Prefix sharing holds extra refs on a
+        # page (the radix tree plus every slot whose table maps it), and the
+        # page returns to the free list only when the last ref drops.
+        self._refs = [0] * num_pages
 
     @property
     def num_free(self) -> int:
@@ -64,32 +67,49 @@ class PageAllocator:
     def in_use(self) -> int:
         return (self.num_pages - 1) - len(self._free)
 
+    def refcount(self, page: int) -> int:
+        return self._refs[page]
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """``n`` distinct page ids, or None if fewer than ``n`` are free."""
+        """``n`` distinct page ids (each with refcount 1), or None if fewer
+        than ``n`` are free."""
         enforce(n >= 0, f"alloc: n must be >= 0, got {n}")
         if n > len(self._free):
             return None
         out = [self._free.pop() for _ in range(n)]
         for p in out:
-            self._allocated[p] = True
+            self._refs[p] = 1
         return out
 
+    def ref(self, pages: Sequence[int]) -> None:
+        """Take an extra reference on already-allocated pages (prefix
+        sharing: a cache hit maps the same physical page into another
+        slot's table)."""
+        for p in pages:
+            enforce(SCRATCH_PAGE < p < self.num_pages,
+                    f"ref: page id {p} out of range")
+            enforce(self._refs[p] > 0,
+                    f"ref: page {p} is not allocated")
+            self._refs[p] += 1
+
     def free(self, pages: Sequence[int]) -> None:
-        """Return pages to the pool. Double-free and scratch-free are
-        programming errors and raise (a silently-tolerated double free
+        """Drop one reference per page; a page returns to the pool when its
+        refcount hits 0. Freeing an unallocated page or scratch is a
+        programming error and raises (a silently-tolerated double free
         would hand one physical page to two sequences later)."""
         for p in pages:
             enforce(SCRATCH_PAGE < p < self.num_pages,
                     f"free: page id {p} out of range")
-            enforce(self._allocated[p], f"free: page {p} is not allocated "
+            enforce(self._refs[p] > 0, f"free: page {p} is not allocated "
                     "(double free?)")
-            self._allocated[p] = False
-            self._free.append(p)
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                self._free.append(p)
 
     def assert_empty(self) -> None:
         """The no-leak invariant: after a full drain every page is back in
         the free list."""
-        leaked = [i for i, a in enumerate(self._allocated) if a]
+        leaked = [i for i, r in enumerate(self._refs) if r > 0]
         enforce(not leaked,
                 f"page leak after drain: {len(leaked)} page(s) still "
                 f"allocated: {leaked[:8]}")
@@ -130,6 +150,9 @@ class PagedKVCache:
         self.seq_lens = np.zeros((max_slots,), dtype=np.int32)
         self._slot_pages: List[List[int]] = [[] for _ in range(max_slots)]
         self._active = [False] * max_slots
+        # logical page indices this slot shares with the prefix cache (or
+        # other slots): writes into these must copy-on-write first
+        self._slot_shared: List[set] = [set() for _ in range(max_slots)]
 
     # -- slot lifecycle ----------------------------------------------------
 
@@ -148,11 +171,12 @@ class PagedKVCache:
         enforce(self._active[slot], f"release_slot: slot {slot} not active")
         pages = self._slot_pages[slot]
         n = len(pages)
-        self.allocator.free(pages)
-        self._slot_pages[slot] = []
+        self.allocator.free(pages)  # drops this slot's ref; shared pages
+        self._slot_pages[slot] = []  # survive under the prefix cache's ref
         self.page_tables[slot, :] = SCRATCH_PAGE
         self.seq_lens[slot] = 0
         self._active[slot] = False
+        self._slot_shared[slot].clear()
         return n
 
     def release_all(self) -> int:
@@ -189,6 +213,73 @@ class PagedKVCache:
         self._slot_pages[slot].extend(grant)
         return True
 
+    def trim(self, slot: int, n_positions: int) -> int:
+        """Shrink ``slot`` to exactly the pages covering positions
+        ``[0, n_positions)``, freeing the surplus (speculative rollback:
+        pages granted for a draft block whose tokens were rejected).
+        Returns the number of pages released."""
+        enforce(self._active[slot], f"trim: slot {slot} not active")
+        keep = -(-n_positions // self.page_size)  # ceil div
+        pages = self._slot_pages[slot]
+        if keep >= len(pages):
+            return 0
+        surplus = pages[keep:]
+        self.allocator.free(surplus)
+        self._slot_pages[slot] = pages[:keep]
+        self.page_tables[slot, keep:] = SCRATCH_PAGE
+        self._slot_shared[slot] = {
+            li for li in self._slot_shared[slot] if li < keep}
+        return len(surplus)
+
+    # -- prefix sharing ----------------------------------------------------
+
+    def adopt_pages(self, slot: int, pages: Sequence[int]) -> None:
+        """Map already-written ``pages`` (a prefix-cache hit) as the slot's
+        first logical pages, taking one reference per page. The slot must
+        not have grown yet — hits apply at admission, before any prefill.
+        The adopted logical indices are marked shared: a write into one
+        (a continuation chunk straddling the hit boundary) must
+        copy-on-write through :meth:`private_copy` first."""
+        enforce(self._active[slot], f"adopt_pages: slot {slot} not active")
+        enforce(not self._slot_pages[slot],
+                f"adopt_pages: slot {slot} already has pages")
+        enforce(len(pages) <= self.pages_per_slot,
+                f"adopt_pages: {len(pages)} pages exceed table width "
+                f"{self.pages_per_slot}")
+        self.allocator.ref(pages)
+        for i, p in enumerate(pages):
+            self.page_tables[slot, i] = p
+        self._slot_pages[slot] = list(pages)
+        self._slot_shared[slot] = set(range(len(pages)))
+
+    def is_shared(self, slot: int, logical_index: int) -> bool:
+        return logical_index in self._slot_shared[slot]
+
+    def shared_indices(self, slot: int) -> List[int]:
+        return sorted(self._slot_shared[slot])
+
+    def private_copy(self, slot: int, logical_index: int) -> Optional[tuple]:
+        """Copy-on-write bookkeeping: replace the shared page at
+        ``logical_index`` with a fresh private page. Returns
+        ``(src_page, dst_page)`` for the engine's device-side page copy, or
+        None when the pool is exhausted (state unchanged — caller preempts
+        or evicts). The old page keeps its other refs (prefix cache /
+        other slots); this slot's ref is dropped."""
+        enforce(self._active[slot], f"private_copy: slot {slot} not active")
+        enforce(logical_index in self._slot_shared[slot],
+                f"private_copy: slot {slot} logical page {logical_index} "
+                "is not shared")
+        grant = self.allocator.alloc(1)
+        if grant is None:
+            return None
+        src = self._slot_pages[slot][logical_index]
+        dst = grant[0]
+        self.allocator.free([src])
+        self._slot_pages[slot][logical_index] = dst
+        self.page_tables[slot, logical_index] = dst
+        self._slot_shared[slot].discard(logical_index)
+        return src, dst
+
     # -- readout -----------------------------------------------------------
 
     def active_slots(self) -> List[int]:
@@ -196,6 +287,10 @@ class PagedKVCache:
 
     def slot_page_count(self, slot: int) -> int:
         return len(self._slot_pages[slot])
+
+    def slot_pages(self, slot: int) -> List[int]:
+        """The slot's physical page ids in logical order (a copy)."""
+        return list(self._slot_pages[slot])
 
     @property
     def pages_in_use(self) -> int:

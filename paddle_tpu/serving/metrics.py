@@ -345,6 +345,16 @@ class DecodeMetrics:
         self.retries_exhausted_total = 0  # requests past their retry budget
         self.journal_records_total = 0   # WAL records appended
         self.journal_replayed_total = 0  # requests resumed from the journal
+        # speculative decoding (serving.decode.spec_* families)
+        self.verify_steps_total = 0       # draft-and-verify iterations run
+        self.spec_tokens_total = 0        # tokens appended by verify steps
+        self.spec_drafts_proposed_total = 0  # draft tokens scored
+        self.spec_drafts_accepted_total = 0  # draft tokens accepted
+        # prefix cache (serving.decode.prefix_* / cow_* families)
+        self.prompt_tokens_total = 0      # prompt tokens across admissions
+        self.prefix_hit_tokens_total = 0  # prompt tokens served from cache
+        self.prefix_saved_chunks_total = 0  # prefill chunks skipped outright
+        self.cow_copies_total = 0         # copy-on-write page copies
         # tenant-quota admission accounting (serving.tenant.* families)
         self._tenant_admitted: collections.Counter = collections.Counter()
         self._tenant_shed: collections.Counter = collections.Counter()
@@ -480,6 +490,74 @@ class DecodeMetrics:
         prof.observe("serving.decode.request_latency_seconds", latency_s,
                      labels=self._labels)
 
+    # -- speculative decoding (serving.decode.spec_* families) ---------------
+
+    def record_verify_step(self, active: int, max_slots: int, seconds: float,
+                           new_tokens: int, drafts_proposed: int,
+                           drafts_accepted: int) -> None:
+        """One draft-and-verify iteration: counts like a decode step (it
+        advances every participating slot at least one token) plus the
+        speculation ledger. ``serving.decode.spec_accept_rate`` is the
+        cumulative accepted/proposed draft-token ratio — the series the
+        watch layer's acceptance-collapse rule subscribes to."""
+        self.record_step(active, max_slots, seconds, new_tokens)
+        with self._lock:
+            self.verify_steps_total += 1
+            self.spec_tokens_total += new_tokens
+            self.spec_drafts_proposed_total += drafts_proposed
+            self.spec_drafts_accepted_total += drafts_accepted
+            proposed = self.spec_drafts_proposed_total
+            rate = (self.spec_drafts_accepted_total / proposed
+                    if proposed else 0.0)
+        prof.inc_counter("serving.decode.verify_steps_total",
+                         labels=self._labels)
+        prof.inc_counter("serving.decode.spec_tokens_total", new_tokens,
+                         labels=self._labels)
+        prof.set_gauge("serving.decode.spec_accept_rate", rate,
+                       labels=self._labels)
+
+    def spec_accept_rate(self) -> float:
+        with self._lock:
+            if not self.spec_drafts_proposed_total:
+                return 0.0
+            return (self.spec_drafts_accepted_total
+                    / self.spec_drafts_proposed_total)
+
+    def accepted_tokens_per_verify_step(self) -> float:
+        with self._lock:
+            if not self.verify_steps_total:
+                return 0.0
+            return self.spec_tokens_total / self.verify_steps_total
+
+    # -- prefix cache (serving.decode.prefix_* families) ---------------------
+
+    def record_prompt_tokens(self, n: int) -> None:
+        with self._lock:
+            self.prompt_tokens_total += n
+        prof.inc_counter("serving.decode.prompt_tokens_total", n,
+                         labels=self._labels)
+
+    def record_prefix_hit(self, hit_tokens: int, saved_chunks: int) -> None:
+        with self._lock:
+            self.prefix_hit_tokens_total += hit_tokens
+            self.prefix_saved_chunks_total += saved_chunks
+        prof.inc_counter("serving.decode.prefix_hit_tokens_total", hit_tokens,
+                         labels=self._labels)
+
+    def record_cow(self, n: int = 1) -> None:
+        with self._lock:
+            self.cow_copies_total += n
+        prof.inc_counter("serving.decode.cow_copies_total", n,
+                         labels=self._labels)
+
+    def prefix_saved_frac(self) -> float:
+        """Fraction of admitted prompt tokens whose prefill was served from
+        the prefix cache — the bench's ``prefix_prefill_tokens_saved_frac``."""
+        with self._lock:
+            if not self.prompt_tokens_total:
+                return 0.0
+            return self.prefix_hit_tokens_total / self.prompt_tokens_total
+
     # -- zero-loss recovery (serving.recovery.* families) --------------------
 
     def record_step_fault(self) -> None:
@@ -555,6 +633,18 @@ class DecodeMetrics:
                 "retries_exhausted_total": self.retries_exhausted_total,
                 "journal_records_total": self.journal_records_total,
                 "journal_replayed_total": self.journal_replayed_total,
+                "verify_steps_total": self.verify_steps_total,
+                "spec_tokens_total": self.spec_tokens_total,
+                "spec_drafts_proposed_total": self.spec_drafts_proposed_total,
+                "spec_drafts_accepted_total": self.spec_drafts_accepted_total,
+                "spec_accept_rate": (
+                    self.spec_drafts_accepted_total
+                    / self.spec_drafts_proposed_total
+                    if self.spec_drafts_proposed_total else 0.0),
+                "prompt_tokens_total": self.prompt_tokens_total,
+                "prefix_hit_tokens_total": self.prefix_hit_tokens_total,
+                "prefix_saved_chunks_total": self.prefix_saved_chunks_total,
+                "cow_copies_total": self.cow_copies_total,
                 "mean_step_occupancy": (
                     self.tokens_total / self.steps_total
                     if self.steps_total else 0.0),

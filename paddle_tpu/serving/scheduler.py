@@ -353,7 +353,12 @@ class WeightedFairScheduler:
                                  else deadline - time.monotonic())
                     if remaining is not None and remaining <= 0:
                         timed_out = True
-                    else:
+                    elif not expired:
+                        # with evicted requests in hand, skip the wait:
+                        # their on_expired callbacks must fire now (outside
+                        # the lock), not at the next notify — a caller
+                        # blocked on one of those requests may be the only
+                        # thing that would ever notify again
                         self._readable.wait(remaining)
             self._fire_expired(expired)
             if result is not None:

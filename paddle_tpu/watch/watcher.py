@@ -122,6 +122,14 @@ def default_rules() -> List[WatchRule]:
         WatchRule("serving.recovery.consecutive_faults",
                   det_mod.EwmaDetector(alpha=0.3, z_threshold=6.0,
                                        min_samples=8)),
+        # cumulative draft-acceptance ratio under speculative decoding: a
+        # collapse (inverted — anomalously LOW) means the draft has
+        # diverged from the target (stale draft weights, wrong tokenizer)
+        # and every verify step is wasted work
+        WatchRule("serving.decode.spec_accept_rate",
+                  det_mod.EwmaDetector(alpha=0.2, z_threshold=6.0,
+                                       min_samples=16),
+                  invert=True),
     ]
 
 

@@ -1,0 +1,278 @@
+"""paddle_tpu.serving.prefix_cache — radix prefix sharing tests (ISSUE 12).
+
+Unit level: the refcounted :class:`PageAllocator` (double-free/ref-on-free
+raise, pages return to the pool only on the last drop), the radix tree's
+insert/match/dedup/LRU-leaf-first-evict/clear contract, and the
+:class:`PagedKVCache` adopt / copy-on-write / speculative-trim
+bookkeeping. Engine level: churn over a shared system prefix on a
+page-starved pool (preempt + resume + tree eviction all fire) stays
+token-exact vs. :func:`generate` with the verify step compiled once, a
+copy-on-write prefill continuation stays exact, and a mid-speculation
+engine failure migrates through :class:`DecodeFleet` with every
+refcounted page accounted for afterwards (``assert_no_leaks``).
+"""
+
+import types
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import models
+from paddle_tpu.models.transformer_lm import generate
+from paddle_tpu.resilience import faults
+from paddle_tpu.serving import (
+    DecodeConfig,
+    DecodeEngine,
+    DecodeFleet,
+    PageAllocator,
+    PagedKVCache,
+    RadixPrefixCache,
+)
+
+VOCAB = 97
+
+# page-starved pool + tiny backoffs, as in test_serving_recovery: three
+# grown slots plus the prefix tree cannot all fit, so adopt/evict/preempt
+# and the recovery ladder all exercise for real
+DC = dict(max_slots=3, page_size=4, max_context=40, prefill_chunk=8,
+          num_pages=14, spec_tokens=3, prefix_cache=True,
+          recovery_base_delay_s=0.001, recovery_max_delay_s=0.005,
+          breaker_cooldown_s=0.05, breaker_max_cooldown_s=0.2)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    yield
+    faults.clear()
+
+
+# ---- allocator refcounts ---------------------------------------------------
+
+
+def test_allocator_refcount_semantics():
+    a = PageAllocator(6)  # pages 1..5 usable
+    pages = a.alloc(2)
+    assert a.num_free == 3
+    assert all(a.refcount(p) == 1 for p in pages)
+    a.ref(pages)  # prefix sharing: a second owner
+    assert all(a.refcount(p) == 2 for p in pages)
+    a.free(pages)  # first owner drops: still allocated
+    assert a.num_free == 3
+    a.free(pages)  # last owner drops: back in the pool
+    assert a.num_free == 5
+    with pytest.raises(Exception):
+        a.free([pages[0]])  # double free
+    with pytest.raises(Exception):
+        a.ref([pages[0]])  # ref on a free page
+    with pytest.raises(Exception):
+        a.free([0])  # scratch is never allocated
+    a.assert_empty()
+
+
+# ---- radix tree ------------------------------------------------------------
+
+
+def test_radix_insert_match_dedup():
+    a = PageAllocator(10)
+    pc = RadixPrefixCache(a, page_size=4)
+    toks = list(range(1, 13))  # 3 full pages
+    pages = a.alloc(3)
+    assert pc.insert(toks, pages) == 3
+    assert all(a.refcount(p) == 2 for p in pages)  # slot + tree
+    # page granularity: a trailing partial chunk never matches
+    assert pc.match(toks + [99]) == pages
+    assert pc.match(toks[:7]) == pages[:1]
+    # divergence mid-path: only the shared leading page matches
+    assert pc.match(toks[:4] + [88] * 4) == pages[:1]
+    # re-insert is a no-op — dedup falls out of the walk, no double ref
+    assert pc.insert(toks, pages) == 0
+    assert all(a.refcount(p) == 2 for p in pages)
+    # a forked prompt adds only its diverging page
+    fork = a.alloc(1)
+    assert pc.insert(toks[:8] + [77] * 4, pages[:2] + fork) == 1
+    assert pc.num_pages == 4
+    # the "slots" release; the tree alone keeps every page allocated
+    a.free(pages)
+    a.free(fork)
+    assert a.num_free == 9 - 4
+    assert pc.clear() == 4
+    a.assert_empty()
+
+
+def test_radix_evict_lru_leaf_first():
+    a = PageAllocator(12)
+    pc = RadixPrefixCache(a, page_size=2)
+    chain = a.alloc(3)
+    pc.insert([1, 2, 3, 4, 5, 6], chain)
+    a.free(chain)  # tree-only refs
+    fork = a.alloc(1)
+    pc.insert([1, 2, 77, 78], [chain[0], fork[0]])
+    a.free(fork)
+    # touch the chain so the fork is the LRU leaf
+    assert pc.match([1, 2, 3, 4, 5, 6]) == chain
+    assert pc.evict(1) == 1  # fork leaf goes first; chain intact
+    assert pc.match([1, 2, 77, 78]) == [chain[0]]
+    assert pc.match([1, 2, 3, 4, 5, 6]) == chain
+    # a leaf another owner still maps frees no capacity when dropped, so
+    # eviction keeps walking up the chain until a page actually frees
+    a.ref([chain[2]])  # simulate a slot still mapping the deep page
+    assert pc.evict(1) == 1  # drops chain[2] (still held) AND chain[1]
+    assert pc.num_pages == 1
+    assert a.refcount(chain[2]) == 1  # the "slot's" ref survives eviction
+    a.free([chain[2]])
+    pc.clear()
+    a.assert_empty()
+
+
+def test_radix_max_pages_cap_trims_on_insert():
+    a = PageAllocator(20)
+    pc = RadixPrefixCache(a, page_size=2, max_pages=3)
+    pages = a.alloc(5)
+    pc.insert(list(range(1, 11)), pages)
+    assert pc.num_pages == 3  # trimmed back to the cap, deepest-first
+    a.free(pages)
+    assert a.num_free == 19 - 3
+    pc.clear()
+    a.assert_empty()
+
+
+# ---- paged cache: adopt / copy-on-write / speculative trim -----------------
+
+
+def test_kv_adopt_cow_trim_refcounts():
+    kv = PagedKVCache(max_slots=2, page_size=4, num_pages=10,
+                      pages_per_slot=4)
+    a = kv.allocator
+    donor = a.alloc(2)  # stands in for the tree's refs
+    s = kv.acquire_slot()
+    kv.adopt_pages(s, donor)
+    assert kv.slot_pages(s) == donor
+    assert kv.shared_indices(s) == [0, 1]
+    assert all(a.refcount(p) == 2 for p in donor)
+    # a write into logical page 1 must copy-on-write: fresh private page,
+    # the donor keeps its ref on the original
+    src, dst = kv.private_copy(s, 1)
+    assert src == donor[1] and dst not in donor
+    assert kv.is_shared(s, 0) and not kv.is_shared(s, 1)
+    assert a.refcount(donor[1]) == 1 and a.refcount(dst) == 1
+    assert kv.page_tables[s, 1] == dst
+    with pytest.raises(Exception):
+        kv.private_copy(s, 1)  # already private
+    # grow for a draft block, then roll back (speculative trim)
+    assert kv.ensure_capacity(s, 16)
+    assert kv.slot_page_count(s) == 4
+    assert kv.trim(s, 5) == 2
+    assert kv.slot_page_count(s) == 2
+    assert kv.is_shared(s, 0)  # shared indices below the keep survive
+    # release drops only the slot's refs; the donor's survive
+    kv.release_slot(s)
+    assert a.refcount(donor[0]) == 1 and a.refcount(donor[1]) == 1
+    a.free(donor)
+    kv.assert_no_leaks()
+
+
+# ---- engine level ----------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lm():
+    """Tiny LM + greedy references over prompts sharing a 14-token system
+    prefix (not page- or chunk-aligned, so the copy-on-write path is
+    reachable)."""
+    spec = models.get_model("transformer_lm", seq_len=64, vocab=VOCAB,
+                            d_model=32, d_inner=64, num_heads=4, n_layers=2)
+    cfg = spec.extra["cfg"]
+    rng = np.random.RandomState(7)
+    variables = spec.model.init(0, *spec.synth_batch(2, rng))
+    sys_prefix = rng.randint(1, VOCAB, size=(14,)).astype(np.int32)
+    cases = []
+    for _ in range(6):
+        tail = rng.randint(1, VOCAB,
+                           size=(int(rng.randint(2, 8)),)).astype(np.int32)
+        prompt = np.concatenate([sys_prefix, tail])
+        n = int(rng.randint(8, 16))
+        ref = np.asarray(generate(variables, jnp.asarray(prompt[None]),
+                                  n, cfg))[0]
+        cases.append((prompt, n, ref))
+    return types.SimpleNamespace(cfg=cfg, variables=variables, cases=cases)
+
+
+def _engine(lm, **over):
+    kw = dict(DC)
+    kw.update(over)
+    return DecodeEngine(lm.variables, lm.cfg, decode=DecodeConfig(**kw),
+                        draft_variables=lm.variables, draft_cfg=lm.cfg)
+
+
+def test_shared_prefix_churn_token_exact_no_leaks(lm):
+    """The ISSUE 12 churn criterion: two rounds of shared-prefix traffic
+    on a starved pool — adopt, preempt/resume, and allocator-pressure
+    tree eviction all fire — and every output still exactly matches
+    generate(), with both jitted paths compiled once and every
+    refcounted page back in the free list after drain."""
+    eng = _engine(lm)
+    try:
+        for _ in range(2):
+            handles = [eng.submit(p, n) for p, n, _ in lm.cases]
+            outs = [h.result(timeout=300) for h in handles]
+            for (prompt, n, ref), out in zip(lm.cases, outs):
+                assert np.array_equal(out.tokens, ref), (
+                    f"prefix-shared decode diverged for Tp={len(prompt)} "
+                    f"N={n}")
+        snap = eng.metrics.snapshot()
+        assert snap["prefix_hit_tokens_total"] > 0
+        assert snap["preempted_total"] >= 1  # churn really happened
+        assert snap["verify_steps_total"] >= 1
+        assert eng.verify_step_cache_size() == 1
+        assert eng.decode_step_cache_size() == 1
+        assert eng.prefix.stats()["hits"] >= 1
+    finally:
+        eng.close()
+    eng.kv.assert_no_leaks()
+
+
+def test_prefix_cow_fires_and_stays_exact(lm):
+    """Sequential same-prefix traffic: the hit boundary (3 pages = 12
+    tokens) is not chunk-aligned (chunk = 8), so the continuation chunk
+    straddles an adopted page and must copy-on-write — outputs stay
+    exact and the donor pages stay valid for later hits."""
+    eng = _engine(lm)
+    try:
+        for prompt, n, ref in lm.cases:
+            out = eng.infer(prompt, n)
+            assert np.array_equal(out.tokens, ref)
+        snap = eng.metrics.snapshot()
+        assert snap["prefix_hit_tokens_total"] > 0
+        assert snap["cow_copies_total"] >= 1
+        assert eng.metrics.prefix_saved_frac() > 0.0
+    finally:
+        eng.close()
+    eng.kv.assert_no_leaks()
+
+
+def test_migration_mid_speculation_refcounts_clean(lm):
+    """Engine A dies mid-speculation (DECODE_STEP faults every verify
+    iteration until its breaker trips): the fleet migrates every live
+    request to B token-exactly, and BOTH engines — tree refs, adopted
+    pages, draft cache bookkeeping — drain to assert_no_leaks."""
+    ea = _engine(lm)
+    eb = _engine(lm)
+    fleet = DecodeFleet([ea, eb])
+    try:
+        with faults.injected(
+            faults.FaultSpec(faults.DECODE_STEP, "error", after=1,
+                             times=10 ** 9,
+                             match={"engine": ea.metrics.engine_label})
+        ):
+            handles = [ea.submit(p, n) for p, n, _ in lm.cases]  # pin to A
+            outs = [h.result(timeout=300) for h in handles]
+        for (_, _, ref), out in zip(lm.cases, outs):
+            assert np.array_equal(out.tokens, ref)
+        assert ea.metrics.snapshot()["migrated_total"] == len(lm.cases)
+        assert eb.metrics.snapshot()["errors_total"] == 0
+        assert eb.verify_step_cache_size() == 1
+    finally:
+        fleet.close(timeout=60)
+    ea.kv.assert_no_leaks()
+    eb.kv.assert_no_leaks()

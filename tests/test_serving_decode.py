@@ -187,3 +187,129 @@ def test_per_token_deadline_admission(lm):
         h2.cancel()
     finally:
         engine.close()
+
+
+# ---- speculative decoding (ISSUE 12) ---------------------------------------
+
+
+def test_speculative_self_draft_exact_and_compile_once(lm):
+    """Draft-and-verify under mixed-length traffic on the starved pool:
+    outputs exactly match generate() and BOTH jitted paths stay
+    compile-once — ``paged_verify_step``'s [max_slots, spec_tokens + 1]
+    block shape is static config, so admit/evict/preempt of requests
+    with six (prompt_len, budget) shapes adds no executables."""
+    engine = DecodeEngine(
+        lm.variables, lm.cfg,
+        decode=DecodeConfig(max_slots=3, page_size=4, max_context=40,
+                            prefill_chunk=8, num_pages=14, spec_tokens=3),
+        draft_variables=lm.variables, draft_cfg=lm.cfg)
+    try:
+        assert engine.verify_step_cache_size() == 1  # warmup compile only
+        handles = [engine.submit(p, n) for p, n, _ in lm.cases]
+        outs = [h.result(timeout=300) for h in handles]
+        for (prompt, n, ref), out in zip(lm.cases, outs):
+            assert np.array_equal(out.tokens, ref), (
+                f"speculative decode diverged for Tp={len(prompt)} N={n}")
+        snap = engine.metrics.snapshot()
+        assert snap["verify_steps_total"] >= 1
+        # self-draft: almost every in-budget draft is accepted, so each
+        # verify step lands more than one token on average
+        assert engine.metrics.accepted_tokens_per_verify_step() > 1.0
+        assert 0.0 < snap["spec_accept_rate"] <= 1.0
+        assert engine.verify_step_cache_size() == 1
+        assert engine.decode_step_cache_size() == 1
+    finally:
+        engine.close()
+    engine.kv.assert_no_leaks()
+
+
+def test_speculative_divergent_draft_still_exact(lm):
+    """Token-exactness must not depend on draft quality: a separately
+    seeded 1-layer draft proposes mostly-wrong tokens, the acceptance
+    rule rejects them, and the output still equals generate()."""
+    dspec = models.get_model("transformer_lm", seq_len=64, vocab=VOCAB,
+                             d_model=16, d_inner=32, num_heads=2, n_layers=1)
+    drng = np.random.RandomState(99)
+    draft_vars = dspec.model.init(1, *dspec.synth_batch(2, drng))
+    engine = DecodeEngine(
+        lm.variables, lm.cfg,
+        decode=DecodeConfig(max_slots=3, page_size=4, max_context=40,
+                            prefill_chunk=8, num_pages=14, spec_tokens=3),
+        draft_variables=draft_vars, draft_cfg=dspec.extra["cfg"])
+    try:
+        handles = [engine.submit(p, n) for p, n, _ in lm.cases[:4]]
+        outs = [h.result(timeout=300) for h in handles]
+        for (prompt, n, ref), out in zip(lm.cases[:4], outs):
+            assert np.array_equal(out.tokens, ref), (
+                f"divergent-draft decode diverged for Tp={len(prompt)}")
+        # rejection-heavy, but each verify step still lands its one
+        # target-sampled token
+        assert engine.metrics.snapshot()["verify_steps_total"] >= 1
+        assert engine.metrics.accepted_tokens_per_verify_step() >= 1.0
+    finally:
+        engine.close()
+    engine.kv.assert_no_leaks()
+
+
+@pytest.mark.parametrize("variant", [
+    {},
+    {"pos_encoding": "rope"},
+    {"num_kv_heads": 2},
+    {"attention_window": 3},
+    {"num_kv_heads": 2, "pos_encoding": "rope", "ffn_activation": "swiglu",
+     "attention_window": 4},
+], ids=["sinusoid", "rope", "gqa", "window", "modern"])
+def test_verify_step_exact_across_model_configs(variant):
+    """paged_verify_step must reproduce generate() under every cache
+    layout it special-cases: additive sinusoid PE, per-position RoPE,
+    the H_kv-head GQA cache, sliding-window masking, and all of them
+    at once."""
+    spec = models.get_model("transformer_lm", seq_len=48, vocab=VOCAB,
+                            d_model=32, d_inner=64, num_heads=4, n_layers=2,
+                            **variant)
+    cfg = spec.extra["cfg"]
+    rng = np.random.RandomState(3)
+    variables = spec.model.init(0, *spec.synth_batch(2, rng))
+    cases = []
+    for tp in (5, 9):
+        prompt = rng.randint(1, VOCAB, size=(tp,)).astype(np.int32)
+        ref = np.asarray(generate(variables, jnp.asarray(prompt[None]),
+                                  10, cfg))[0]
+        cases.append((prompt, ref))
+    engine = DecodeEngine(
+        variables, cfg,
+        decode=DecodeConfig(max_slots=2, page_size=4, max_context=32,
+                            prefill_chunk=8, num_pages=12, spec_tokens=3),
+        draft_variables=variables, draft_cfg=cfg)
+    try:
+        handles = [engine.submit(p, 10) for p, _ in cases]
+        outs = [h.result(timeout=300) for h in handles]
+        for (prompt, ref), out in zip(cases, outs):
+            assert np.array_equal(out.tokens, ref), (
+                f"verify step diverged for variant={variant} "
+                f"Tp={len(prompt)}")
+        assert engine.metrics.snapshot()["verify_steps_total"] >= 1
+        assert engine.verify_step_cache_size() == 1
+    finally:
+        engine.close()
+    engine.kv.assert_no_leaks()
+
+
+def test_cost_model_speculative_math():
+    """Under speculation one admission 'iteration' is a verify step
+    landing accepted_per_step tokens; prefill falls back to verify cost
+    when no chunk observations exist; observe_verify feeds both EMAs."""
+    cm = DecodeCostModel(chunk_s=0.05, verify_s=0.01, accepted_per_step=2.0)
+    assert cm.estimate(3, 20, queue_cost=4) == pytest.approx(
+        3 * 0.05 + (20 / 2.0) * 0.01 + 4 * 0.01)
+    # no accepted-tokens observation yet: assume 1 token/iteration;
+    # no chunk observation: chunk cost falls back to verify cost
+    assert DecodeCostModel(verify_s=0.1).estimate(1, 2) == pytest.approx(
+        1 * 0.1 + 2 * 0.1)
+    cm2 = DecodeCostModel(alpha=0.5, verify_s=0.1, accepted_per_step=1.0)
+    cm2.observe_verify(0.2, 3.0)
+    snap = cm2.snapshot()
+    assert snap["verify_s"] == pytest.approx(0.15)
+    assert snap["accepted_per_step"] == pytest.approx(2.0)
+    # the non-speculative estimate path is untouched when verify_s is cold
+    assert cm2.snapshot()["step_s"] is None

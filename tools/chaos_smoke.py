@@ -520,6 +520,162 @@ def _decode_phase(work: str, seed: int) -> None:
     e2.kv.assert_no_leaks()
 
 
+def _spec_decode_phase(work: str, seed: int) -> None:
+    """Speculative decoding + radix prefix cache under chaos (ISSUE 12):
+    ``DECODE_STEP`` faults land inside draft-and-verify iterations (the
+    quarantine path must roll the draft block back), an engine dies
+    mid-speculation and its live requests migrate token-exact, and a
+    ``kill()`` mid-speculation replays from the durable journal — with
+    the refcounted page pool (slot refs + radix tree refs + CoW copies)
+    provably empty after every drain."""
+    import jax.numpy as jnp
+    from paddle_tpu import models
+    from paddle_tpu.models.transformer_lm import generate
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.resilience.circuit import OPEN
+    from paddle_tpu.serving import (
+        DecodeConfig,
+        DecodeEngine,
+        DecodeFleet,
+        replay_journal,
+        resume_incomplete,
+    )
+
+    rng = np.random.RandomState(seed + 12)
+    spec = models.get_model("transformer_lm", seq_len=64, vocab=97,
+                            d_model=32, d_inner=64, num_heads=4, n_layers=2)
+    cfg = spec.extra["cfg"]
+    variables = spec.model.init(0, *spec.synth_batch(2, rng))
+
+    # self-draft (draft == target): acceptance stays high, so rollback,
+    # trim and the verify fast path all run; the starved 13-page pool is
+    # shared with the radix tree, so adopt/evict/preempt fire too
+    def mk_engine(**over):
+        kw = dict(max_slots=3, page_size=4, max_context=40, prefill_chunk=8,
+                  num_pages=14, spec_tokens=3, prefix_cache=True,
+                  recovery_base_delay_s=0.001, recovery_max_delay_s=0.005)
+        kw.update(over)
+        return DecodeEngine(variables, cfg, decode=DecodeConfig(**kw),
+                            draft_variables=variables, draft_cfg=cfg)
+
+    # prompts share a 14-token preamble that is neither page- nor
+    # chunk-aligned, so prefix hits AND copy-on-write are reachable
+    preamble = rng.randint(1, 97, size=(14,)).astype(np.int32)
+    cases = []
+    for _ in range(3):
+        tail = rng.randint(1, 97,
+                           size=(int(rng.randint(2, 8)),)).astype(np.int32)
+        p = np.concatenate([preamble, tail])
+        n = int(rng.randint(8, 16))
+        ref = np.asarray(generate(variables, jnp.asarray(p[None]), n, cfg))[0]
+        cases.append((p, n, ref))
+
+    def check_exact(outs, tag):
+        for (_, _, ref), out in zip(cases, outs):
+            check(np.array_equal(out.tokens, ref),
+                  f"{tag}: output not token-exact "
+                  f"(got {list(out.tokens)}, want {ref.tolist()})")
+
+    # leg 1: transient fault storm fires inside verify iterations — the
+    # draft block rolls back, requests re-prefill (hitting the warm
+    # tree), and every output is still token-exact
+    engine = mk_engine()
+    try:
+        with _inject(
+            faults.FaultSpec(faults.DECODE_STEP, "error", after=2, times=3),
+            seed=seed,
+        ) as plan:
+            handles = [engine.submit(p, n) for p, n, _ in cases]
+            outs = [h.result(timeout=300) for h in handles]
+            check(plan.all_fired(),
+                  f"verify-step storm never fired: {plan.stats()}")
+        check_exact(outs, "spec storm")
+        snap = engine.metrics.snapshot()
+        check(snap["errors_total"] == 0,
+              f"verify-step storm failed requests: {snap}")
+        check(snap["recovered_total"] >= 1,
+              f"storm never took the recovery path: {snap}")
+        check(snap["verify_steps_total"] >= 1,
+              f"traffic never went through draft-and-verify: {snap}")
+        # second round over the warm tree: prefix hits, still exact
+        handles = [engine.submit(p, n) for p, n, _ in cases]
+        check_exact([h.result(timeout=300) for h in handles], "warm prefix")
+        snap = engine.metrics.snapshot()
+        check(snap["prefix_hit_tokens_total"] > 0,
+              f"warm rerun never hit the prefix cache: {snap}")
+        check(engine.verify_step_cache_size() == 1,
+              "verify step recompiled under chaos traffic")
+        print(f"[chaos] spec decode: storm recovered="
+              f"{snap['recovered_total']} verify_steps="
+              f"{snap['verify_steps_total']} prefix_hit_tokens="
+              f"{snap['prefix_hit_tokens_total']}, 0 failed")
+    finally:
+        unjoined = engine.close(timeout=30)
+        check(not unjoined, f"spec engine threads failed to join: {unjoined}")
+    engine.kv.assert_no_leaks()
+
+    # leg 2: engine dies mid-speculation — permanent verify faults trip
+    # A's breaker; every live request finishes on B token-exact
+    ea, eb = mk_engine(), mk_engine()
+    fleet = DecodeFleet([ea, eb])
+    try:
+        with _inject(
+            faults.FaultSpec(faults.DECODE_STEP, "error", after=1,
+                             times=10 ** 9,
+                             match={"engine": ea.metrics.engine_label}),
+            seed=seed,
+        ):
+            handles = [ea.submit(p, n) for p, n, _ in cases]
+            outs = [h.result(timeout=300) for h in handles]
+        check_exact(outs, "spec migration")
+        check(ea.breaker.state == OPEN,
+              f"sick spec engine's breaker not open: {ea.breaker.state}")
+        check(ea.metrics.snapshot()["migrated_total"] == len(cases),
+              f"not every request migrated: {ea.metrics.snapshot()}")
+        check(eb.metrics.snapshot()["errors_total"] == 0,
+              f"rescue engine failed requests: {eb.metrics.snapshot()}")
+        check(eb.verify_step_cache_size() == 1,
+              "rescue engine recompiled its verify step")
+        print(f"[chaos] spec decode: migrated "
+              f"{ea.metrics.snapshot()['migrated_total']} requests "
+              f"mid-speculation, 0 failed")
+    finally:
+        fleet.close(timeout=30)
+    ea.kv.assert_no_leaks()
+    eb.kv.assert_no_leaks()
+
+    # leg 3: kill() mid-speculation — no drain, tree and slots torn down
+    # with zero leaked refs; a fresh spec engine replays the journal
+    wal = os.path.join(work, "spec_decode.wal")
+    e1 = mk_engine(journal_path=wal, journal_fsync_every=4)
+    handles = [e1.submit(p, n) for p, n, _ in cases]
+    deadline = time.monotonic() + 120
+    while (e1.metrics.snapshot()["tokens_total"] < 6
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    e1.kill()
+    e1.kv.assert_no_leaks()  # kill dropped slot refs AND the tree's refs
+    e2 = mk_engine(journal_path=wal)
+    try:
+        resumed = resume_incomplete(e2, wal)
+        check(len(resumed) == len(cases),
+              f"resumed {len(resumed)}/{len(cases)} after spec kill")
+        rep = replay_journal(wal)
+        by_prompt = {tuple(p.tolist()): ref for p, _, ref in cases}
+        for rid, (rh, n_delivered) in resumed.items():
+            out = rh.result(timeout=300)
+            ref = by_prompt[tuple(rep[rid].prompt.tolist())]
+            check(np.array_equal(out.tokens, ref),
+                  f"spec-replayed request {rid} not token-exact")
+        print(f"[chaos] spec decode: kill mid-speculation replayed "
+              f"{len(resumed)} requests token-exact, 0 leaked pages")
+    finally:
+        unjoined = e2.close(timeout=30)
+        check(not unjoined,
+              f"spec replay engine threads failed to join: {unjoined}")
+    e2.kv.assert_no_leaks()
+
+
 def _overload_phase(work: str, seed: int) -> None:
     """Mixed-tenant overload at ~10x drain capacity with a transiently
     failing replica: interactive p99 must hold its SLO, batch must shed
@@ -714,6 +870,7 @@ def main(argv=None) -> int:
         _elastic_phase(work, args.seed)
         _serving_phase(args.seed)
         _decode_phase(work, args.seed)
+        _spec_decode_phase(work, args.seed)
         _overload_phase(work, args.seed)
 
         # coverage gate: a fault point nobody injects is a recovery path
