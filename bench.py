@@ -708,6 +708,10 @@ def serve_decode_main(n_requests: int = 24) -> dict:
 
     - **continuous**: ``serving.DecodeEngine`` (paged KV cache, iteration-
       level admission; a finished request's slot refills next step);
+    - **continuous + lock check**: the same engine with the ``core.locks``
+      order detector forced on (``lock_check_overhead_pct`` — the
+      detector's whole tax, gated so leaving it on under test/chaos stays
+      cheap);
     - **continuous + journal**: the same engine with the durable token
       journal enabled (``decode_serve_journal_tok_per_sec``) — the delta
       against the first leg is the zero-loss WAL overhead, gated so it
@@ -762,6 +766,11 @@ def serve_decode_main(n_requests: int = 24) -> dict:
         total_tokens = sum(mnt for _, mnt in reqs)
 
         # -- continuous: one engine, all requests submitted up front ------
+        # lock-order checking forced OFF for this leg: it is the baseline
+        # side of lock_check_overhead_pct below (and the production
+        # default)
+        from paddle_tpu.core import locks as _locks
+        _locks.set_enabled(False)
         eng = DecodeEngine(variables, cfg, decode=DecodeConfig(
             max_slots=slots, page_size=16, max_context=128,
             prefill_chunk=16))
@@ -775,6 +784,27 @@ def serve_decode_main(n_requests: int = 24) -> dict:
                         and eng.prefill_cache_size() == 1)
         eng.close()
         eng.kv.assert_no_leaks()
+
+        # -- continuous + lock-order detector: same traffic with
+        # core.locks checking forced ON; the delta vs the leg above is the
+        # whole detector tax (per-acquire bookkeeping + edge checks),
+        # gated so the "cheap enough to leave on under test/chaos" claim
+        # stays true
+        try:
+            _locks.set_enabled(True)
+            eng = DecodeEngine(variables, cfg, decode=DecodeConfig(
+                max_slots=slots, page_size=16, max_context=128,
+                prefill_chunk=16))
+            t0 = time.perf_counter()
+            handles = [eng.submit(p, mnt) for p, mnt in reqs]
+            outs_l = [h.result(timeout=600) for h in handles]
+            dt_lock = time.perf_counter() - t0
+            gen_lock = sum(len(o.tokens) for o in outs_l)
+            eng.close()
+            eng.kv.assert_no_leaks()
+            lock_violations = len(_locks.violations())
+        finally:
+            _locks.set_enabled(None)  # back to flag/pytest resolution
 
         # -- continuous + durable journal: same traffic with the WAL on --
         # the delta vs the leg above is the whole journaling tax (CRC +
@@ -861,6 +891,15 @@ def serve_decode_main(n_requests: int = 24) -> dict:
         eng.kv.assert_no_leaks()
 
         result["value"] = round(gen_cont / dt_cont, 1)
+        result["decode_serve_lockcheck_tok_per_sec"] = round(
+            gen_lock / dt_lock, 1)
+        result["lock_check_overhead_pct"] = round(
+            100.0 * (1.0 - (gen_lock / dt_lock)
+                     / max(gen_cont / dt_cont, 1e-9)), 1)
+        if lock_violations:
+            result["notes"].append(
+                f"lock-order violations under bench traffic: "
+                f"{lock_violations}")
         result["decode_serve_journal_tok_per_sec"] = round(
             gen_journal / dt_journal, 1)
         result["journal_overhead_pct"] = round(

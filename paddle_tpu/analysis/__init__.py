@@ -8,7 +8,10 @@ Three layers, one diagnostics vocabulary (:mod:`.diagnostics`):
   ``jax.eval_shape`` and reports structural problems (lazy import: pulls
   in jax);
 * :mod:`.source_lint` — AST lint of the repo's own Python sources for
-  repo-specific invariants (stdlib only).
+  repo-specific invariants (stdlib only);
+* :mod:`.concurrency_lint` — AST lint for the locking discipline that
+  ``core.locks`` enforces at runtime (raw primitives, unbounded waits,
+  callbacks/blocking I/O under a lock).
 
 CLI: ``python -m paddle_tpu.analysis [paths...] [--verify-program DIR]``.
 """
@@ -22,6 +25,7 @@ from paddle_tpu.analysis.diagnostics import (
     format_diagnostics,
     has_errors,
 )
+from paddle_tpu.analysis.concurrency_lint import lint_concurrency
 from paddle_tpu.analysis.source_lint import lint_file, lint_source
 from paddle_tpu.analysis.verifier import (
     VerificationError,
@@ -36,6 +40,7 @@ __all__ = [
     "WARNING",
     "format_diagnostics",
     "has_errors",
+    "lint_concurrency",
     "lint_file",
     "lint_model",
     "lint_source",

@@ -1,9 +1,10 @@
 """CLI: ``python -m paddle_tpu.analysis``.
 
 Default action lints Python sources (the whole ``paddle_tpu`` package when
-no paths are given). ``--verify-program DIR`` additionally verifies an
-exported native program directory (``program.txt`` + ``weights.bin``).
-Exit status 1 when any error-severity diagnostic was produced.
+no paths are given) with both the general source lint and the concurrency
+lint. ``--verify-program DIR`` additionally verifies an exported native
+program directory (``program.txt`` + ``weights.bin``). Exit status 1 when
+any error-severity diagnostic was produced.
 """
 
 from __future__ import annotations
@@ -13,6 +14,7 @@ import os
 import sys
 from typing import List
 
+from paddle_tpu.analysis.concurrency_lint import lint_concurrency
 from paddle_tpu.analysis.diagnostics import Diagnostic, format_diagnostics, has_errors
 from paddle_tpu.analysis.source_lint import lint_source
 from paddle_tpu.analysis.verifier import verify_text
@@ -53,6 +55,7 @@ def main(argv=None) -> int:
     diags: List[Diagnostic] = []
     if not args.no_source_lint:
         diags.extend(lint_source(args.paths or None))
+        diags.extend(lint_concurrency(args.paths or None))
     if args.verify_program:
         diags.extend(_verify_program_dir(args.verify_program))
 

@@ -1,0 +1,257 @@
+"""Concurrency lint: AST checks for the locking discipline that
+``paddle_tpu.core.locks`` enforces at runtime.
+
+PR 11 and PR 12 each shipped a fix for a *pre-existing* deadlock found by
+accident (``DecodeEngine.close`` hang; ``WeightedFairScheduler.recv``
+parking while holding un-fired expiry callbacks). Both bugs had the same
+textual shape — work invoked while a lock is held, or a wait that can
+park forever — which a repo-specific static pass catches at review time.
+Rules:
+
+* ``raw-threading-lock`` — ``threading.Lock()`` / ``RLock()`` /
+  ``Condition()`` constructed anywhere in the package outside
+  ``core/locks.py`` itself: threaded subsystems must use the named,
+  instrumented ``core.locks`` wrappers so the lock-order detector and the
+  held-locks registry see every lock;
+* ``wait-without-timeout`` — zero-argument ``.wait()`` or ``.join()``:
+  an unbounded park cannot be woken by shutdown paths that race the
+  waiter (the PR 11 close-hang shape). Pass a timeout and re-check in a
+  loop;
+* ``wait-without-predicate-loop`` — ``cond.wait(...)`` on a Condition
+  not lexically inside a ``while``: stolen wakeups and ``notify_all``
+  broadcasts make a bare wait return with the predicate still false;
+* ``callback-under-lock`` — invoking a user callback / subscriber
+  (``on_*`` / ``*callback*`` names) inside a ``with <lock>:`` body: the
+  exact PR 12 bug shape (callback re-enters the lock, or blocks while
+  every other thread needs it). Collect under the lock, fire after
+  release — the pattern ``MetricRegistry._notify`` already follows;
+* ``blocking-io-under-lock`` — filesystem / sleep / subprocess / socket
+  calls inside a ``with <lock>:`` body: every thread contending that
+  lock now waits on the disk or the network.
+
+Lock-ish context expressions are recognized by name (last dotted segment
+containing ``lock``/``cond``/``mutex``) — naming a lock something else
+hides it from the lexical rules, which is the usual precision/recall
+trade for AST lint; the runtime order-graph has no such blind spot.
+
+Wired into ``python -m paddle_tpu.analysis`` and the whole-tree-clean
+test in ``tests/test_concurrency_lint.py`` (so the gate rides tier-1).
+Suppress a finding with ``# lint: allow`` on the offending line.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Sequence, Set
+
+from paddle_tpu.analysis.diagnostics import ERROR, Diagnostic
+from paddle_tpu.analysis.source_lint import _dotted, default_roots
+
+__all__ = ["lint_concurrency", "lint_file", "default_roots"]
+
+_SUPPRESS = "# lint: allow"
+
+_RAW_PRIMITIVES = ("Lock", "RLock", "Condition")
+
+# last-segment names that mark a with-context as "holding a lock"
+_LOCKISH = ("lock", "cond", "mutex")
+
+# dotted call chains that block on the filesystem / network / clock
+_BLOCKING_CALLS = {
+    "open", "time.sleep",
+    "os.fsync", "os.replace", "os.rename", "os.remove", "os.unlink",
+    "os.makedirs", "os.rmdir", "os.listdir", "os.stat",
+}
+_BLOCKING_PREFIXES = ("subprocess.", "shutil.", "socket.", "urllib.",
+                      "requests.")
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    """Does this with-context expression look like a lock acquisition?
+    Matches names/attributes whose last segment contains lock/cond/mutex
+    (``self._lock``, ``cache_lock``, ``self._cond``) and direct
+    ``.acquire()``-style helpers on such names."""
+    chain = _dotted(expr)
+    if chain is None and isinstance(expr, ast.Call):
+        chain = _dotted(expr.func)
+    if not chain:
+        return False
+    last = chain.rsplit(".", 1)[-1].lower()
+    return any(k in last for k in _LOCKISH)
+
+
+def _is_locks_module(path: str) -> bool:
+    return os.path.normpath(path).endswith(os.path.join("core", "locks.py"))
+
+
+class _CondNames(ast.NodeVisitor):
+    """Pre-pass: names assigned from ``Condition(...)`` constructors (raw
+    or ``core.locks``), so ``wait-without-predicate-loop`` does not fire
+    on ``Event.wait`` / ``Thread.join`` / queue waits."""
+
+    def __init__(self) -> None:
+        self.names: Set[str] = set()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if isinstance(node.value, ast.Call):
+            chain = _dotted(node.value.func) or ""
+            if chain.rsplit(".", 1)[-1] == "Condition":
+                for tgt in node.targets:
+                    chain_t = _dotted(tgt)
+                    if chain_t:
+                        self.names.add(chain_t.rsplit(".", 1)[-1])
+        self.generic_visit(node)
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, source_lines: List[str],
+                 cond_names: Set[str]):
+        self.path = path
+        self.lines = source_lines
+        self.cond_names = cond_names
+        self.diags: List[Diagnostic] = []
+        self._while_depth = 0
+        self._lock_depth = 0  # lexically inside a `with <lockish>:` body
+
+    def _diag(self, code: str, message: str, node: ast.AST,
+              severity: str = ERROR) -> None:
+        line_no = getattr(node, "lineno", 0)
+        src = self.lines[line_no - 1] if 0 < line_no <= len(self.lines) else ""
+        if _SUPPRESS in src:
+            return
+        self.diags.append(Diagnostic(
+            code, message, severity=severity,
+            where=f"{self.path}:{line_no}", source=src,
+        ))
+
+    # -- lexical context ---------------------------------------------------
+
+    def visit_While(self, node: ast.While) -> None:
+        self._while_depth += 1
+        self.generic_visit(node)
+        self._while_depth -= 1
+
+    def visit_With(self, node: ast.With) -> None:
+        lockish = any(_is_lockish(item.context_expr) for item in node.items)
+        for item in node.items:
+            self.visit(item)
+        if lockish:
+            self._lock_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if lockish:
+            self._lock_depth -= 1
+
+    # functions defined inside a with-block run LATER, not under the lock
+    def _visit_fn(self, node) -> None:
+        saved = self._lock_depth
+        self._lock_depth = 0
+        self.generic_visit(node)
+        self._lock_depth = saved
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+    visit_Lambda = _visit_fn
+
+    # -- rules -------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        chain = _dotted(node.func) or ""
+        last = chain.rsplit(".", 1)[-1] if chain else ""
+
+        # raw-threading-lock: threading.Lock/RLock/Condition constructors
+        if chain in tuple(f"threading.{p}" for p in _RAW_PRIMITIVES):
+            self._diag(
+                "raw-threading-lock",
+                f"{chain}() bypasses the lock-order detector and the "
+                "held-locks registry; use the named core.locks wrapper "
+                f"(locks.{chain.rsplit('.', 1)[-1]}(name='subsystem.role'))",
+                node,
+            )
+
+        # wait-without-timeout: zero-arg .wait() / .join()
+        if last in ("wait", "join") and not node.args and not node.keywords \
+                and isinstance(node.func, ast.Attribute):
+            self._diag(
+                "wait-without-timeout",
+                f".{last}() with no timeout parks forever if the notifier "
+                "races shutdown (the DecodeEngine.close hang shape); pass "
+                "a timeout and re-check the predicate in a loop",
+                node,
+            )
+
+        # wait-without-predicate-loop: cond.wait(...) outside a while
+        if last == "wait" and isinstance(node.func, ast.Attribute):
+            recv = _dotted(node.func.value)
+            recv_last = recv.rsplit(".", 1)[-1] if recv else ""
+            if recv_last in self.cond_names and not self._while_depth:
+                self._diag(
+                    "wait-without-predicate-loop",
+                    f"{recv}.wait() outside a while-predicate loop: "
+                    "notify_all broadcasts and stolen wakeups return with "
+                    "the predicate still false — use "
+                    "`while not pred: cond.wait(timeout)`",
+                    node,
+                )
+
+        # rules that only apply inside a `with <lock>:` body
+        if self._lock_depth:
+            if last.startswith("on_") or "callback" in last.lower():
+                self._diag(
+                    "callback-under-lock",
+                    f"{chain or last}(...) invoked while holding a lock — "
+                    "the WeightedFairScheduler.recv deadlock shape (PR 12): "
+                    "the callback can re-enter the lock or block every "
+                    "other thread; collect under the lock, fire after "
+                    "release",
+                    node,
+                )
+            elif chain in _BLOCKING_CALLS or any(
+                    chain.startswith(p) for p in _BLOCKING_PREFIXES):
+                self._diag(
+                    "blocking-io-under-lock",
+                    f"{chain}(...) inside a `with lock:` body serializes "
+                    "every contending thread behind the disk/network; move "
+                    "the I/O outside the critical section",
+                    node,
+                )
+        self.generic_visit(node)
+
+
+def lint_file(path: str, text: Optional[str] = None) -> List[Diagnostic]:
+    """Lint one Python file for concurrency-discipline violations."""
+    if text is None:
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+    if _is_locks_module(path):
+        return []  # the wrapper module itself owns the raw primitives
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError as e:
+        return [Diagnostic("syntax-error", str(e),
+                           where=f"{path}:{e.lineno or 0}")]
+    pre = _CondNames()
+    pre.visit(tree)
+    linter = _Linter(path, text.splitlines(), pre.names)
+    linter.visit(tree)
+    return linter.diags
+
+
+def lint_concurrency(paths: Optional[Sequence[str]] = None) -> List[Diagnostic]:
+    """Lint a set of files/directories (default: the paddle_tpu package)."""
+    targets: List[str] = []
+    for p in (list(paths) if paths else default_roots()):
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                targets.extend(
+                    os.path.join(dirpath, f)
+                    for f in sorted(filenames) if f.endswith(".py")
+                )
+        else:
+            targets.append(p)
+    diags: List[Diagnostic] = []
+    for path in targets:
+        diags.extend(lint_file(path))
+    return diags

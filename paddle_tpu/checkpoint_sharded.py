@@ -30,6 +30,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import numpy as np
 
+from paddle_tpu.core import locks
 from paddle_tpu.core import logging as ptlog
 from paddle_tpu.core import profiler as prof
 from paddle_tpu.core.enforce import enforce
@@ -242,10 +243,10 @@ class AsyncSaveHandle:
 
 _pending: Optional[AsyncSaveHandle] = None
 # guards the _pending slot itself (read/clear); cheap, never held across IO
-_pending_lock = threading.Lock()
+_pending_lock = locks.Lock("checkpoint.async_pending")
 # serializes whole save entries: two threads calling save_sharded_async
 # concurrently would otherwise both drain, snapshot, and race the slot
-_save_lock = threading.RLock()
+_save_lock = locks.RLock("checkpoint.save")
 
 
 def wait_pending_save(timeout: Optional[float] = None) -> Optional[str]:

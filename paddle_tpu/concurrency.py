@@ -37,6 +37,8 @@ import threading
 import time
 from typing import Any, Callable, Iterable, Optional, Sequence
 
+from paddle_tpu.core import locks
+
 __all__ = [
     "Channel",
     "ChannelClosedError",
@@ -87,9 +89,9 @@ class Channel:
             raise ValueError(f"capacity must be >= 0, got {capacity}")
         self.capacity = int(capacity)
         self.dtype = dtype
-        self._lock = threading.Lock()
-        self._readable = threading.Condition(self._lock)  # value available
-        self._movement = threading.Condition(self._lock)  # any state change
+        self._lock = locks.Lock("concurrency.channel")
+        self._readable = locks.Condition(self._lock, name="concurrency.channel.readable")  # value available
+        self._movement = locks.Condition(self._lock, name="concurrency.channel.movement")  # any state change
         self._buf: collections.deque = collections.deque()
         self._senders: collections.deque[_Waiter] = collections.deque()
         self._recv_waiting = 0  # receivers parked in recv() (select peeks)

@@ -98,6 +98,10 @@ class Flags:
     tenant_byte_quota: int = 0
     # priority class for requests that don't specify one
     tenant_default_class: str = "interactive"
+    # lock-order deadlock detection for core.locks instrumented wrappers
+    # (always on under pytest and tools/chaos_smoke.py; this flag turns it
+    # on elsewhere — env PADDLE_TPU_LOCK_CHECK=1)
+    lock_check: bool = False
     # guaranteed batch-class drain share under interactive overload
     tenant_batch_min_share: float = 0.1
 

@@ -11,6 +11,8 @@ import logging
 import sys
 import threading
 
+from paddle_tpu.core import locks
+
 _logger = logging.getLogger("paddle_tpu")
 if not _logger.handlers:
     _h = logging.StreamHandler(sys.stderr)
@@ -41,7 +43,7 @@ def warning(msg: str, *args) -> None:
 
 
 _warned_once: set = set()
-_warned_once_lock = threading.Lock()
+_warned_once_lock = locks.Lock("core.warn_once")
 
 
 def warn_once(key, msg: str, *args) -> bool:

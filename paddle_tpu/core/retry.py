@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Any, Callable, Iterator, Optional, Sequence, Tuple, Type, Union
 
+from paddle_tpu.core import locks
 from paddle_tpu.core.enforce import enforce
 
 __all__ = [
@@ -107,7 +108,7 @@ class RetryBudget:
         self._clock = clock
         self._tokens = float(burst)
         self._last = clock()
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("core.retry_budget")
         self.taken_total = 0
         self.exhausted_total = 0
 

@@ -32,6 +32,7 @@ import dataclasses
 import threading
 from typing import Optional
 
+from paddle_tpu.core import locks
 from paddle_tpu.observability import exporter, metrics, mfu, runlog
 from paddle_tpu.observability.exporter import MetricsServer, render_text
 from paddle_tpu.observability.metrics import (
@@ -93,7 +94,7 @@ class ObservabilityConfig:
         )
 
 
-_lock = threading.Lock()
+_lock = locks.Lock("observability.install")
 _server: Optional[MetricsServer] = None
 _owned_runlog: Optional[RunLog] = None
 

@@ -260,6 +260,10 @@ class _Handler(BaseHTTPRequestHandler):
             body, status = self._tenants()
             self.send_response(status)
             self.send_header("Content-Type", JSON_CONTENT_TYPE)
+        elif path == "/locks":
+            body, status = self._locks()
+            self.send_response(status)
+            self.send_header("Content-Type", JSON_CONTENT_TYPE)
         else:
             body = b"not found\n"
             self.send_response(404)
@@ -338,6 +342,30 @@ class _Handler(BaseHTTPRequestHandler):
         return json.dumps(snaps).encode() + b"\n", 200
 
     @staticmethod
+    def _locks() -> Tuple[bytes, int]:
+        """The ``core.locks`` view of the process: whether order checking
+        is on, every held instrumented lock (owner, hold seconds,
+        waiters), the observed lock-order graph, and any recorded
+        order violations — the first page to pull on a live stall."""
+        from paddle_tpu.core import locks as _locks
+
+        try:
+            doc = {
+                "enabled": _locks.enabled(),
+                "held": _locks.held_snapshot(),
+                "order_graph": _locks.graph_snapshot(),
+                "violations": [
+                    {k: v for k, v in rec.items()
+                     if k not in ("stack", "other_stack")}
+                    for rec in _locks.violations()
+                ],
+                "violation_count": len(_locks.violations()),
+            }
+        except Exception as e:  # never take the exporter down with locks
+            return (json.dumps({"error": repr(e)}).encode() + b"\n", 500)
+        return json.dumps(doc).encode() + b"\n", 200
+
+    @staticmethod
     def _trace() -> Tuple[bytes, int]:
         """The current merged Chrome-trace document — save the response
         body and load it straight into chrome://tracing / Perfetto."""
@@ -359,9 +387,10 @@ class MetricsServer:
     JSON), ``/trace`` (the current merged Chrome-trace document from
     ``paddle_tpu.tracing``), ``/alerts?n=&source=`` (recent alerts from
     the ``paddle_tpu.watch`` hub), ``/slo`` (installed SLO engines'
-    current compliance/burn-rate status), and ``/tenants`` (installed
+    current compliance/burn-rate status), ``/tenants`` (installed
     serving admission controllers' per-tenant quotas, queue depths, and
-    shed/brownout state)."""
+    shed/brownout state), and ``/locks`` (the ``core.locks`` held-locks
+    registry, lock-order graph, and any recorded order violations)."""
 
     def __init__(self, registry: Optional[obs_metrics.MetricRegistry] = None,
                  host: str = "127.0.0.1", port: int = 0):

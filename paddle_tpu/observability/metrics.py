@@ -24,6 +24,7 @@ import bisect
 import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from paddle_tpu.core import locks
 from paddle_tpu.core import enforce
 
 __all__ = [
@@ -168,7 +169,7 @@ class MetricRegistry:
     """Thread-safe registry of typed metric families."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("observability.metric_registry")
         self._families: Dict[str, _Family] = {}
         # write subscribers: called AFTER the lock is released with
         # (name, kind, value, labels_dict) for every inc/set/observe —

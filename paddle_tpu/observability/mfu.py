@@ -24,6 +24,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional, Tuple
 
+from paddle_tpu.core import locks
+
 __all__ = [
     "PEAK_FLOPS_TABLE",
     "peak_flops",
@@ -49,7 +51,7 @@ PEAK_FLOPS_TABLE: Tuple[Tuple[str, float], ...] = (
     ("cpu", 5e10),
 )
 
-_override_lock = threading.Lock()
+_override_lock = locks.Lock("observability.mfu_override")
 _override: Optional[float] = None
 
 
@@ -143,7 +145,7 @@ class GoodputTracker:
     loss — ...)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("observability.goodput")
         self._good_s = 0.0
         self._bad_s: Dict[str, float] = {}
 

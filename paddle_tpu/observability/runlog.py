@@ -35,6 +35,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from paddle_tpu.core import locks
 from paddle_tpu.core import enforce
 
 __all__ = [
@@ -84,7 +85,7 @@ class RunLog:
         self.path = path
         self.max_bytes = int(max_bytes)
         self.keep = int(keep)
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("observability.runlog")
         self._fh = open(path, "a", buffering=1)
         self._size = self._fh.tell()
         self._closed = False
@@ -133,7 +134,7 @@ class RunLog:
 
 
 _active: Optional[RunLog] = None
-_install_lock = threading.Lock()
+_install_lock = locks.Lock("observability.runlog_install")
 
 
 def set_runlog(runlog: Optional[RunLog]) -> Optional[RunLog]:

@@ -26,6 +26,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from paddle_tpu.core import locks
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.core.retry import next_backoff
 
@@ -54,7 +55,7 @@ class CircuitBreaker:
         self.jitter = float(jitter)
         self._clock = clock
         self._rng = rng
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("resilience.circuit_breaker")
         self._state = CLOSED
         self._consecutive_failures = 0
         self._open_count = 0     # successive trips without a success between

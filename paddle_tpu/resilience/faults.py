@@ -38,6 +38,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from paddle_tpu.core import locks
 from paddle_tpu.core import logging as ptlog
 from paddle_tpu.core import profiler as prof
 from paddle_tpu.core.enforce import enforce, enforce_in
@@ -176,7 +177,7 @@ class FaultPlan:
     def __init__(self, specs: List[FaultSpec], seed: int = 0):
         self.specs = list(specs)
         self.rng = random.Random(seed)
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("resilience.fault_plan")
 
     def stats(self) -> Dict[str, int]:
         """point -> total faults fired (summed over specs)."""

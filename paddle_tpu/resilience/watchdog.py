@@ -5,7 +5,9 @@ dead peer, a device driver stall) looks identical to a slow one from the
 outside — the reference stack's answer was an operator timeout plus glog;
 ours is a monitor thread armed around each step. When an armed region
 exceeds ``timeout_s`` the watchdog dumps EVERY thread's Python stack to
-the log (the armed thread highlighted), bumps the
+the log (the armed thread highlighted) together with the ``core.locks``
+held-locks table (who holds what, for how long, with how many waiters —
+the first question a stall post-mortem asks), bumps the
 ``resilience.watchdog_stalls`` counter, and invokes ``on_stall`` — it
 never kills the step, because a stall that eventually completes must not
 be turned into a failure by its own diagnostics. Escalation is the
@@ -32,6 +34,7 @@ import traceback
 from contextlib import contextmanager
 from typing import Callable, Optional
 
+from paddle_tpu.core import locks
 from paddle_tpu.core import logging as ptlog
 from paddle_tpu.core import profiler as prof
 from paddle_tpu.core.enforce import enforce
@@ -67,8 +70,8 @@ class StepWatchdog:
         self.on_stall = on_stall
         self.stalls = 0  # regions that exceeded the timeout
         self._clock = clock
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
+        self._lock = locks.Lock("resilience.watchdog")
+        self._cond = locks.Condition(self._lock, name="resilience.watchdog.cond")
         self._armed = None  # (generation, deadline, tag, thread_id, t_start)
         self._gen = 0
         self._closed = False
@@ -108,10 +111,16 @@ class StepWatchdog:
         self._thread.join(timeout=5)
 
     def _monitor(self) -> None:
-        with self._cond:
-            while not self._closed:
+        while True:
+            stall = None
+            with self._cond:
+                if self._closed:
+                    return
                 if self._armed is None:
-                    self._cond.wait()
+                    # bounded idle wait: a lost close() notify (killed
+                    # process, racing shutdown) must not park this thread
+                    # forever — re-check _closed each second
+                    self._cond.wait(timeout=1.0)
                     continue
                 gen, deadline, tag, tid, t_start = self._armed
                 now = self._clock()
@@ -122,27 +131,30 @@ class StepWatchdog:
                 # Fire once per region (re-arm happens on the next step).
                 self._armed = None
                 self.stalls += 1
-                elapsed = now - t_start
-                dump = dump_all_stacks(highlight_thread_id=tid)
-                self._cond.release()
-                try:  # log + callback outside the lock: they may be slow
-                    prof.inc_counter("resilience.watchdog_stalls")
-                    # which spans every thread was inside when it wedged —
-                    # the trace-level complement of the Python stacks below
-                    open_spans = self._active_span_summary()
-                    runlog.emit("watchdog_stall", tag=tag,
-                                elapsed_s=round(elapsed, 3),
-                                open_spans=open_spans)
-                    ptlog.error(
-                        "watchdog: %s exceeded %.1fs (%.1fs elapsed); "
-                        "open spans: %s; thread stacks:\n%s",
-                        tag, self.timeout_s, elapsed,
-                        ", ".join(open_spans) or "none", dump,
-                    )
-                    if self.on_stall is not None:
-                        self.on_stall(tag, elapsed)
-                finally:
-                    self._cond.acquire()
+                stall = (tag, tid, now - t_start)
+            # diagnostics + user callback run with NO lock held: they may
+            # be slow, and on_stall re-entering arm()/disarm() must not
+            # deadlock (the callback-under-lock shape PR 12 fixed in the
+            # scheduler)
+            tag, tid, elapsed = stall
+            dump = dump_all_stacks(highlight_thread_id=tid)
+            prof.inc_counter("resilience.watchdog_stalls")
+            # which spans every thread was inside when it wedged — the
+            # trace-level complement of the Python stacks below
+            open_spans = self._active_span_summary()
+            held = locks.held_snapshot()
+            runlog.emit("watchdog_stall", tag=tag,
+                        elapsed_s=round(elapsed, 3),
+                        open_spans=open_spans, held_locks=held)
+            ptlog.error(
+                "watchdog: %s exceeded %.1fs (%.1fs elapsed); "
+                "open spans: %s; thread stacks:\n%s\nheld locks:\n%s",
+                tag, self.timeout_s, elapsed,
+                ", ".join(open_spans) or "none", dump,
+                locks.render_held_table(),
+            )
+            if self.on_stall is not None:
+                self.on_stall(tag, elapsed)
 
     @staticmethod
     def _active_span_summary() -> list:

@@ -38,6 +38,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from paddle_tpu.core import locks
 from paddle_tpu.core import config as cfg
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.observability import metrics as obs_metrics
@@ -133,7 +134,7 @@ class TokenBucket:
         self._clock = clock
         self._tokens = float(burst)
         self._last = clock()
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("serving.token_bucket")
 
     def try_take(self, n: float = 1.0) -> bool:
         with self._lock:
@@ -215,7 +216,7 @@ class AdmissionController:
         self.brownout_min_s = float(brownout_min_s)
         self.deadline_quantile = float(deadline_quantile)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("serving.admission")
         self._brownout_level = 0
         self._brownout_since: Optional[float] = None
         self._brownout_reason = ""
@@ -390,7 +391,7 @@ class AdmissionController:
 
 # -- process-wide install (what the exporter's /tenants endpoint serves) -----
 
-_installed_lock = threading.Lock()
+_installed_lock = locks.Lock("serving.admission_install")
 _installed: List[AdmissionController] = []
 
 

@@ -58,6 +58,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional
 import jax
 import numpy as np
 
+from paddle_tpu.core import locks
 from paddle_tpu import observability, tracing
 from paddle_tpu.concurrency import ChannelClosedError, go
 from paddle_tpu.core import config as cfg_mod
@@ -267,7 +268,7 @@ class DecodeCostModel:
         # pessimistic under speculation.
         self._verify_s = verify_s
         self._accepted = accepted_per_step
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("serving.decode_cost_model")
 
     def observe_step(self, seconds: float) -> None:
         with self._lock:
@@ -490,7 +491,7 @@ class DecodeEngine:
         self._resume: Deque[_DecodeRequest] = deque()
         self._pending_admit: Deque[_DecodeRequest] = deque()
         self._closed = False
-        self._close_lock = threading.Lock()
+        self._close_lock = locks.Lock("serving.decode_close")
         # zero-loss recovery state (serving.recovery)
         self._breaker = CircuitBreaker(
             failure_threshold=dconf.unhealthy_after,

@@ -47,6 +47,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 import jax
 import numpy as np
 
+from paddle_tpu.core import locks
 from paddle_tpu import tracing
 from paddle_tpu.concurrency import Channel, ChannelClosedError, go
 from paddle_tpu.core import config as cfg
@@ -304,11 +305,11 @@ class ServingEngine:
             if self._watcher is not None and self.config.anomaly_eject:
                 self._watcher.hub.register_action(self._on_alert)
         self._closed = False
-        self._close_lock = threading.Lock()
+        self._close_lock = locks.Lock("serving.engine_close")
         self._rr = 0  # round-robin cursor (guarded by _pick_lock)
         # replica picking happens on the batcher thread AND on worker
         # threads redispatching a failed batch
-        self._pick_lock = threading.Lock()
+        self._pick_lock = locks.Lock("serving.engine_pick")
 
         base_place = place or cfg.default_place()
         platform = base_place.platform

@@ -22,6 +22,7 @@ import math
 import threading
 from typing import Dict, Optional
 
+from paddle_tpu.core import locks
 from paddle_tpu.core import profiler as prof
 from paddle_tpu.observability import metrics as obs_metrics
 
@@ -47,7 +48,7 @@ class ServingMetrics:
 
     def __init__(self, latency_window: int = 8192,
                  engine_label: Optional[str] = None):
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("serving.metrics")
         self.engine_label = engine_label or f"serving{next(_ENGINE_SEQ)}"
         self._labels = {"engine": self.engine_label}
         obs_metrics.default_registry().histogram(
@@ -306,7 +307,7 @@ class DecodeMetrics:
     returns a plain dict for tests and the bench CLI."""
 
     def __init__(self, engine_label: Optional[str] = None):
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("serving.decode_metrics")
         self.engine_label = engine_label or f"decode{next(_ENGINE_SEQ)}"
         self._labels = {"engine": self.engine_label}
         reg = obs_metrics.default_registry()

@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from paddle_tpu.core import locks
 from paddle_tpu.core import logging as ptlog
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.observability import runlog
@@ -147,12 +148,13 @@ class RequestJournal:
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         self._f = open(path, "ab")
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("serving.request_journal")
         self._unsynced = 0
         self.records_total = 0
 
     def _append(self, obj: Dict[str, Any]) -> None:
         data = _encode_record(obj)
+        need_sync = False
         with self._lock:
             if self._f.closed:
                 return  # journal detached mid-flight (engine killed)
@@ -160,9 +162,22 @@ class RequestJournal:
             self.records_total += 1
             self._unsynced += 1
             if self._unsynced >= self.fsync_every:
-                self._f.flush()
-                os.fsync(self._f.fileno())
                 self._unsynced = 0
+                need_sync = True
+        if need_sync:
+            self._sync()
+
+    def _sync(self) -> None:
+        """flush+fsync OUTSIDE the append lock: fsync covers every byte
+        written before the call, so a concurrent append only widens the
+        sync, never narrows it — and the ms-scale syscall no longer stalls
+        other writer threads behind the disk (BufferedWriter serializes
+        the flush internally)."""
+        try:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        except (ValueError, OSError):
+            pass  # journal closed mid-flight; close() already synced
 
     def log_admit(self, rid: str, prompt: np.ndarray, mnt: int,
                   gen_prefix: List[int], tenant: str, cls: str) -> None:
@@ -186,17 +201,14 @@ class RequestJournal:
         with self._lock:
             if self._f.closed:
                 return
-            self._f.flush()
-            os.fsync(self._f.fileno())
             self._unsynced = 0
+        self._sync()
 
     def close(self) -> None:
+        self._sync()
         with self._lock:
-            if self._f.closed:
-                return
-            self._f.flush()
-            os.fsync(self._f.fileno())
-            self._f.close()
+            if not self._f.closed:
+                self._f.close()
 
 
 @dataclasses.dataclass
@@ -296,7 +308,7 @@ class DecodeFleet:
         enforce(len(engines) >= 1, "DecodeFleet needs at least one engine")
         self.engines = list(engines)
         self._rr = 0
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("serving.decode_fleet")
         self.rescued_total = 0
         self.rescue_failed_total = 0
         for eng in self.engines:

@@ -41,6 +41,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from paddle_tpu.core import locks
 from paddle_tpu.concurrency import ChannelClosedError
 from paddle_tpu.core.enforce import enforce
 
@@ -125,9 +126,9 @@ class WeightedFairScheduler:
         self._legacy_capacity = legacy_capacity
         self._on_expired = on_expired
         self._clock = clock
-        self._lock = threading.Lock()
-        self._readable = threading.Condition(self._lock)  # work available
-        self._space = threading.Condition(self._lock)     # capacity freed
+        self._lock = locks.Lock("serving.scheduler")
+        self._readable = locks.Condition(self._lock, name="serving.scheduler.readable")  # work available
+        self._space = locks.Condition(self._lock, name="serving.scheduler.space")     # capacity freed
         self._rr: Dict[str, int] = {c: 0 for c in CLASSES}
         self._total = 0
         self._closed = False

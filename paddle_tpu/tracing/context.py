@@ -33,6 +33,7 @@ import time
 from collections import deque
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from paddle_tpu.core import locks
 from paddle_tpu.core import profiler as prof
 from paddle_tpu.core.config import flags
 from paddle_tpu.core.enforce import enforce
@@ -198,7 +199,7 @@ class Span:
 # Store + thread-local span stack
 # --------------------------------------------------------------------------
 
-_lock = threading.Lock()
+_lock = locks.Lock("tracing.spans")
 _store: "deque[Span]" = deque(maxlen=max(1, int(flags().trace_max_spans)))
 _enabled = True
 _tls = threading.local()

@@ -22,6 +22,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from paddle_tpu.core import locks
 from paddle_tpu.core import profiler as prof
 
 __all__ = [
@@ -32,7 +33,7 @@ __all__ = [
     "reset_memory_telemetry",
 ]
 
-_lock = threading.Lock()
+_lock = locks.Lock("tracing.memory")
 # live-arrays fallback needs its own running peak — PJRT tracks the real
 # one only when memory_stats() exists
 _live_peak: Dict[str, int] = {}

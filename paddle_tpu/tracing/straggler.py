@@ -29,6 +29,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
+from paddle_tpu.core import locks
 from paddle_tpu.core import logging as ptlog
 from paddle_tpu.core import profiler as prof
 from paddle_tpu.core.config import flags
@@ -57,7 +58,7 @@ class StragglerDetector:
             min_samples=min_samples,
         )
         self.flagged: Dict[str, int] = {}  # key -> flag count
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("tracing.straggler")
 
     @property
     def ratio(self) -> float:

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.core import locks
 from paddle_tpu.core import config as cfg
 from paddle_tpu.core import profiler as prof
 from paddle_tpu.observability import runlog
@@ -96,7 +97,7 @@ def default_store_path() -> Optional[str]:
     return os.path.join(d, "kernel_tune.json") if d else None
 
 
-_store_lock = threading.Lock()
+_store_lock = locks.Lock("tune.autotune_store")
 _stores: Dict[Optional[str], TuneStore] = {}
 _lookup_cache: Dict[tuple, Optional[Tuple[int, int]]] = {}
 _announced = False

@@ -27,6 +27,7 @@ import threading
 import zlib
 from typing import Dict, Optional, Tuple
 
+from paddle_tpu.core import locks
 from paddle_tpu.core import profiler as prof
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.observability import runlog
@@ -83,7 +84,7 @@ class TuneStore:
 
     def __init__(self, path: Optional[str] = None):
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("tune.store")
         self._entries: Dict[str, dict] = {}
         self.corrupt = False  # last load found a bad file
         if path and os.path.exists(path):

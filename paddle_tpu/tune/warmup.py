@@ -24,6 +24,7 @@ import threading
 import zlib
 from typing import Dict, List, Optional
 
+from paddle_tpu.core import locks
 from paddle_tpu.core import config as cfg
 from paddle_tpu.core import profiler as prof
 from paddle_tpu.core.enforce import enforce
@@ -95,7 +96,7 @@ class WarmupManifest:
     def __init__(self, model: str, path: Optional[str] = None):
         self.model = str(model)
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("tune.warmup_manifest")
         self._entries: List[dict] = []
         self._seen: set = set()
         self.corrupt = False
@@ -179,7 +180,7 @@ class WarmupManifest:
         self.corrupt = False
 
 
-_manifest_lock = threading.Lock()
+_manifest_lock = locks.Lock("tune.manifest_install")
 _manifests: Dict[tuple, WarmupManifest] = {}
 
 

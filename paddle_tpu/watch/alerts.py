@@ -27,6 +27,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+from paddle_tpu.core import locks
 from paddle_tpu.core import logging as ptlog
 from paddle_tpu.core import profiler as prof
 from paddle_tpu.observability import runlog
@@ -80,7 +81,7 @@ class AlertHub:
     """Thread-safe bounded alert store + fan-out (see module docstring)."""
 
     def __init__(self, capacity: int = 1024):
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("watch.alert_hub")
         self._alerts: deque = deque(maxlen=capacity)
         self._actions: List[Callable[[Alert], None]] = []
         self.emitted_total = 0

@@ -32,6 +32,7 @@ import os
 import threading
 from typing import Dict, Iterable, Optional, Tuple
 
+from paddle_tpu.core import locks
 from paddle_tpu.core.enforce import enforce
 
 __all__ = [
@@ -53,7 +54,7 @@ INFO_ONLY = "info"
 _HIGHER_SUFFIXES = ("_per_sec", "_per_s", "_throughput", "_speedup")
 _HIGHER_CONTAINS = ("_per_sec_", "_per_sec")  # e.g. decode_tok_per_sec_bs8
 _HIGHER_EXACT = ("mfu", "goodput_frac")
-_LOWER_SUFFIXES = ("_seconds", "_ms", "_s", "_latency")
+_LOWER_SUFFIXES = ("_seconds", "_ms", "_s", "_latency", "_overhead_pct")
 _LOWER_CONTAINS = ("_ms_", "latency")
 
 
@@ -160,7 +161,7 @@ class BaselineStore:
     def __init__(self, path: Optional[str] = None, ema_alpha: float = 0.25):
         self.path = path
         self.ema_alpha = float(ema_alpha)
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("watch.baseline_store")
         self._stats: Dict[str, RollingStat] = {}
         if path and os.path.exists(path):
             self.load()

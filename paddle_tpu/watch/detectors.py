@@ -35,6 +35,7 @@ import threading
 from collections import deque
 from typing import Dict, Optional, Tuple
 
+from paddle_tpu.core import locks
 from paddle_tpu.core.enforce import enforce
 
 __all__ = [
@@ -98,7 +99,7 @@ class EwmaDetector:
         self.min_samples = int(min_samples)
         self.min_spread = float(min_spread)
         self.poison_after = int(poison_after)
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("watch.ewma_detector")
         # key -> [count, mean, var, consecutive_flags]
         self._state: Dict[str, list] = {}
 
@@ -168,7 +169,7 @@ class RollingQuantileDetector:
         self.q = float(q)
         self.ratio = float(ratio)
         self.min_samples = int(min_samples)
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("watch.quantile_detector")
         self._series: Dict[str, deque] = {}
 
     def observe(self, key: str, value: float) -> Optional[DetectorResult]:
@@ -239,7 +240,7 @@ class SkewDetector:
                 f"skew ratio must be > 1.0, got {self.ratio}")
         self.window = int(window)
         self.min_samples = int(min_samples)
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("watch.skew_detector")
         self._series: Dict[str, deque] = {}
 
     def record(self, key: str, seconds: float) -> Optional[DetectorResult]:

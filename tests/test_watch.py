@@ -494,6 +494,7 @@ def test_metric_direction_classification():
     assert metric_direction("p99_ms") == "lower_better"
     assert metric_direction("compile_seconds") == "lower_better"
     assert metric_direction("prefill_ms_bs8") == "lower_better"
+    assert metric_direction("lock_check_overhead_pct") == "lower_better"
     assert metric_direction("resnet_peak_hbm_bytes_bs64") == "info"
 
 

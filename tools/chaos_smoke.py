@@ -69,6 +69,11 @@ if os.environ.get("JAX_PLATFORMS") == "cpu" and \
         + " --xla_force_host_platform_device_count=2"
     ).strip()
 
+# deadlock canary: run every phase with the core.locks order detector on
+# (respects an explicit PADDLE_TPU_LOCK_CHECK=0) and fail the run on any
+# recorded order violation or a lock held past the watchdog threshold
+os.environ.setdefault("PADDLE_TPU_LOCK_CHECK", "1")
+
 import numpy as np  # noqa: E402
 
 
@@ -79,6 +84,26 @@ class ChaosFailure(AssertionError):
 def check(cond, msg: str) -> None:
     if not cond:
         raise ChaosFailure(msg)
+
+
+# a lock held this long under chaos load is a wedge, not a critical
+# section (matches the watchdog timeout scale used by the decode phases)
+_LOCK_HOLD_BUDGET_S = 30.0
+
+
+def _deadlock_canary(phase: str) -> None:
+    """Fail the run if the lock-order detector recorded a potential
+    deadlock during ``phase``, or any instrumented lock is still held past
+    the watchdog threshold (a wedged thread the phase leaked)."""
+    from paddle_tpu.core import locks
+    vs = locks.violations()
+    check(not vs,
+          f"{phase}: {len(vs)} lock-order violation(s): "
+          + "; ".join(" -> ".join(v["cycle"]) for v in vs))
+    hold = locks.max_hold_seconds()
+    check(hold < _LOCK_HOLD_BUDGET_S,
+          f"{phase}: a lock has been held {hold:.1f}s "
+          f"(budget {_LOCK_HOLD_BUDGET_S}s):\n" + locks.render_held_table())
 
 
 _EXERCISED_POINTS = set()
@@ -869,9 +894,13 @@ def main(argv=None) -> int:
         _corrupt_resume_phase(root, args.seed)
         _elastic_phase(work, args.seed)
         _serving_phase(args.seed)
+        _deadlock_canary("serving")
         _decode_phase(work, args.seed)
+        _deadlock_canary("decode")
         _spec_decode_phase(work, args.seed)
+        _deadlock_canary("spec_decode")
         _overload_phase(work, args.seed)
+        _deadlock_canary("overload")
 
         # coverage gate: a fault point nobody injects is a recovery path
         # nobody proves — new points must arrive with their chaos leg
@@ -886,7 +915,8 @@ def main(argv=None) -> int:
         if not args.keep and args.dir is None:
             shutil.rmtree(work, ignore_errors=True)
     print(f"[chaos] OK: every injected fault fired, every recovery held, "
-          f"all {len(_EXERCISED_POINTS)} registered fault points exercised")
+          f"all {len(_EXERCISED_POINTS)} registered fault points exercised, "
+          f"no lock-order violations")
     return 0
 
 
