@@ -66,3 +66,26 @@ def axis_index(axis_name: str):
 
 def axis_size(axis_name: str):
     return lax.axis_size(axis_name)
+
+
+# -- KV-page transfer primitives (serving.disagg) ---------------------------
+#
+# Disaggregated prefill/decode handoff moves one request's KV pages from a
+# prefill worker's page arrays into a decode worker's. When both workers
+# live in one process these run jitted on-device (a gather/scatter per
+# page — no host round-trip); across processes the gathered pages are
+# serialized with per-page CRCs (serving.disagg.HandoffPayload). Page
+# arrays are ``[L, num_pages, H_kv, page_size, dh]``; one page is the
+# fixed-shape ``[L, H_kv, page_size, dh]`` slice, so both ops compile
+# exactly once per engine geometry.
+
+def gather_kv_page(pages, page_id):
+    """Extract one physical page from a paged KV array (device-side)."""
+    return pages[:, page_id]
+
+
+def scatter_kv_page(pages, page_id, page):
+    """Implant one page payload at ``page_id`` in a paged KV array
+    (device-side; the functional update donates into the engine's
+    running page arrays)."""
+    return pages.at[:, page_id].set(page)

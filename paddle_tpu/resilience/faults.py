@@ -60,6 +60,7 @@ __all__ = [
     "SERVING_DISPATCH",
     "DECODE_STEP",
     "DECODE_RECOVER",
+    "DISAGG_HANDOFF",
     "DEVICE_LOST",
     "PREEMPT_NOTICE",
     "DeviceLostError",
@@ -80,6 +81,12 @@ DECODE_STEP = "serving.decode.step"
 # iteration): failing *here* proves recovery is not a single point of
 # failure — a fault during recovery escalates to migration/journal replay
 DECODE_RECOVER = "serving.decode.recover"
+# disaggregated prefill/decode handoff (serving.disagg.DisaggRouter):
+# fires on the transfer path between a prefill worker publishing a
+# request's KV pages and the decode worker adopting them — a fault here
+# models a torn/failed transfer, which must degrade to re-prefill on
+# another worker (never a lost request)
+DISAGG_HANDOFF = "serving.disagg.handoff"
 # elastic-training points (trainer step loop): a replica/device vanishing
 # mid-step, and the scheduler's advance preemption notice — both are
 # hardware/cluster events in production, injectable here so the whole
@@ -102,6 +109,7 @@ def registered_points() -> List[str]:
         SERVING_DISPATCH,
         DECODE_STEP,
         DECODE_RECOVER,
+        DISAGG_HANDOFF,
         DEVICE_LOST,
         PREEMPT_NOTICE,
     ]

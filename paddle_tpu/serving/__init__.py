@@ -64,6 +64,13 @@ from paddle_tpu.serving.decode import (
     DecodeHandle,
     DecodeOutput,
 )
+from paddle_tpu.serving.disagg import (
+    Autoscaler,
+    AutoscalerConfig,
+    DisaggRouter,
+    HandoffCorrupt,
+    HandoffPayload,
+)
 from paddle_tpu.serving.engine import (
     DeadlineExceeded,
     EngineClosedError,
@@ -129,4 +136,9 @@ __all__ = [
     "RetriesExhausted",
     "replay_journal",
     "resume_incomplete",
+    "DisaggRouter",
+    "HandoffPayload",
+    "HandoffCorrupt",
+    "Autoscaler",
+    "AutoscalerConfig",
 ]

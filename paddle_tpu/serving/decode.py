@@ -73,6 +73,7 @@ from paddle_tpu.models.transformer_lm import (
     paged_verify_step,
 )
 from paddle_tpu.observability import runlog
+from paddle_tpu.parallel import collective
 from paddle_tpu.resilience import faults
 from paddle_tpu.resilience.circuit import CircuitBreaker
 from paddle_tpu.serving import admission as admission_mod
@@ -180,6 +181,10 @@ class DecodeConfig:
     # rebuild in-flight work after a process restart. None = off.
     journal_path: Optional[str] = None
     journal_fsync_every: int = 16
+    # WAL size (bytes) that triggers an in-place compaction: finished
+    # requests drop, incomplete ones are rewritten as snapshots into a
+    # fresh segment (atomic publish). None = unbounded growth.
+    journal_compact_bytes: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -405,6 +410,10 @@ class DecodeEngine:
         self._prefill = jax.jit(functools.partial(
             paged_prefill_chunk, cfg=self.model_cfg,
             page_size=dconf.page_size, **sample_kw))
+        # disagg KV handoff (serving.disagg): one page is the fixed-shape
+        # [L, H_kv, page_size, dh] slice, so gather/implant compile once
+        self._gather_page = jax.jit(collective.gather_kv_page)
+        self._implant_page = jax.jit(collective.scatter_kv_page)
         self._rng = (jax.random.PRNGKey(dconf.rng_seed)
                      if dconf.temperature > 0.0 else None)
 
@@ -490,6 +499,12 @@ class DecodeEngine:
         self._active: List[_DecodeRequest] = []     # admission order
         self._resume: Deque[_DecodeRequest] = deque()
         self._pending_admit: Deque[_DecodeRequest] = deque()
+        # disaggregated serving (serving.disagg): a prefill-role engine
+        # publishes finished prefills through _handoff_sink instead of
+        # decoding them; a decode-role engine admits adopted payloads
+        # from _pending_handoff (implanted on the loop thread)
+        self._handoff_sink: Optional[Callable[..., None]] = None
+        self._pending_handoff: Deque = deque()  # (req, HandoffPayload)
         self._closed = False
         self._close_lock = locks.Lock("serving.decode_close")
         # zero-loss recovery state (serving.recovery)
@@ -502,9 +517,13 @@ class DecodeEngine:
         self._recover_prev_delay = 0.0
         self._breaker_dirty = False
         self._journal: Optional[RequestJournal] = None
+        # a DisaggRouter may swap in a journal SHARED across its workers;
+        # then close()/kill() must not close it (the router owns its fd)
+        self._journal_owned = True
         if dconf.journal_path:
             self._journal = RequestJournal(
-                dconf.journal_path, fsync_every=dconf.journal_fsync_every)
+                dconf.journal_path, fsync_every=dconf.journal_fsync_every,
+                compact_bytes=dconf.journal_compact_bytes)
         self._rid_seq = itertools.count()
         self._killed = False
         self._drain_abort = False
@@ -668,6 +687,17 @@ class DecodeEngine:
     @property
     def admission(self) -> Optional[admission_mod.AdmissionController]:
         return self._admission
+
+    def load(self) -> float:
+        """Live work on this engine: active slots plus every parked or
+        queued request. ``DecodeFleet._pick`` routes new work to the
+        least-loaded healthy engine by this number. Read lock-free from
+        any thread — ``len()`` is atomic under the GIL, and staleness
+        only costs routing optimality, never correctness."""
+        return float(len(self._active) + len(self._resume)
+                     + len(self._pending_admit)
+                     + len(self._pending_handoff)
+                     + self._queue.qsize())
 
     # -- admission cost ----------------------------------------------------
 
@@ -845,8 +875,9 @@ class DecodeEngine:
             self._loop_body()
         except BaseException as e:  # fail everything rather than hang
             ptlog.error("decode loop died: %r", e)
-            for req in list(self._active) + list(self._resume) + \
-                    list(self._pending_admit):
+            for req in (list(self._active) + list(self._resume)
+                        + list(self._pending_admit)
+                        + [item[0] for item in self._pending_handoff]):
                 try:
                     self._fail(req, RuntimeError(f"decode loop died: {e!r}"))
                 except Exception:
@@ -862,6 +893,7 @@ class DecodeEngine:
                 self._force_drain()
                 break
             self._sweep_cancel_deadline()
+            self._admit_handoffs()
             self._admit()
             t0 = time.perf_counter()
             did_prefill = self._prefill_some()
@@ -870,6 +902,8 @@ class DecodeEngine:
                 self.metrics.set_pages(self._kv.pages_in_use,
                                        self._kv.pages_free)
                 self.metrics.set_active_slots(len(self._active))
+                self.metrics.set_load(self.load())
+                self.metrics.set_queue_depth(self._queue.qsize())
                 if self._loop_trace is not None:
                     tracing.record_span(
                         "serving.decode.step", t0, time.perf_counter(),
@@ -877,7 +911,8 @@ class DecodeEngine:
                         active=len(self._active))
                 continue
             # idle: nothing to prefill or step — wait for work or drain out
-            if self._active or self._resume or self._pending_admit:
+            if (self._active or self._resume or self._pending_admit
+                    or self._pending_handoff):
                 continue
             try:
                 req, ok = self._queue.recv(timeout=dconf.idle_poll_s)
@@ -907,6 +942,14 @@ class DecodeEngine:
                 elif req.deadline is not None and now > req.deadline:
                     pool.remove(req)
                     self._expire(req)
+        for item in list(self._pending_handoff):
+            req = item[0]
+            if req.cancelled:
+                self._pending_handoff.remove(item)
+                self._finish(req, "cancelled")
+            elif req.deadline is not None and now > req.deadline:
+                self._pending_handoff.remove(item)
+                self._expire(req)
 
     def _admit(self) -> None:
         """Fill free slots: preempted requests first (front of line), then
@@ -958,6 +1001,83 @@ class DecodeEngine:
                     tracing.record_span(
                         "serving.decode.queue_wait", req.t_enqueue_pc,
                         req.t_admit_pc, parent=req.trace)
+
+    def _admit_handoffs(self) -> None:
+        """Admit handed-off requests (serving.disagg): implant the
+        transferred KV pages into this engine's page arrays and enter the
+        decode phase directly — no re-prefill. Any failure (geometry
+        mismatch, page-pool pressure, implant error) degrades to the
+        proven resume path, which re-prefills ``prompt + generated``
+        token-exactly — a bad transfer costs latency, never a request."""
+        import jax.numpy as jnp
+
+        dconf = self.decode_config
+        page_shape = (self._k_pages.shape[:1] + self._k_pages.shape[2:])
+        while (self._pending_handoff
+               and len(self._active) < dconf.max_slots):
+            req, payload = self._pending_handoff.popleft()
+            if req.cancelled:
+                self._finish(req, "cancelled")
+                continue
+            slot = self._kv.acquire_slot()
+            if slot is None:  # raced vs max_slots accounting; park
+                self._pending_handoff.appendleft((req, payload))
+                return
+            req.slot = slot
+            n_pages = -(-int(payload.cur_len) // dconf.page_size)
+            ok = False
+            # a draft model keeps its own page arrays, which the payload
+            # does not carry — re-prefill fills both caches correctly
+            if (not self._spec_k
+                    and payload.page_size == dconf.page_size
+                    and 0 < payload.cur_len <= dconf.max_context
+                    and len(payload.k_pages) == n_pages
+                    and len(payload.v_pages) == n_pages
+                    and all(p.shape == page_shape
+                            for p in payload.k_pages + payload.v_pages)):
+                try:
+                    if self._ensure_pages(req, int(payload.cur_len)):
+                        table = self._kv.page_tables[req.slot]
+                        for li in range(n_pages):
+                            pid = jnp.int32(table[li])
+                            self._k_pages = self._implant_page(
+                                self._k_pages, pid,
+                                jnp.asarray(payload.k_pages[li],
+                                            self._cache_dtype))
+                            self._v_pages = self._implant_page(
+                                self._v_pages, pid,
+                                jnp.asarray(payload.v_pages[li],
+                                            self._cache_dtype))
+                        ok = True
+                except Exception as e:
+                    ptlog.warning(
+                        "handoff page adoption failed (%r); "
+                        "re-prefilling request %s", e, req.rid)
+            if not ok:
+                self._release(req)
+                req.phase = "queued"
+                req.seq = None
+                req.chunks_done = 0
+                req.cur_len = 0
+                self._resume.append(req)
+                self.metrics.record_recover(1)
+                continue
+            # the adopted pages cover positions [0, cur_len); last_tok is
+            # the token pending its KV write — exactly mid-decode state
+            req.seq = None
+            req.phase = "decode"
+            req.cur_len = int(payload.cur_len)
+            req.chunks_done = self._n_chunks(
+                int(req.prompt.size) + len(req.generated))
+            req.last_tok = int(payload.last_tok)
+            self._kv.seq_lens[req.slot] = req.cur_len
+            req.t_admit_pc = time.perf_counter()
+            self._active.append(req)
+            self.metrics.record_handoff_in()
+            self.metrics.record_slot_admit()
+            runlog.emit("handoff_adopted", rid=req.rid,
+                        from_engine=payload.src, pages=n_pages,
+                        engine=self.metrics.engine_label)
 
     def _maybe_prefix_adopt(self, req: _DecodeRequest) -> None:
         """Consult the radix prefix cache at slot assignment: adopt the
@@ -1134,6 +1254,14 @@ class DecodeEngine:
                 # prefilled sequence — the first (or, after a resume, the
                 # next) generated token
                 self._append_token(req, tok)
+                # prefill role (serving.disagg): publish instead of
+                # decoding here — unless that one sampled token already
+                # finished the request (it left _active via _finish).
+                # Draft-model engines keep their work local: the payload
+                # carries only the target cache.
+                if (self._handoff_sink is not None and not self._spec_k
+                        and req in self._active):
+                    self._publish_handoff(req)
         return progressed
 
     def _decode_step(self) -> bool:
@@ -1465,6 +1593,8 @@ class DecodeEngine:
             drained.append(self._resume.popleft())
         while self._pending_admit:
             drained.append(self._pending_admit.popleft())
+        while self._pending_handoff:
+            drained.append(self._pending_handoff.popleft()[0])
         while True:
             try:
                 req, ok = self._queue.recv(timeout=0)
@@ -1560,6 +1690,118 @@ class DecodeEngine:
                 trace_id=req.trace.trace_id if req.trace else None)
         # front-of-line with the resumed: the request already waited once
         self._resume.append(req)
+        self._queue.poke()  # an idle loop is parked in recv(idle_poll_s)
+        return req.handle
+
+    # -- disaggregated prefill/decode handoff (serving.disagg) -------------
+
+    def _publish_handoff(self, req: _DecodeRequest) -> None:
+        """Prefill-role exit: prefill just completed, so the slot's pages
+        hold the request's full context — gather them off-device, release
+        the slot, and hand the payload to the router's sink. Durability
+        (journal handoff record + receiver ack) is the router's job; a
+        sink failure degrades to decoding locally through the resume
+        path, so a broken transfer never loses the request."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.serving.disagg import HandoffPayload
+
+        dconf = self.decode_config
+        n_pages = -(-req.cur_len // dconf.page_size)
+        # gather BEFORE _release: freed pages can be rewritten immediately
+        pages = self._kv.slot_pages(req.slot)[:n_pages]
+        k_pages = [np.asarray(self._gather_page(self._k_pages,
+                                                jnp.int32(p)))
+                   for p in pages]
+        v_pages = [np.asarray(self._gather_page(self._v_pages,
+                                                jnp.int32(p)))
+                   for p in pages]
+        payload = HandoffPayload(
+            rid=req.rid or "", prompt=req.prompt,
+            generated=list(req.generated), mnt=req.mnt,
+            tenant=req.tenant, cls=req.cls, deadline=req.deadline,
+            t_submit=req.t_submit, n_preemptions=req.n_preemptions,
+            cur_len=int(req.cur_len), last_tok=int(req.last_tok),
+            page_size=dconf.page_size, k_pages=k_pages, v_pages=v_pages,
+            src=self.metrics.engine_label, handle=req.handle,
+            trace=req.trace)
+        self._release(req)
+        try:
+            self._handoff_sink(self, payload)
+        except Exception as e:
+            ptlog.warning("KV handoff failed (%r); request %s continues "
+                          "decoding locally", e, req.rid)
+            req.phase = "queued"
+            req.seq = None
+            req.chunks_done = 0
+            req.cur_len = 0
+            self._resume.append(req)
+            return
+        self.metrics.record_handoff_out()
+        # with a per-engine WAL, close the rid here — the adopting engine
+        # journals it afresh, so a replay of THIS file cannot resurrect a
+        # request that now lives elsewhere. With a journal SHARED across
+        # the fleet the rid must stay open (the adopter keeps appending
+        # under it); the handoff/ack records carry the transfer state.
+        if self._journal_owned:
+            self._j_fin(req, "migrated")
+        runlog.emit("handoff_published", rid=req.rid, pages=n_pages,
+                    engine=self.metrics.engine_label)
+
+    def adopt_handoff(self, payload,
+                      from_engine: Optional[str] = None) -> DecodeHandle:
+        """Adopt a prefilled request handed off by a prefill-role engine
+        (:class:`~paddle_tpu.serving.disagg.HandoffPayload`): its KV
+        pages are implanted on the loop thread and decode continues from
+        ``cur_len`` without re-prefilling. The client's original handle
+        is repointed here, mirroring :meth:`adopt_rescue`. Thread-safe;
+        returns the (possibly fresh) handle."""
+        if self._closed:
+            raise EngineClosedError("engine is closed")
+        prompt = np.asarray(payload.prompt, np.int32).reshape(-1)
+        req = _DecodeRequest(
+            prompt, int(payload.mnt),
+            self._n_chunks(int(prompt.size) + len(payload.generated)),
+            payload.deadline, payload.t_submit or time.monotonic(),
+            tenant=payload.tenant, cls=payload.cls)
+        req.generated = [int(t) for t in payload.generated]
+        req.n_preemptions = payload.n_preemptions
+        req.rid = payload.rid or (
+            f"{self.metrics.engine_label}-{_RID_SALT}-"
+            f"{next(self._rid_seq)}")
+        if payload.handle is not None:
+            req.handle = payload.handle
+            payload.handle._req = req  # cancel() must target the new req
+        req.trace = payload.trace
+        if req.trace is None and tracing.tracing_enabled():
+            req.trace = tracing.SpanContext.new_trace()
+        if req.trace is not None:
+            req.handle.trace = req.trace
+            req.t_enqueue_pc = time.perf_counter()
+        # the prefill worker's final-chunk sample may already satisfy the
+        # request: complete without decoding (same as adopt_rescue)
+        eos = self.decode_config.eos_id
+        done_eos = (eos is not None and req.generated
+                    and req.generated[-1] == eos)
+        if done_eos or len(req.generated) >= req.mnt:
+            reason = "eos" if done_eos else "length"
+            self._j_admit(req)
+            self._j_fin(req, reason)
+            req.handle._complete(DecodeOutput(
+                tokens=np.asarray(req.generated, dtype=np.int32),
+                finish_reason=reason, prompt_len=int(req.prompt.size),
+                n_preemptions=req.n_preemptions))
+            return req.handle
+        self._j_admit(req)
+        self.metrics.record_submit()
+        if from_engine is not None:
+            runlog.emit(
+                "request_handed_off", rid=req.rid, from_engine=from_engine,
+                to_engine=self.metrics.engine_label,
+                generated=len(req.generated),
+                trace_id=req.trace.trace_id if req.trace else None)
+        self._pending_handoff.append((req, payload))
+        self._queue.poke()  # an idle loop is parked in recv(idle_poll_s)
         return req.handle
 
     def kill(self) -> None:
@@ -1578,15 +1820,17 @@ class DecodeEngine:
         self._killed = True
         self._queue.close()
         self._thread.join(5.0)
-        if journal is not None:
+        if journal is not None and self._journal_owned:
             journal.close()  # release the fd; on-disk bytes stay as-is
         exc = EngineUnhealthy(
             f"engine {self.metrics.engine_label} killed")
         drained = (list(self._active) + list(self._resume)
-                   + list(self._pending_admit))
+                   + list(self._pending_admit)
+                   + [item[0] for item in self._pending_handoff])
         self._active.clear()
         self._resume.clear()
         self._pending_admit.clear()
+        self._pending_handoff.clear()
         while True:
             try:
                 req, ok = self._queue.recv(timeout=0)
@@ -1616,9 +1860,11 @@ class DecodeEngine:
         instead of leaving its handle hanging forever, then prove no KV
         page leaked."""
         drained = (list(self._active) + list(self._resume)
-                   + list(self._pending_admit))
+                   + list(self._pending_admit)
+                   + [item[0] for item in self._pending_handoff])
         self._resume.clear()
         self._pending_admit.clear()
+        self._pending_handoff.clear()
         while True:
             try:
                 req, ok = self._queue.recv(timeout=0)
@@ -1657,7 +1903,7 @@ class DecodeEngine:
         if unjoined:
             ptlog.error("DecodeEngine.close: loop failed to join within %s",
                         timeout)
-        if self._journal is not None:
+        if self._journal is not None and self._journal_owned:
             self._journal.close()
         if self._admission is not None:
             admission_mod.uninstall(self._admission)

@@ -356,6 +356,9 @@ class DecodeMetrics:
         self.prefix_hit_tokens_total = 0  # prompt tokens served from cache
         self.prefix_saved_chunks_total = 0  # prefill chunks skipped outright
         self.cow_copies_total = 0         # copy-on-write page copies
+        # disaggregated prefill/decode (serving.disagg.* families)
+        self.handoffs_out_total = 0       # prefilled requests published
+        self.handoffs_in_total = 0        # handed-off requests adopted
         # tenant-quota admission accounting (serving.tenant.* families)
         self._tenant_admitted: collections.Counter = collections.Counter()
         self._tenant_shed: collections.Counter = collections.Counter()
@@ -559,6 +562,34 @@ class DecodeMetrics:
                 return 0.0
             return self.prefix_hit_tokens_total / self.prompt_tokens_total
 
+    # -- disaggregated prefill/decode (serving.disagg.* families) ------------
+
+    def record_handoff_out(self) -> None:
+        """This engine finished a prefill and published the request's KV
+        pages to the router's handoff sink (prefill-worker role)."""
+        with self._lock:
+            self.handoffs_out_total += 1
+        prof.inc_counter("serving.disagg.handoffs_out_total",
+                         labels=self._labels)
+
+    def record_handoff_in(self) -> None:
+        """This engine adopted a handed-off request's KV pages straight
+        into its decode loop (decode-worker role)."""
+        with self._lock:
+            self.handoffs_in_total += 1
+        prof.inc_counter("serving.disagg.handoffs_in_total",
+                         labels=self._labels)
+
+    def set_load(self, load: float) -> None:
+        """Live routing-load signal (active slots + queued/parked work) —
+        what :meth:`DecodeFleet._pick` ranks engines by; refreshed every
+        loop iteration and at submit time."""
+        prof.set_gauge("serving.decode.load", load, labels=self._labels)
+
+    def set_queue_depth(self, depth: int) -> None:
+        prof.set_gauge("serving.decode.queue_depth", depth,
+                       labels=self._labels)
+
     # -- zero-loss recovery (serving.recovery.* families) --------------------
 
     def record_step_fault(self) -> None:
@@ -646,6 +677,8 @@ class DecodeMetrics:
                 "prefix_hit_tokens_total": self.prefix_hit_tokens_total,
                 "prefix_saved_chunks_total": self.prefix_saved_chunks_total,
                 "cow_copies_total": self.cow_copies_total,
+                "handoffs_out_total": self.handoffs_out_total,
+                "handoffs_in_total": self.handoffs_in_total,
                 "mean_step_occupancy": (
                     self.tokens_total / self.steps_total
                     if self.steps_total else 0.0),

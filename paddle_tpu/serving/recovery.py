@@ -102,6 +102,14 @@ class RescuePacket:
 _J_ADMIT = "admit"
 _J_TOK = "tok"
 _J_FIN = "fin"
+# disaggregated handoff (serving.disagg): a prefill worker published the
+# request's KV pages toward a decode worker ("hof", full request snapshot
+# — authoritative like an admit record), and the receiving decode worker
+# acknowledged adoption ("ack"). A crash between the two leaves the
+# request unfinished in replay, so resume_incomplete re-prefills it —
+# a handoff in flight is never a lost request.
+_J_HOF = "hof"
+_J_ACK = "ack"
 
 
 def _encode_record(obj: Dict[str, Any]) -> bytes:
@@ -138,34 +146,55 @@ class RequestJournal:
     ``fsync_every`` appends (and on :meth:`flush`/:meth:`close`), keeping
     the syscall off the per-token hot path. The window between fsyncs is
     the only durability gap — at most ``fsync_every`` tokens re-decode
-    after a crash, which re-prefill makes token-exact anyway."""
+    after a crash, which re-prefill makes token-exact anyway.
 
-    def __init__(self, path: str, fsync_every: int = 16):
+    ``compact_bytes`` bounds WAL growth: once the file exceeds it, the
+    journal is compacted — every request replayed, finished ones dropped,
+    and only incomplete ones rewritten (as authoritative admit snapshots
+    carrying their generated prefix) into a fresh segment published
+    atomically (tmp + fsync + ``os.replace`` + directory fsync). Replay
+    over a compacted journal is indistinguishable from replay over the
+    full history. None = never compact (the pre-PR-15 contract)."""
+
+    def __init__(self, path: str, fsync_every: int = 16,
+                 compact_bytes: Optional[int] = None):
         enforce(fsync_every >= 1,
                 f"fsync_every must be >= 1, got {fsync_every}")
+        enforce(compact_bytes is None or compact_bytes >= 1,
+                f"compact_bytes must be >= 1, got {compact_bytes}")
         self.path = path
         self.fsync_every = int(fsync_every)
+        self.compact_bytes = compact_bytes
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         self._f = open(path, "ab")
         self._lock = locks.Lock("serving.request_journal")
         self._unsynced = 0
+        self._bytes = os.path.getsize(path)
         self.records_total = 0
+        self.compactions_total = 0
 
     def _append(self, obj: Dict[str, Any]) -> None:
         data = _encode_record(obj)
         need_sync = False
+        need_compact = False
         with self._lock:
             if self._f.closed:
                 return  # journal detached mid-flight (engine killed)
             self._f.write(data)
             self.records_total += 1
             self._unsynced += 1
+            self._bytes += len(data)
             if self._unsynced >= self.fsync_every:
                 self._unsynced = 0
                 need_sync = True
+            if (self.compact_bytes is not None
+                    and self._bytes >= self.compact_bytes):
+                need_compact = True
         if need_sync:
             self._sync()
+        if need_compact:
+            self.compact()
 
     def _sync(self) -> None:
         """flush+fsync OUTSIDE the append lock: fsync covers every byte
@@ -197,6 +226,84 @@ class RequestJournal:
     def log_finish(self, rid: str, reason: str) -> None:
         self._append({"k": _J_FIN, "rid": rid, "reason": reason})
 
+    def log_handoff(self, rid: str, prompt: np.ndarray, mnt: int,
+                    gen_prefix: List[int], tenant: str, cls: str,
+                    src: str, dst: Optional[str]) -> None:
+        """A prefill worker published this request's KV pages toward
+        ``dst``. The record carries the full request snapshot (like an
+        admit record) so replay of THIS journal alone can re-prefill an
+        unacked handoff — durability does not depend on the source
+        worker surviving the transfer."""
+        self._append({
+            "k": _J_HOF, "rid": rid,
+            "prompt": [int(t) for t in np.asarray(prompt).reshape(-1)],
+            "mnt": int(mnt), "gen": [int(t) for t in gen_prefix],
+            "tenant": tenant, "cls": cls, "src": src, "dst": dst,
+        })
+        self.flush()  # the handoff record must be durable before transfer
+
+    def log_handoff_ack(self, rid: str, dst: str) -> None:
+        """The receiving decode worker validated and adopted the pages."""
+        self._append({"k": _J_ACK, "rid": rid, "dst": dst})
+
+    def compact(self) -> Dict[str, int]:
+        """Rewrite the WAL into a fresh segment containing only incomplete
+        requests (each as one authoritative admit snapshot carrying its
+        generated prefix); finished requests and their token records are
+        dropped. The new segment is published atomically — written to a
+        temp file, fsync'd, ``os.replace``'d over the journal, and the
+        directory entry fsync'd — so a crash at ANY point leaves either
+        the old segment or the complete new one, never a mix. The journal
+        lock is held throughout: a compaction is rare (size-triggered)
+        and concurrent appends must not land in the segment being
+        replaced. Torn-tail safe by construction: replay stops at the
+        first corrupt record, so compaction preserves exactly the state a
+        post-crash replay would recover. Returns
+        ``{"kept": .., "dropped": .., "bytes": ..}``."""
+        with self._lock:
+            if self._f.closed:
+                return {"kept": 0, "dropped": 0, "bytes": 0}
+            try:
+                self._f.flush()
+                os.fsync(self._f.fileno())  # lint: allow — rare, must be atomic vs appends
+            except (ValueError, OSError):
+                return {"kept": 0, "dropped": 0, "bytes": 0}
+            replayed = replay_journal(self.path)
+            tmp = f"{self.path}.compact.{os.getpid()}"
+            kept = 0
+            with open(tmp, "wb") as f:  # lint: allow — rare, must be atomic vs appends
+                for rid, rr in replayed.items():
+                    if rr.finished:
+                        continue
+                    f.write(_encode_record({
+                        "k": _J_ADMIT, "rid": rid,
+                        "prompt": [int(t) for t in rr.prompt],
+                        "mnt": int(rr.mnt),
+                        "gen": [int(t) for t in rr.generated],
+                        "tenant": rr.tenant, "cls": rr.cls,
+                    }))
+                    kept += 1
+                f.flush()
+                os.fsync(f.fileno())  # lint: allow — rare, must be atomic vs appends
+            old = self._f
+            os.replace(tmp, self.path)  # lint: allow — rare, must be atomic vs appends
+            dpath = os.path.dirname(os.path.abspath(self.path)) or "."
+            dfd = os.open(dpath, os.O_RDONLY)
+            try:
+                os.fsync(dfd)  # lint: allow — rare, must be atomic vs appends
+            finally:
+                os.close(dfd)
+            old.close()
+            self._f = open(self.path, "ab")  # lint: allow — rare, must be atomic vs appends
+            self._bytes = os.path.getsize(self.path)
+            self._unsynced = 0
+            self.compactions_total += 1
+            dropped = len(replayed) - kept
+            nbytes = self._bytes
+        runlog.emit("journal_compacted", path=self.path, kept=kept,
+                    dropped=dropped, bytes=nbytes)
+        return {"kept": kept, "dropped": dropped, "bytes": nbytes}
+
     def flush(self) -> None:
         with self._lock:
             if self._f.closed:
@@ -213,7 +320,11 @@ class RequestJournal:
 
 @dataclasses.dataclass
 class ReplayedRequest:
-    """One request reconstructed from the journal."""
+    """One request reconstructed from the journal. ``handed_off``/
+    ``acked`` expose the disaggregated-handoff state: a request that was
+    handed off but never acked was in flight between workers at the
+    crash — it is NOT finished, so :func:`resume_incomplete` re-prefills
+    it (the zero-loss handoff contract)."""
 
     rid: str
     prompt: np.ndarray
@@ -223,6 +334,8 @@ class ReplayedRequest:
     cls: str = "interactive"
     finished: bool = False
     reason: Optional[str] = None
+    handed_off: bool = False
+    acked: bool = False
 
 
 def replay_journal(path: str) -> Dict[str, ReplayedRequest]:
@@ -258,6 +371,23 @@ def replay_journal(path: str) -> Dict[str, ReplayedRequest]:
             elif kind == _J_FIN and rid in out:
                 out[rid].finished = True
                 out[rid].reason = rec.get("reason")
+            elif kind == _J_HOF:
+                # authoritative snapshot at publish time, like an admit —
+                # a prefill worker may hand off a request this journal
+                # never saw admitted (per-worker journals)
+                rr = out.get(rid)
+                if rr is None:
+                    rr = out[rid] = ReplayedRequest(
+                        rid=rid, prompt=np.asarray(
+                            rec.get("prompt", []), np.int32),
+                        mnt=int(rec.get("mnt", 0)), generated=[],
+                        tenant=rec.get("tenant", "default"),
+                        cls=rec.get("cls", "interactive"))
+                rr.generated = [int(t) for t in rec.get("gen", [])]
+                rr.handed_off = True
+                rr.acked = False
+            elif kind == _J_ACK and rid in out:
+                out[rid].acked = True
     if n_bad:
         ptlog.warning("journal %s: stopped at a torn/corrupt record "
                       "(%d request(s) recovered before it)", path, len(out))
@@ -296,7 +426,8 @@ def resume_incomplete(engine, path: str) -> Dict[str, Tuple[Any, int]]:
 class DecodeFleet:
     """A set of ``DecodeEngine``\\ s behind one submit surface, with
     health-aware routing and rescue. Each engine keeps its own
-    ``CircuitBreaker``; routing round-robins over CLOSED breakers and
+    ``CircuitBreaker``; routing picks the least-loaded CLOSED breaker
+    (live slots + queued/parked depth, ``DecodeEngine.load()``) and
     spends at most one half-open probe per pick on a cooled-down OPEN
     one, so a recovered device earns its traffic back one request at a
     time. When an engine declares itself unhealthy it drains its live
@@ -309,34 +440,47 @@ class DecodeFleet:
         self.engines = list(engines)
         self._rr = 0
         self._lock = locks.Lock("serving.decode_fleet")
+        # engines mid drain-and-convert (serving.disagg): excluded from
+        # routing while their graceful drain runs
+        self._draining: set = set()
         self.rescued_total = 0
         self.rescue_failed_total = 0
         for eng in self.engines:
             eng._rescue_sink = self._rescue
 
-    def _order(self) -> List[Any]:
+    def _order(self, candidates: Optional[List[Any]] = None) -> List[Any]:
+        """Rotating view over ``candidates`` (default: every engine) —
+        keeps half-open probes fair when several breakers cool down at
+        once; the load ranking below is order-independent."""
+        engines = list(self.engines if candidates is None else candidates)
         with self._lock:
             k = self._rr
             self._rr += 1
-        n = len(self.engines)
-        return [self.engines[(k + i) % n] for i in range(n)]
+        n = len(engines)
+        return [engines[(k + i) % n] for i in range(n)] if n else []
 
-    def _pick(self, exclude: Optional[Any] = None) -> Optional[Any]:
-        order = [e for e in self._order()
-                 if e is not exclude and not e.closed]
+    def _pick(self, exclude: Optional[Any] = None,
+              candidates: Optional[List[Any]] = None) -> Optional[Any]:
+        order = [e for e in self._order(candidates)
+                 if e is not exclude and not e.closed
+                 and id(e) not in self._draining]
         # spend a half-open probe the moment one is available — even with
         # healthy engines around, one risked request is how an ejected
         # engine earns its capacity back (a failed probe just re-opens
         # the breaker, and recovery/migration makes the request itself
         # zero-loss). allow() takes the single probe token atomically.
-        healthy = None
+        healthy = []
         for eng in order:
             if eng.breaker.state == CLOSED:
-                if healthy is None:
-                    healthy = eng
+                healthy.append(eng)
             elif eng.breaker.retry_in() == 0.0 and eng.breaker.allow():
                 return eng
-        return healthy
+        if not healthy:
+            return None
+        # least-loaded over CLOSED breakers: a saturated engine stops
+        # receiving new work while a peer has capacity (ties keep the
+        # rotating order, so equal-load engines still round-robin)
+        return min(healthy, key=lambda e: e.load())
 
     def submit(self, prompt, max_new_tokens: int, **kwargs):
         eng = self._pick()

@@ -132,6 +132,7 @@ class WeightedFairScheduler:
         self._rr: Dict[str, int] = {c: 0 for c in CLASSES}
         self._total = 0
         self._closed = False
+        self._poked = False
 
     # -- introspection -----------------------------------------------------
 
@@ -142,6 +143,19 @@ class WeightedFairScheduler:
     def is_closed(self) -> bool:
         with self._lock:
             return self._closed
+
+    def poke(self) -> None:
+        """Bounce one parked ``recv`` caller out through its timeout path
+        without delivering work. The decode-engine loop parks here when
+        idle, but handoff/rescue adoptions arrive on side lists only the
+        loop thread may touch — without a poke the adoption waits out the
+        full idle poll. Only ``recv`` calls WITH a timeout return early;
+        an untimed ``recv`` ignores the flag (and leaves it set for the
+        next timed caller), so blocking consumers never see a spurious
+        ``TimeoutError``."""
+        with self._lock:
+            self._poked = True
+            self._readable.notify()
 
     def tenant_names(self) -> List[str]:
         return list(self._order)
@@ -354,6 +368,9 @@ class WeightedFairScheduler:
                                  else deadline - time.monotonic())
                     if remaining is not None and remaining <= 0:
                         timed_out = True
+                    elif deadline is not None and self._poked:
+                        self._poked = False
+                        timed_out = True  # poke(): out-of-band work waits
                     elif not expired:
                         # with evicted requests in hand, skip the wait:
                         # their on_expired callbacks must fire now (outside

@@ -36,6 +36,7 @@ from paddle_tpu.watch.detectors import (  # noqa: F401
 from paddle_tpu.watch.slo import (  # noqa: F401
     SLO,
     SloEngine,
+    disagg_slos,
     install,
     installed_engines,
     serving_slos,
@@ -65,6 +66,7 @@ __all__ = [
     "SkewDetector",
     "SLO",
     "SloEngine",
+    "disagg_slos",
     "install",
     "installed_engines",
     "serving_slos",

@@ -44,7 +44,7 @@ from paddle_tpu.observability import metrics as obs_metrics
 from paddle_tpu.watch import alerts as alerts_mod
 
 __all__ = ["SLO", "SloEngine", "install", "uninstall", "installed_engines",
-           "serving_slos"]
+           "serving_slos", "disagg_slos"]
 
 LATENCY = "latency"
 ERROR_RATE = "error_rate"
@@ -377,6 +377,27 @@ def serving_slos(
             "serving.errors_total", error_rate_objective,
             total_metric="serving.responses_total",
             window_s=window_s, labels=labels, severity=severity),
+    ]
+
+
+def disagg_slos(
+    decode_labels: List[str],
+    p99_objective_s: float = 0.25,
+    window_s: float = 60.0,
+    severity: str = alerts_mod.WARNING,
+) -> List[SLO]:
+    """Interactive decode p99 objectives for a disaggregated fleet: one
+    latency SLO per decode-role worker label. These are what the
+    :class:`~paddle_tpu.serving.disagg.Autoscaler` burns against — point
+    ``AutoscalerConfig(slo_name=...)`` at one of the returned names
+    (``disagg_<label>_decode_p99``). The disaggregation headline is that
+    a prefill storm must not move these."""
+    return [
+        SLO(f"disagg_{lbl}_decode_p99", LATENCY,
+            "serving.request_latency_seconds", p99_objective_s,
+            window_s=window_s, quantile=0.99,
+            labels={"engine": lbl}, severity=severity)
+        for lbl in decode_labels
     ]
 
 

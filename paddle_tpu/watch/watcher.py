@@ -131,6 +131,16 @@ def default_rules() -> List[WatchRule]:
                   det_mod.EwmaDetector(alpha=0.2, z_threshold=6.0,
                                        min_samples=16),
                   invert=True),
+        # disaggregated serving (serving.disagg): per-engine backlog and
+        # live load. A sustained spike on a prefill-role worker is the
+        # queue-depth anomaly signal the Autoscaler's scale_prefill rule
+        # consumes (alongside the decode-p99 SLO burn rate)
+        WatchRule("serving.decode.queue_depth",
+                  det_mod.EwmaDetector(alpha=0.2, z_threshold=8.0,
+                                       min_samples=16)),
+        WatchRule("serving.decode.load",
+                  det_mod.EwmaDetector(alpha=0.2, z_threshold=8.0,
+                                       min_samples=16)),
     ]
 
 

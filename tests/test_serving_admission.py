@@ -105,6 +105,45 @@ def test_batch_only_traffic_drains_without_interactive():
     assert ok and req.cls == BATCH
 
 
+def test_scheduler_poke_bounces_timed_recv_early():
+    """poke() wakes a parked timed recv through its timeout path well
+    before the timeout lapses — the decode engine relies on this so
+    handoff/rescue adoptions don't wait out a full idle poll."""
+    import threading
+    import time as _time
+
+    sched = WeightedFairScheduler(_tenants(t=dict(queue_capacity=10)))
+    woke = {}
+
+    def parked():
+        t0 = _time.monotonic()
+        try:
+            sched.recv(timeout=5.0)
+        except TimeoutError:
+            woke["dt"] = _time.monotonic() - t0
+
+    th = threading.Thread(target=parked)
+    th.start()
+    _time.sleep(0.05)  # let it park in the condition wait
+    sched.poke()
+    th.join(timeout=2.0)
+    assert not th.is_alive() and woke["dt"] < 1.0, woke
+
+    # the flag is one-shot: the next timed recv waits out its own timeout
+    t0 = _time.monotonic()
+    try:
+        sched.recv(timeout=0.1)
+    except TimeoutError:
+        pass
+    assert _time.monotonic() - t0 >= 0.09
+
+    # poke never steals real work: with an item queued, recv returns it
+    sched.poke()
+    assert sched.try_put(FakeReq("t")) is None
+    req, ok = sched.recv(timeout=1)
+    assert ok and req is not None
+
+
 def test_scheduler_quota_rejections_are_typed():
     sched = WeightedFairScheduler(
         _tenants(small=dict(queue_capacity=2, byte_quota=100)))

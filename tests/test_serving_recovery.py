@@ -210,13 +210,25 @@ def test_fault_during_recovery_escalates_to_migration(lm):
     eb = _engine(lm)
     fleet = DecodeFleet([ea, eb])
     try:
+        handles = [ea.submit(p, n) for p, n, _ in lm.cases]
+        # arm the faults only once every case is through prefill: if the
+        # step fault fires while some cases still sit in the admission
+        # queue, the engine (correctly) migrates just the admitted subset
+        # and the count below races with the loop thread
+        total_chunks = sum(-(-len(p) // ea.decode_config.prefill_chunk)
+                           for p, _, _ in lm.cases)
+        deadline = time.monotonic() + 60
+        while (time.monotonic() < deadline
+               and ea.metrics.snapshot()["prefill_chunks_total"]
+               < total_chunks):
+            time.sleep(0.005)
+        assert ea.metrics.snapshot()["prefill_chunks_total"] == total_chunks
         with faults.injected(
             faults.FaultSpec(faults.DECODE_STEP, "error", after=1,
                              match={"engine": ea.metrics.engine_label}),
             faults.FaultSpec(faults.DECODE_RECOVER, "error",
                              match={"engine": ea.metrics.engine_label}),
         ) as plan:
-            handles = [ea.submit(p, n) for p, n, _ in lm.cases]
             outs = [h.result(timeout=120) for h in handles]
             assert plan.all_fired()
         for (_, _, ref), out in zip(lm.cases, outs):
@@ -310,6 +322,86 @@ def test_process_restart_replays_journal_resumes_and_dedupes(lm, tmp_path):
         assert e2.decode_step_cache_size() == 1
     finally:
         e2.close(timeout=30)
+
+
+# ---- journal compaction (PR 15 satellite) ----------------------------------
+
+
+def test_journal_size_triggered_compaction_keeps_incomplete(tmp_path):
+    """Crossing compact_bytes rewrites the WAL: finished requests drop,
+    incomplete ones survive as full snapshots, and replay over the
+    compacted file equals replay over the uncompacted history."""
+    path = os.fspath(tmp_path / "j.wal")
+    j = RequestJournal(path, fsync_every=1, compact_bytes=2048)
+    j.log_admit("keep", np.array([3, 4], np.int32), 8, [], "default",
+                "interactive")
+    j.log_token("keep", 11)
+    j.log_token("keep", 12)
+    # churn finished requests until the size trigger fires
+    i = 0
+    while j.compactions_total == 0:
+        rid = f"done{i}"
+        j.log_admit(rid, np.array([1, 2], np.int32), 4, [], "default",
+                    "interactive")
+        j.log_token(rid, 5)
+        j.log_finish(rid, "length")
+        i += 1
+        assert i < 10_000, "compaction never triggered"
+    assert os.path.getsize(path) < 2048  # rewritten, not just rotated
+    rep = replay_journal(path)
+    # only the incomplete request survives, with its token prefix intact
+    incomplete = {r for r, v in rep.items() if not v.finished}
+    assert incomplete == {"keep"}
+    assert rep["keep"].generated == [11, 12]
+    assert rep["keep"].prompt.tolist() == [3, 4]
+    assert rep["keep"].mnt == 8
+    # ...and the journal keeps accepting appends after the swap
+    j.log_token("keep", 13)
+    j.close()
+    assert replay_journal(path)["keep"].generated == [11, 12, 13]
+
+
+def test_journal_replay_over_compacted_plus_torn_tail(tmp_path):
+    """The two defenses compose: compaction's atomic publish, then a torn
+    append on the NEW segment — replay trusts the compacted snapshot and
+    ignores the torn tail."""
+    path = os.fspath(tmp_path / "j.wal")
+    j = RequestJournal(path, fsync_every=1)
+    j.log_admit("a", np.array([5], np.int32), 6, [], "default",
+                "interactive")
+    j.log_token("a", 9)
+    j.log_admit("b", np.array([6], np.int32), 6, [], "default", "batch")
+    j.log_finish("b", "eos")
+    stats = j.compact()
+    assert stats["kept"] == 1 and stats["dropped"] == 1
+    j.log_token("a", 10)  # post-compaction append lands in the new segment
+    j.close()
+    with open(path, "ab") as f:
+        f.write(b"deadbeef|{\"k\":\"tok\",\"rid\":\"a\"")  # torn, no newline
+    rep = replay_journal(path)
+    assert set(rep) == {"a"}
+    assert not rep["a"].finished
+    assert rep["a"].generated == [9, 10]
+
+
+def test_journal_compaction_under_live_engine(lm, tmp_path):
+    """An engine journaling through a tiny compact_bytes budget compacts
+    mid-traffic without losing replayability or corrupting results."""
+    path = os.fspath(tmp_path / "decode.wal")
+    eng = _engine(lm, journal_path=path, journal_fsync_every=1,
+                  journal_compact_bytes=1024)
+    try:
+        for _ in range(2):  # several generations of churn
+            handles = [eng.submit(p, n) for p, n, _ in lm.cases]
+            for (_, _, ref), h in zip(lm.cases, handles):
+                assert np.array_equal(h.result(timeout=120).tokens, ref)
+        assert eng._journal.compactions_total >= 1
+        eng._journal.flush()
+        rep = replay_journal(path)
+        assert all(r.finished for r in rep.values())
+    finally:
+        eng.close(timeout=30)
+    eng.kv.assert_no_leaks()
 
 
 # ---- close() drain deadline (satellite) ------------------------------------

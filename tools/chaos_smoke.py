@@ -701,6 +701,253 @@ def _spec_decode_phase(work: str, seed: int) -> None:
     e2.kv.assert_no_leaks()
 
 
+def _disagg_phase(work: str, seed: int) -> None:
+    """Disaggregated prefill/decode under chaos (ISSUE 15):
+
+    1. a prefill STORM of long prompts mid-decode must not move the
+       decode-side latency — steady interactive generations complete in
+       the same envelope with or without the storm, and the decode
+       worker never runs a prefill chunk (the role split is structural,
+       not probabilistic);
+    2. a faulted KV-page transfer (``DISAGG_HANDOFF``) degrades to a
+       token-exact re-prefill on the decode worker (rung 2);
+    3. a prefill worker killed mid-handoff — the ``hof`` journal record
+       durable, the receiver's ``ack`` never written — loses zero
+       requests: replay resumes every one on the decode worker,
+       token-exact, with zero leaked pages;
+    4. a drain-and-convert cycle (prefill -> decode -> prefill) under
+       continuous load completes every request token-exact.
+    """
+    import threading
+
+    import jax.numpy as jnp
+    from paddle_tpu import models
+    from paddle_tpu.models.transformer_lm import generate
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import (
+        DecodeConfig,
+        DecodeEngine,
+        DisaggRouter,
+        replay_journal,
+        resume_incomplete,
+    )
+    from paddle_tpu.serving.disagg import DECODE, PREFILL
+
+    rng = np.random.RandomState(seed + 15)
+    spec = models.get_model("transformer_lm", seq_len=64, vocab=97,
+                            d_model=32, d_inner=64, num_heads=4, n_layers=2)
+    cfg = spec.extra["cfg"]
+    variables = spec.model.init(0, *spec.synth_batch(2, rng))
+
+    def mk_engine(**over):
+        kw = dict(max_slots=3, page_size=4, max_context=40, prefill_chunk=8,
+                  num_pages=30, recovery_base_delay_s=0.001,
+                  recovery_max_delay_s=0.005)
+        kw.update(over)
+        return DecodeEngine(variables, cfg, decode=DecodeConfig(**kw))
+
+    cases = []
+    for _ in range(3):
+        p = rng.randint(1, 97, size=(int(rng.randint(4, 8)),)).astype(np.int32)
+        n = int(rng.randint(10, 16))
+        ref = np.asarray(generate(variables, jnp.asarray(p[None]), n, cfg))[0]
+        cases.append((p, n, ref))
+
+    def check_exact(outs, tag):
+        for (_, _, ref), out in zip(cases, outs):
+            check(np.array_equal(out.tokens, ref),
+                  f"{tag}: output not token-exact "
+                  f"(got {list(out.tokens)}, want {ref.tolist()})")
+
+    # leg 1: prefill storm mid-decode — the decode side must not notice
+    pre, dec = mk_engine(), mk_engine()
+    router = DisaggRouter([pre, dec], [PREFILL, DECODE])
+    try:
+        # warm the jits so wave timings measure steady state, not compiles
+        [h.result(timeout=300)
+         for h in [router.submit(p, n) for p, n, _ in cases]]
+
+        def steady_wave():
+            t0 = time.monotonic()
+            lats = []
+            for p, n, _ in cases:
+                s = time.monotonic()
+                outs_one = router.submit(p, n).result(timeout=300)
+                lats.append(time.monotonic() - s)
+                check(len(outs_one.tokens) == n,
+                      f"steady request truncated: {outs_one.finish_reason}")
+            return max(lats), time.monotonic() - t0
+
+        quiet_p99, _ = steady_wave()
+        # 6 long-prompt requests flood the prefill worker...
+        storm = [router.submit(
+            rng.randint(1, 97, size=(26,)).astype(np.int32), 2)
+            for _ in range(6)]
+        # ...while the steady interactive wave runs mid-storm
+        storm_p99, _ = steady_wave()
+        storm_outs = [h.result(timeout=300) for h in storm]
+        check(all(o.finish_reason == "length" for o in storm_outs),
+              f"storm requests lost: {[o.finish_reason for o in storm_outs]}")
+        budget = 3.0 * max(quiet_p99, 0.05) + 1.0
+        check(storm_p99 <= budget,
+              f"prefill storm moved decode p99: quiet={quiet_p99:.3f}s "
+              f"storm={storm_p99:.3f}s (budget {budget:.3f}s)")
+        # the role split is structural: every prefill chunk ran on the
+        # prefill worker, the decode worker only ever adopted pages
+        check(dec.metrics.snapshot()["prefill_chunks_total"] == 0,
+              f"decode worker ran prefill chunks: {dec.metrics.snapshot()}")
+        check(router.handoffs_total == 2 * len(cases) + len(cases) + 6,
+              f"requests bypassed the handoff path: {router.snapshot()}")
+        check(router.handoff_rejects_total == 0,
+              f"unforced handoff rejects: {router.snapshot()}")
+        print(f"[chaos] disagg: storm held decode p99 "
+              f"(quiet={quiet_p99 * 1e3:.0f}ms storm={storm_p99 * 1e3:.0f}ms"
+              f", {router.handoffs_total} handoffs, 0 rejects)")
+    finally:
+        unjoined = router.close(30)
+        check(not unjoined, f"disagg threads failed to join: {unjoined}")
+    pre.kv.assert_no_leaks()
+    dec.kv.assert_no_leaks()
+
+    # leg 2: faulted KV-page transfer — rung 2 re-prefills, token-exact
+    pre, dec = mk_engine(), mk_engine()
+    router = DisaggRouter([pre, dec], [PREFILL, DECODE],
+                          transport="serialized")
+    try:
+        with _inject(
+            faults.FaultSpec(faults.DISAGG_HANDOFF, "error", times=2),
+            seed=seed,
+        ) as plan:
+            handles = [router.submit(p, n) for p, n, _ in cases]
+            outs = [h.result(timeout=300) for h in handles]
+            check(plan.all_fired(),
+                  f"handoff faults never fired: {plan.stats()}")
+        check_exact(outs, "handoff fault")
+        check(router.handoff_rejects_total == 2,
+              f"faulted transfers not rejected: {router.snapshot()}")
+        check(router.handoff_reprefills_total == 2,
+              f"rejected transfers not re-prefilled: {router.snapshot()}")
+        print(f"[chaos] disagg: {router.handoff_rejects_total} faulted "
+              f"transfers rejected + re-prefilled, token-exact")
+    finally:
+        unjoined = router.close(30)
+        check(not unjoined, f"disagg threads failed to join: {unjoined}")
+    pre.kv.assert_no_leaks()
+    dec.kv.assert_no_leaks()
+
+    # leg 3: prefill worker killed mid-handoff. Draining the decode side
+    # wedges every request inside the handoff window — the hof record is
+    # durable (fsync'd BEFORE transfer) but no ack ever lands. kill() is
+    # a simulated crash; replay over the shared WAL must resume every
+    # request on the decode worker, token-exact, zero loss.
+    wal = os.path.join(work, "disagg.wal")
+    pre, dec = mk_engine(), mk_engine()
+    router = DisaggRouter([pre, dec], [PREFILL, DECODE],
+                          journal_path=wal, transport="serialized")
+    try:
+        router._draining.add(id(dec))
+        handles = [router.submit(p, n) for p, n, _ in cases]
+        deadline = time.monotonic() + 120
+        rep = {}
+        while time.monotonic() < deadline:
+            router._journal.flush()
+            rep = replay_journal(wal)
+            if (len(rep) == len(cases)
+                    and all(r.handed_off and not r.acked
+                            for r in rep.values())
+                    and not any(r.finished for r in rep.values())):
+                break
+            time.sleep(0.005)
+        check(len(rep) == len(cases)
+              and all(r.handed_off and not r.acked for r in rep.values()),
+              f"handoff window never reached: {rep}")
+        pre.kill()  # crash mid-handoff: hof durable, ack never written
+        failed = 0
+        for h in handles:
+            try:
+                h.result(timeout=10)
+            except Exception:
+                failed += 1
+        check(failed == len(handles),
+              f"killed worker's handles did not fail typed: {failed}")
+        router._draining.discard(id(dec))
+        router._journal.flush()
+        rep = replay_journal(wal)
+        check(not any(r.finished for r in rep.values()),
+              "crash left finish records in the journal")
+        resumed = resume_incomplete(dec, wal)
+        check(len(resumed) == len(cases),
+              f"resumed {len(resumed)}/{len(cases)} after the crash")
+        by_prompt = {tuple(p.tolist()): ref for p, _, ref in cases}
+        for rid, (rh, n_delivered) in resumed.items():
+            out = rh.result(timeout=300)
+            ref = by_prompt[tuple(rep[rid].prompt.tolist())]
+            check(np.array_equal(out.tokens, ref),
+                  f"request {rid} not token-exact after the crash")
+            check(out.tokens[:n_delivered].tolist()
+                  == rep[rid].generated[:n_delivered],
+                  f"dedup prefix mismatch for {rid}")
+        print(f"[chaos] disagg: killed the prefill worker mid-handoff, "
+              f"resumed {len(resumed)} unacked requests token-exact, 0 lost")
+    finally:
+        unjoined = router.close(30)
+        check(not unjoined, f"disagg threads failed to join: {unjoined}")
+    pre.kv.assert_no_leaks()  # kill released every slot's pages
+    dec.kv.assert_no_leaks()
+
+    # leg 4: drain-and-convert cycle under continuous load
+    built = []
+
+    def factory(role):
+        eng = mk_engine()
+        built.append(eng)
+        return eng
+
+    p1, p2, d1 = mk_engine(), mk_engine(), mk_engine()
+    router = DisaggRouter([p1, p2, d1], [PREFILL, PREFILL, DECODE],
+                          factory=factory)
+    stop = threading.Event()
+    results = []
+
+    def client():
+        k = 0
+        while not stop.is_set():
+            p, n, ref = cases[k % len(cases)]
+            k += 1
+            try:
+                out = router.submit(p, n).result(timeout=300)
+                results.append(bool(np.array_equal(out.tokens, ref)))
+            except Exception as e:  # any loss under conversion = failure
+                results.append(repr(e))
+    try:
+        t = threading.Thread(target=client)
+        t.start()
+        mid = router.convert(p2, DECODE, timeout=30)
+        check(p2.closed, "converted worker was not drained")
+        back = router.convert(mid, PREFILL, timeout=30)
+        time.sleep(0.1)  # a little more load on the reshaped fleet
+        stop.set()
+        t.join(timeout=120)
+        check(not t.is_alive(), "disagg load client failed to finish")
+        check(results and all(r is True for r in results),
+              f"requests lost/corrupted during conversion: "
+              f"{[r for r in results if r is not True][:3]} "
+              f"({len(results)} total)")
+        check(router.conversions_total == 2,
+              f"conversions not recorded: {router.snapshot()}")
+        check(router.n_prefill == 2 and router.n_decode == 1,
+              f"role cycle did not restore the fleet shape: "
+              f"{router.snapshot()}")
+        print(f"[chaos] disagg: drain-and-convert cycle under load, "
+              f"{len(results)} requests token-exact through 2 conversions")
+    finally:
+        stop.set()
+        unjoined = router.close(30)
+        check(not unjoined, f"disagg threads failed to join: {unjoined}")
+    for e in [p1, p2, d1] + built:
+        e.kv.assert_no_leaks()
+
+
 def _overload_phase(work: str, seed: int) -> None:
     """Mixed-tenant overload at ~10x drain capacity with a transiently
     failing replica: interactive p99 must hold its SLO, batch must shed
@@ -899,6 +1146,8 @@ def main(argv=None) -> int:
         _deadlock_canary("decode")
         _spec_decode_phase(work, args.seed)
         _deadlock_canary("spec_decode")
+        _disagg_phase(work, args.seed)
+        _deadlock_canary("disagg")
         _overload_phase(work, args.seed)
         _deadlock_canary("overload")
 
