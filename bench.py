@@ -941,6 +941,117 @@ def serve_decode_main(n_requests: int = 24) -> dict:
     return result
 
 
+def serve_group_main(n_requests: int = 16) -> dict:
+    """Tensor-parallel replica-group benchmark (``bench.py --serve-group``):
+    a seeded mixed-length request set served two ways on CPU JAX —
+
+    - **single**: one ``DecodeEngine`` on one device (the PR 16 baseline
+      discipline: the dispatch unit is a device);
+    - **group**: the same engine backed by a tp=2 ``ReplicaGroup`` — one
+      pjit'd step over a two-device submesh, params and paged KV sharded
+      per ``GroupLayout``, the per-member canary probing every loop.
+
+    Headline metric: group-mode generated tokens/sec. The ratio
+    ``group_vs_single_tok_per_sec`` is the rolling baseline — on a CPU
+    host both "devices" share the same cores, so the ratio measures the
+    partitioning + collective overhead (< 1.0 expected; on a real pod the
+    ICI collectives overlap and the win is HBM: half the params and KV
+    per chip). ``group_probe_overhead_pct`` is the whole per-member
+    canary tax (timed host→device probes + skew bookkeeping), gated so
+    the always-on health check stays cheap. Both legs must agree
+    token-for-token and stay compile-flat. Prints ONE JSON line."""
+    # the tp=2 submesh needs two devices BEFORE jax initializes
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from paddle_tpu import models
+    from paddle_tpu.serving import DecodeConfig, DecodeEngine
+    from paddle_tpu.serving.shardgroup import make_groups, probe_members
+
+    result = {
+        "metric": "group_serve_tok_per_sec",
+        "value": 0.0,
+        "unit": "tok/s",
+        "notes": [],
+    }
+    try:
+        result["device_kind"] = jax.devices()[0].device_kind
+        from paddle_tpu.core import locks as _locks
+        _locks.set_enabled(False)  # production default; measured elsewhere
+        vocab, slots = 512, 4
+        spec = models.get_model("transformer_lm", seq_len=128, vocab=vocab,
+                                d_model=64, d_inner=128, num_heads=4,
+                                n_layers=2)
+        cfg = spec.extra["cfg"]
+        rng = np.random.RandomState(0)
+        variables = spec.model.init(0, *spec.synth_batch(2, rng))
+        reqs = []
+        for _ in range(n_requests):
+            tp = int(rng.randint(4, 25))
+            mnt = int(rng.randint(8, 49))
+            reqs.append((rng.randint(1, vocab, size=(tp,)).astype(np.int32),
+                         mnt))
+        dconf = dict(max_slots=slots, page_size=16, max_context=128,
+                     prefill_chunk=16)
+
+        def run(group, probe_every):
+            eng = DecodeEngine(variables, cfg, decode=DecodeConfig(
+                group_probe_every_s=probe_every, **dconf), group=group)
+            t0 = time.perf_counter()
+            handles = [eng.submit(p, mnt) for p, mnt in reqs]
+            outs = [h.result(timeout=600) for h in handles]
+            dt = time.perf_counter() - t0
+            gen = sum(len(o.tokens) for o in outs)
+            flat = (eng.decode_step_cache_size() == 1
+                    and eng.prefill_cache_size() == 1)
+            eng.close()
+            eng.kv.assert_no_leaks()
+            return outs, gen / dt, flat
+
+        group = make_groups(2)[0]
+        outs_single, tps_single, flat_single = run(None, 0.05)
+        # group leg 1: probes at the production cadence
+        outs_group, tps_group, flat_group = run(group, 0.05)
+        # group leg 2: canary on EVERY loop iteration — the delta against
+        # the cadenced leg bounds the probe tax from above
+        _, tps_probe, _ = run(group, 0.0)
+
+        exact = all(np.array_equal(a.tokens, b.tokens)
+                    for a, b in zip(outs_single, outs_group))
+        # standalone probe cost, for the notes: one full member sweep
+        t0 = time.perf_counter()
+        for _ in range(50):
+            probe_members(group)
+        probe_ms = (time.perf_counter() - t0) / 50 * 1e3
+
+        result["value"] = round(tps_group, 1)
+        result["group_single_tok_per_sec"] = round(tps_single, 1)
+        result["group_vs_single_tok_per_sec"] = round(
+            tps_group / max(tps_single, 1e-9), 3)
+        result["group_probe_overhead_pct"] = round(
+            100.0 * (1.0 - tps_probe / max(tps_group, 1e-9)), 1)
+        result["group_probe_ms"] = round(probe_ms, 3)
+        result["tp_degree"] = 2
+        result["requests"] = len(reqs)
+        result["compile_flat"] = flat_single and flat_group
+        if not (flat_single and flat_group):
+            result["notes"].append("decode step recompiled under traffic")
+        if not exact:
+            result["notes"].append("group tokens diverged from single")
+    except Exception as e:  # same robustness contract as main(): always JSON
+        result["notes"].append(
+            f"serve_group_failed: {type(e).__name__}: {e}"[:300])
+    print(json.dumps(result))
+    return result
+
+
 def serve_disagg_main(n_rounds: int = 4) -> dict:
     """Disaggregated prefill/decode benchmark (``bench.py --serve-disagg``):
     the same storm-under-decode workload served two ways on CPU JAX —
@@ -1363,6 +1474,9 @@ if __name__ == "__main__":
         tune_child_main(sys.argv[i + 1], sys.argv[i + 2])
     elif "--tune" in sys.argv:
         tune_main()
+    elif "--serve-group" in sys.argv:
+        serve_group_main(
+            n_requests=int(os.environ.get("PT_BENCH_GROUP_REQS", "16")))
     elif "--serve-disagg" in sys.argv:
         serve_disagg_main(
             n_rounds=int(os.environ.get("PT_BENCH_DISAGG_ROUNDS", "4")))

@@ -17,18 +17,23 @@ from paddle_tpu.parallel.mesh import (
     make_mesh,
     default_mesh,
     initialize_distributed,
+    partition_devices,
+    tp_submesh,
     DATA_AXIS,
     MODEL_AXIS,
     SEQ_AXIS,
     PIPE_AXIS,
     EXPERT_AXIS,
+    TP_AXIS,
 )
 from paddle_tpu.parallel import collective
 from paddle_tpu.parallel.sharding import (
+    degrade_spec,
     param_shardings,
     replicated,
     batch_sharding,
     shard_variables,
+    spec_for,
 )
 from paddle_tpu.parallel.data_parallel import DataParallel
 from paddle_tpu.parallel.pipeline import (
@@ -42,16 +47,21 @@ __all__ = [
     "make_mesh",
     "default_mesh",
     "initialize_distributed",
+    "partition_devices",
+    "tp_submesh",
     "DATA_AXIS",
     "MODEL_AXIS",
     "SEQ_AXIS",
     "PIPE_AXIS",
     "EXPERT_AXIS",
+    "TP_AXIS",
     "collective",
+    "degrade_spec",
     "param_shardings",
     "replicated",
     "batch_sharding",
     "shard_variables",
+    "spec_for",
     "DataParallel",
     "pipeline_apply",
     "stack_stage_params",

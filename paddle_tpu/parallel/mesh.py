@@ -29,6 +29,7 @@ MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 PIPE_AXIS = "pipe"
 EXPERT_AXIS = "expert"
+TP_AXIS = "tp"
 
 
 def initialize_distributed(
@@ -100,6 +101,27 @@ def default_mesh() -> Mesh:
     """All local devices on a single data axis (pure DP — the reference
     ParallelExecutor default)."""
     return make_mesh({DATA_AXIS: -1})
+
+
+def tp_submesh(devices: Sequence) -> Mesh:
+    """A single-axis ``tp`` Mesh over an explicit ordered device tuple — the
+    program scope of one serving replica group. Device ORDER is the caller's
+    contract (ICI-contiguous slices keep the tp collectives on-chip)."""
+    devices = list(devices)
+    enforce(devices, "tp_submesh needs at least one device")
+    return make_mesh({TP_AXIS: len(devices)}, devices=devices)
+
+
+def partition_devices(tp: int, devices: Optional[Sequence] = None):
+    """Slice a device list into ICI-contiguous groups of ``tp`` (the serving
+    analogue of NCCLContextMap's per-ring device slicing). Leftover devices
+    that don't fill a group are dropped — returns a list of device tuples."""
+    devices = list(devices if devices is not None else jax.devices())
+    enforce(tp >= 1, f"partition_devices: tp must be >= 1, got {tp}")
+    return [
+        tuple(devices[i : i + tp])
+        for i in range(0, len(devices) - tp + 1, tp)
+    ]
 
 
 def remesh(mesh: Mesh, devices: Sequence, resize_axis: str = DATA_AXIS) -> Mesh:

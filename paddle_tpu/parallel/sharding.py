@@ -9,16 +9,63 @@ MultiDevSSAGraphBuilder (replicate params everywhere + allreduce grads —
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+import fnmatch
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from paddle_tpu.core.enforce import enforce
 from paddle_tpu.framework import ParamInfo, Variables
+
+# A rule table: ordered (glob-pattern, PartitionSpec) pairs, first match wins.
+ShardingRules = Sequence[Tuple[str, P]]
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def spec_for(
+    param_name: str,
+    rules: ShardingRules,
+    *,
+    ndim: Optional[int] = None,
+    fallback: P = P(),
+) -> P:
+    """Look up the PartitionSpec for ``param_name`` in an ordered rule table
+    of ``(glob_pattern, PartitionSpec)`` pairs — first match wins, unknown
+    params fall back to ``fallback`` (replicated by default) so a new layer
+    never silently inherits a stale layout. When ``ndim`` is given, a matched
+    spec naming more dims than the param has rank is an EnforceError: a rule
+    written for ``[D, H*dh]`` applied to a 1-d bias is a layout bug, not
+    something to truncate quietly."""
+    for pattern, spec in rules:
+        if fnmatch.fnmatchcase(param_name, pattern):
+            if ndim is not None:
+                enforce(
+                    len(spec) <= ndim,
+                    f"spec_for({param_name!r}): rule {pattern!r} names "
+                    f"{len(spec)} dims but param has rank {ndim}",
+                )
+            return spec
+    return fallback
+
+
+def degrade_spec(mesh: Mesh, spec: P, shape: Tuple[int, ...]) -> P:
+    """Per-dim degradation to replicated: drop a sharded dim when its mesh
+    axis is missing or its size doesn't divide the dim (same contract as
+    ``param_shardings`` so one model definition runs on any mesh/tp shape).
+    The spec is right-padded with None to the array rank."""
+    axis_sizes = {
+        name: int(size) for name, size in zip(mesh.axis_names, mesh.devices.shape)
+    }
+    dims = tuple(spec) + (None,) * max(0, len(shape) - len(spec))
+    out = []
+    for dim_size, axis in zip(shape, dims):
+        n = axis_sizes.get(axis) if axis is not None else None
+        out.append(axis if (n is not None and dim_size % n == 0) else None)
+    return P(*out)
 
 
 def batch_sharding(mesh: Mesh, axis: str = "data", ndim: int = 2) -> NamedSharding:

@@ -61,6 +61,7 @@ __all__ = [
     "DECODE_STEP",
     "DECODE_RECOVER",
     "DISAGG_HANDOFF",
+    "GROUP_MEMBER",
     "DEVICE_LOST",
     "PREEMPT_NOTICE",
     "DeviceLostError",
@@ -87,6 +88,13 @@ DECODE_RECOVER = "serving.decode.recover"
 # models a torn/failed transfer, which must degrade to re-prefill on
 # another worker (never a lost request)
 DISAGG_HANDOFF = "serving.disagg.handoff"
+# per-member canary of a tensor-parallel replica group
+# (serving.shardgroup.probe_members): fires once per shard with
+# ctx={engine, shard, device}, so chaos can fail or stall exactly ONE chip
+# of a group — an "error" here must eject the WHOLE group (breaker trip +
+# zero-loss migration) and a "stall" must be localized by the shard-skew
+# straggler watch
+GROUP_MEMBER = "serving.group.member"
 # elastic-training points (trainer step loop): a replica/device vanishing
 # mid-step, and the scheduler's advance preemption notice — both are
 # hardware/cluster events in production, injectable here so the whole
@@ -110,6 +118,7 @@ def registered_points() -> List[str]:
         DECODE_STEP,
         DECODE_RECOVER,
         DISAGG_HANDOFF,
+        GROUP_MEMBER,
         DEVICE_LOST,
         PREEMPT_NOTICE,
     ]

@@ -100,6 +100,14 @@ from paddle_tpu.serving.scheduler import (
     INTERACTIVE,
     WeightedFairScheduler,
 )
+from paddle_tpu.serving.shardgroup import (
+    GroupLayout,
+    GroupStragglerWatch,
+    ReplicaGroup,
+    default_layout,
+    make_groups,
+    probe_members,
+)
 
 __all__ = [
     "ServingEngine",
@@ -141,4 +149,10 @@ __all__ = [
     "HandoffCorrupt",
     "Autoscaler",
     "AutoscalerConfig",
+    "ReplicaGroup",
+    "GroupLayout",
+    "GroupStragglerWatch",
+    "make_groups",
+    "default_layout",
+    "probe_members",
 ]

@@ -88,6 +88,13 @@ from paddle_tpu.serving.engine import (
 from paddle_tpu.serving.kv_cache import SCRATCH_PAGE, PagedKVCache
 from paddle_tpu.serving.metrics import DecodeMetrics
 from paddle_tpu.serving.prefix_cache import RadixPrefixCache
+from paddle_tpu.serving.shardgroup import (
+    GroupLayout,
+    GroupStragglerWatch,
+    ReplicaGroup,
+    default_layout,
+    probe_members,
+)
 from paddle_tpu.serving.recovery import (
     EngineUnhealthy,
     RequestJournal,
@@ -185,6 +192,14 @@ class DecodeConfig:
     # requests drop, incomplete ones are rewritten as snapshots into a
     # fresh segment (atomic publish). None = unbounded growth.
     journal_compact_bytes: Optional[int] = None
+    # -- replica groups (serving.shardgroup) ------------------------------
+    # per-member canary cadence when the engine is group-backed: each
+    # member device is timed individually so a fault or stall is
+    # attributable to ONE chip of the group
+    group_probe_every_s: float = 0.05
+    # per-shard probe-time skew (vs the median shard) that flags a
+    # straggler chip inside the group
+    group_skew_ratio: float = 4.0
 
 
 @dataclasses.dataclass
@@ -366,6 +381,8 @@ class DecodeEngine:
         decode: Optional[DecodeConfig] = None,
         draft_variables=None,
         draft_cfg: Optional[dict] = None,
+        group: Optional[ReplicaGroup] = None,
+        layout: Optional[GroupLayout] = None,
     ):
         self.config = config or ServingConfig()
         self.decode_config = dconf = decode or DecodeConfig()
@@ -393,27 +410,57 @@ class DecodeEngine:
         self.cost = DecodeCostModel()
 
         params = variables.params if hasattr(variables, "params") else variables
-        self._params = jax.device_put(params)
+        # replica-group mode (serving.shardgroup): the engine's program
+        # spans the group's tp submesh — params and KV pages are committed
+        # with the layout's NamedShardings and every jit pins its page
+        # outputs to the same sharding, so the cache arrays never change
+        # placement and the compile-once invariants hold per GROUP exactly
+        # as they do per device
+        self._group = group
+        self._layout = (layout or default_layout()) if group is not None else None
+        self._straggler = (GroupStragglerWatch(group,
+                                               ratio=dconf.group_skew_ratio)
+                           if group is not None else None)
+        self._last_probe = 0.0
         cdt = (dconf.cache_dtype if dconf.cache_dtype is not None
                else self.config.cache_dtype)
         pshape = paged_cache_shape(self.model_cfg, num_pages, dconf.page_size)
         import jax.numpy as jnp
 
         self._cache_dtype = cdt or jnp.float32
-        self._k_pages = jnp.zeros(pshape, self._cache_dtype)
-        self._v_pages = jnp.zeros(pshape, self._cache_dtype)
+        if group is None:
+            self._params = jax.device_put(params)
+            self._k_pages = jnp.zeros(pshape, self._cache_dtype)
+            self._v_pages = jnp.zeros(pshape, self._cache_dtype)
+            kvs = rep = None
+        else:
+            self._params = self._layout.shard_params(group, params)
+            kvs = self._layout.kv_page_sharding(group, pshape)
+            rep = self._layout.replicated(group)
+            self._k_pages = jax.device_put(
+                jnp.zeros(pshape, self._cache_dtype), kvs)
+            self._v_pages = jax.device_put(
+                jnp.zeros(pshape, self._cache_dtype), kvs)
+        jit_kw = {} if group is None else {"out_shardings": (rep, kvs, kvs)}
         sample_kw = dict(temperature=dconf.temperature, top_k=dconf.top_k,
                          top_p=dconf.top_p)
         self._step = jax.jit(functools.partial(
             paged_decode_step, cfg=self.model_cfg,
-            page_size=dconf.page_size, **sample_kw))
+            page_size=dconf.page_size, **sample_kw), **jit_kw)
         self._prefill = jax.jit(functools.partial(
             paged_prefill_chunk, cfg=self.model_cfg,
-            page_size=dconf.page_size, **sample_kw))
+            page_size=dconf.page_size, **sample_kw), **jit_kw)
         # disagg KV handoff (serving.disagg): one page is the fixed-shape
-        # [L, H_kv, page_size, dh] slice, so gather/implant compile once
-        self._gather_page = jax.jit(collective.gather_kv_page)
-        self._implant_page = jax.jit(collective.scatter_kv_page)
+        # [L, H_kv, page_size, dh] slice, so gather/implant compile once.
+        # In group mode the gather's output is pinned replicated — the
+        # wire image is always the FULL logical page regardless of tp —
+        # and the implant re-scatters it back over the group's heads.
+        self._gather_page = jax.jit(
+            collective.gather_kv_page,
+            **({} if group is None else {"out_shardings": rep}))
+        self._implant_page = jax.jit(
+            collective.scatter_kv_page,
+            **({} if group is None else {"out_shardings": kvs}))
         self._rng = (jax.random.PRNGKey(dconf.rng_seed)
                      if dconf.temperature > 0.0 else None)
 
@@ -434,24 +481,34 @@ class DecodeEngine:
                     f"{self.model_cfg.get('vocab')})")
             dp = (draft_variables.params
                   if hasattr(draft_variables, "params") else draft_variables)
-            self._draft_params = jax.device_put(dp)
             self._spec_k = int(dconf.spec_tokens)
             # the draft reads/writes THROUGH the same page tables: its own
             # page arrays, same (num_pages, page_size) geometry, so slot
             # bookkeeping (grow/preempt/trim) covers both caches at once
             dshape = paged_cache_shape(self.draft_cfg, num_pages,
                                        dconf.page_size)
-            self._dk_pages = jnp.zeros(dshape, self._cache_dtype)
-            self._dv_pages = jnp.zeros(dshape, self._cache_dtype)
+            if group is None:
+                self._draft_params = jax.device_put(dp)
+                self._dk_pages = jnp.zeros(dshape, self._cache_dtype)
+                self._dv_pages = jnp.zeros(dshape, self._cache_dtype)
+                djit_kw = {}
+            else:
+                self._draft_params = self._layout.shard_params(group, dp)
+                dkvs = self._layout.kv_page_sharding(group, dshape)
+                self._dk_pages = jax.device_put(
+                    jnp.zeros(dshape, self._cache_dtype), dkvs)
+                self._dv_pages = jax.device_put(
+                    jnp.zeros(dshape, self._cache_dtype), dkvs)
+                djit_kw = {"out_shardings": (rep, dkvs, dkvs)}
             self._draft_step = jax.jit(functools.partial(
                 paged_decode_step, cfg=self.draft_cfg,
-                page_size=dconf.page_size, temperature=0.0))
+                page_size=dconf.page_size, temperature=0.0), **djit_kw)
             self._draft_prefill = jax.jit(functools.partial(
                 paged_prefill_chunk, cfg=self.draft_cfg,
-                page_size=dconf.page_size, temperature=0.0))
+                page_size=dconf.page_size, temperature=0.0), **djit_kw)
             self._verify = jax.jit(functools.partial(
                 paged_verify_step, cfg=self.model_cfg,
-                page_size=dconf.page_size))
+                page_size=dconf.page_size), **jit_kw)
 
         # -- radix prefix cache -------------------------------------------
         self._prefix: Optional[RadixPrefixCache] = None
@@ -460,9 +517,16 @@ class DecodeEngine:
                 self._kv.allocator, dconf.page_size,
                 max_pages=dconf.prefix_cache_pages)
             # device-side page copy for CoW; src/dst are traced scalars so
-            # this compiles once per page-array shape
+            # this compiles once per page-array shape. Group mode pins the
+            # output to the page arrays' sharding (target and draft pages
+            # may shard differently, hence two jits) so the cache arrays
+            # never drift placement between iterations.
+            _copy = lambda pages, src, dst: pages.at[:, dst].set(pages[:, src])
             self._copy_page = jax.jit(
-                lambda pages, src, dst: pages.at[:, dst].set(pages[:, src]))
+                _copy, **({} if group is None else {"out_shardings": kvs}))
+            self._copy_page_d = (self._copy_page if group is None
+                                 or not self._spec_k else jax.jit(
+                                     _copy, out_shardings=dkvs))
 
         # tenants / scheduler / admission — same wiring as ServingEngine,
         # but deadline feasibility runs through the per-token cost model
@@ -588,8 +652,8 @@ class DecodeEngine:
             self._k_pages = self._copy_page(self._k_pages, z, z)
             self._v_pages = self._copy_page(self._v_pages, z, z)
             if self._spec_k:
-                self._dk_pages = self._copy_page(self._dk_pages, z, z)
-                self._dv_pages = self._copy_page(self._dv_pages, z, z)
+                self._dk_pages = self._copy_page_d(self._dk_pages, z, z)
+                self._dv_pages = self._copy_page_d(self._dv_pages, z, z)
         # persist the compiled keys so a restarted engine can prewarm
         from paddle_tpu.tune import warmup as tune_warmup
 
@@ -619,9 +683,14 @@ class DecodeEngine:
         decode-shape knobs (a config change must not replay stale keys)."""
         d = self.decode_config
         mc = self.model_cfg
-        return ("decode_L{l}_D{dm}_S{s}_P{p}_C{c}".format(
+        name = ("decode_L{l}_D{dm}_S{s}_P{p}_C{c}".format(
             l=mc.get("n_layers", 0), dm=mc.get("d_model", 0),
             s=d.max_slots, p=d.page_size, c=d.prefill_chunk))
+        if self._group is not None:
+            # a group program is a different executable than the
+            # single-device one — never replay the other's keys
+            name += f"_tp{self._group.tp}"
+        return name
 
     def prewarm(self) -> int:
         """Replay the persisted warmup manifest: when a previous process
@@ -671,6 +740,20 @@ class DecodeEngine:
     @property
     def kv(self) -> PagedKVCache:
         return self._kv
+
+    @property
+    def group(self) -> Optional[ReplicaGroup]:
+        """The tp replica group backing this engine (None = the classic
+        single-device mode)."""
+        return self._group
+
+    @property
+    def tp_degree(self) -> int:
+        """Tensor-parallel degree of the backing program (1 = single
+        device). Stamped into handoff payloads so cross-group adoption
+        with a DIFFERENT degree degrades to re-prefill instead of
+        implanting pages scattered for the wrong head partition."""
+        return self._group.tp if self._group is not None else 1
 
     @property
     def prefix(self) -> Optional[RadixPrefixCache]:
@@ -893,6 +976,7 @@ class DecodeEngine:
                 self._force_drain()
                 break
             self._sweep_cancel_deadline()
+            self._probe_group()
             self._admit_handoffs()
             self._admit()
             t0 = time.perf_counter()
@@ -1027,8 +1111,14 @@ class DecodeEngine:
             n_pages = -(-int(payload.cur_len) // dconf.page_size)
             ok = False
             # a draft model keeps its own page arrays, which the payload
-            # does not carry — re-prefill fills both caches correctly
+            # does not carry — re-prefill fills both caches correctly.
+            # A payload gathered under a DIFFERENT tp degree ran a
+            # different partitioned program; adopting its pages verbatim
+            # would splice two programs' numerics mid-sequence, so
+            # cross-degree adoption degrades to re-prefill (the target
+            # group recomputes the context self-consistently).
             if (not self._spec_k
+                    and int(getattr(payload, "tp_degree", 1)) == self.tp_degree
                     and payload.page_size == dconf.page_size
                     and 0 < payload.cur_len <= dconf.max_context
                     and len(payload.k_pages) == n_pages
@@ -1121,8 +1211,8 @@ class DecodeEngine:
                 self._k_pages = self._copy_page(self._k_pages, s, d)
                 self._v_pages = self._copy_page(self._v_pages, s, d)
                 if self._spec_k:
-                    self._dk_pages = self._copy_page(self._dk_pages, s, d)
-                    self._dv_pages = self._copy_page(self._dv_pages, s, d)
+                    self._dk_pages = self._copy_page_d(self._dk_pages, s, d)
+                    self._dv_pages = self._copy_page_d(self._dv_pages, s, d)
                 cow_done += 1
         req.chunks_done = c0
         self._kv.seq_lens[req.slot] = m * ps
@@ -1464,6 +1554,49 @@ class DecodeEngine:
             runlog.emit("engine_recovered",
                         engine=self.metrics.engine_label)
 
+    def _probe_group(self) -> None:
+        """Group-backed engines only: per-member canary at
+        ``group_probe_every_s`` cadence. ANY member fault is fatal for
+        the WHOLE group — the jitted program spans every chip, so one
+        sick member poisons every shard's collectives: trip the breaker
+        and eject (migrate via the fleet when attached, else quarantine
+        through the resume path). Healthy probes feed the shard-skew
+        straggler watch, which localizes a slow chip by shard index."""
+        if self._group is None:
+            return
+        now = time.monotonic()
+        if now - self._last_probe < self.decode_config.group_probe_every_s:
+            return
+        self._last_probe = now
+        try:
+            times = probe_members(
+                self._group, engine_label=self.metrics.engine_label)
+        except Exception as e:
+            self.metrics.record_member_fault()
+            self._breaker_dirty = True
+            runlog.emit("group_member_fault",
+                        engine=self.metrics.engine_label,
+                        group=self._group.name, error=repr(e),
+                        in_flight=len(self._active))
+            ptlog.error("group %s member fault (%r): ejecting whole group",
+                        self._group.name, e)
+            if self._rescue_sink is not None:
+                self._migrate_out(e)
+            else:
+                self._breaker.trip()
+                self._quarantine(e)
+            return
+        skew, flagged = self._straggler.observe(times)
+        self.metrics.set_shard_skew(skew)
+        for shard, secs in times.items():
+            self.metrics.set_shard_probe_seconds(shard, secs)
+        if flagged is not None:
+            self.metrics.record_shard_straggler()
+            runlog.emit("group_shard_straggler",
+                        engine=self.metrics.engine_label,
+                        group=self._group.name, shard=flagged,
+                        skew=round(skew, 3))
+
     def _recover_step_fault(self, exc: BaseException) -> None:
         """A jitted decode step failed: only that iteration's KV writes
         are lost, and every live request is reconstructible from host
@@ -1724,7 +1857,7 @@ class DecodeEngine:
             cur_len=int(req.cur_len), last_tok=int(req.last_tok),
             page_size=dconf.page_size, k_pages=k_pages, v_pages=v_pages,
             src=self.metrics.engine_label, handle=req.handle,
-            trace=req.trace)
+            trace=req.trace, tp_degree=self.tp_degree)
         self._release(req)
         try:
             self._handoff_sink(self, payload)

@@ -114,6 +114,11 @@ class HandoffPayload:
     src: str = ""
     handle: Optional[Any] = None
     trace: Optional[Any] = None
+    # tp degree of the group that GATHERED the pages (1 = single device).
+    # Pages on the wire are always full logical pages, but an adopter
+    # with a different degree ran a different partitioned program, so it
+    # rejects the pages and re-prefills (serving.decode._admit_handoffs)
+    tp_degree: int = 1
 
     def to_bytes(self) -> bytes:
         """Serialize for cross-process transfer: a CRC-protected JSON
@@ -140,6 +145,7 @@ class HandoffPayload:
             "t_submit": float(self.t_submit),
             "n_preemptions": int(self.n_preemptions),
             "src": self.src,
+            "tp_degree": int(self.tp_degree),
             "n_pages": len(self.k_pages),
             "shape": shape,
             "dtype": dtype,
@@ -205,6 +211,7 @@ class HandoffPayload:
             t_submit=float(h.get("t_submit", 0.0)),
             n_preemptions=int(h.get("n_preemptions", 0)),
             src=h.get("src", ""),
+            tp_degree=int(h.get("tp_degree", 1)),
         )
 
     def to_rescue_packet(self) -> RescuePacket:

@@ -154,6 +154,20 @@ class PagedKVCache:
         # other slots): writes into these must copy-on-write first
         self._slot_shared: List[set] = [set() for _ in range(max_slots)]
 
+    def geometry(self) -> dict:
+        """The cache's shape contract as a plain dict. Two caches with
+        equal geometry index the same logical pages — the invariant the
+        tp replica groups lean on: page ids are global across a group
+        (only KV *heads* are sharded over the ``tp`` axis), so this one
+        host-side bookkeeper serves every shard and refcounts, the radix
+        prefix cache, CoW and trim run unchanged per shard."""
+        return {
+            "max_slots": self.max_slots,
+            "page_size": self.page_size,
+            "num_pages": self.allocator.num_pages,
+            "pages_per_slot": self.pages_per_slot,
+        }
+
     # -- slot lifecycle ----------------------------------------------------
 
     def acquire_slot(self) -> Optional[int]:

@@ -359,6 +359,9 @@ class DecodeMetrics:
         # disaggregated prefill/decode (serving.disagg.* families)
         self.handoffs_out_total = 0       # prefilled requests published
         self.handoffs_in_total = 0        # handed-off requests adopted
+        # tp replica groups (serving.group.* families)
+        self.group_member_faults_total = 0  # member canary faults (ejections)
+        self.shard_stragglers_total = 0     # probes that flagged a slow shard
         # tenant-quota admission accounting (serving.tenant.* families)
         self._tenant_admitted: collections.Counter = collections.Counter()
         self._tenant_shed: collections.Counter = collections.Counter()
@@ -580,6 +583,32 @@ class DecodeMetrics:
         prof.inc_counter("serving.disagg.handoffs_in_total",
                          labels=self._labels)
 
+    # -- tp replica groups (serving.group.* families) ------------------------
+
+    def record_member_fault(self) -> None:
+        """A per-member canary probe raised — the whole group is being
+        ejected (breaker trip + migration); counted once per probe pass."""
+        with self._lock:
+            self.group_member_faults_total += 1
+        prof.inc_counter("serving.group.member_faults_total",
+                         labels=self._labels)
+
+    def record_shard_straggler(self) -> None:
+        """The straggler watch localized a slow chip inside the group."""
+        with self._lock:
+            self.shard_stragglers_total += 1
+        prof.inc_counter("serving.group.shard_stragglers_total",
+                         labels=self._labels)
+
+    def set_shard_skew(self, skew: float) -> None:
+        """Worst shard's recent probe-time mean over the median shard mean
+        (1.0 = perfectly balanced) — the watch layer's localization signal."""
+        prof.set_gauge("serving.group.shard_skew", skew, labels=self._labels)
+
+    def set_shard_probe_seconds(self, shard: int, seconds: float) -> None:
+        prof.set_gauge("serving.group.shard_probe_seconds", seconds,
+                       labels={**self._labels, "shard": str(shard)})
+
     def set_load(self, load: float) -> None:
         """Live routing-load signal (active slots + queued/parked work) —
         what :meth:`DecodeFleet._pick` ranks engines by; refreshed every
@@ -679,6 +708,8 @@ class DecodeMetrics:
                 "cow_copies_total": self.cow_copies_total,
                 "handoffs_out_total": self.handoffs_out_total,
                 "handoffs_in_total": self.handoffs_in_total,
+                "group_member_faults_total": self.group_member_faults_total,
+                "shard_stragglers_total": self.shard_stragglers_total,
                 "mean_step_occupancy": (
                     self.tokens_total / self.steps_total
                     if self.steps_total else 0.0),

@@ -448,6 +448,28 @@ class DecodeFleet:
         for eng in self.engines:
             eng._rescue_sink = self._rescue
 
+    @classmethod
+    def from_groups(cls, variables, model_cfg, groups, *,
+                    layout=None, config=None, decode=None,
+                    **engine_kwargs) -> "DecodeFleet":
+        """Build a fleet with one group-backed engine per
+        :class:`~paddle_tpu.serving.shardgroup.ReplicaGroup` — the
+        pod-scale shape where the routing unit is a tp submesh, not a
+        device. Engine labels default to the group names so breaker
+        trips, migrations and shard-skew gauges attribute to a group."""
+        # imported here: decode.py imports this module's RescuePacket
+        from paddle_tpu.serving.decode import DecodeEngine
+        from paddle_tpu.serving.engine import ServingConfig
+        engines = []
+        for g in groups:
+            sc = dataclasses.replace(
+                config if config is not None else ServingConfig(),
+                engine_label=g.name)
+            engines.append(DecodeEngine(
+                variables, model_cfg, config=sc, decode=decode,
+                group=g, layout=layout, **engine_kwargs))
+        return cls(engines)
+
     def _order(self, candidates: Optional[List[Any]] = None) -> List[Any]:
         """Rotating view over ``candidates`` (default: every engine) —
         keeps half-open probes fair when several breakers cool down at
@@ -478,9 +500,15 @@ class DecodeFleet:
         if not healthy:
             return None
         # least-loaded over CLOSED breakers: a saturated engine stops
-        # receiving new work while a peer has capacity (ties keep the
-        # rotating order, so equal-load engines still round-robin)
-        return min(healthy, key=lambda e: e.load())
+        # receiving new work while a peer has capacity. Ties break on the
+        # engine's stable fleet index, NOT the rotated order — the rotation
+        # exists for half-open-probe fairness above, but letting it leak
+        # into the load ranking made equal-load placement depend on how
+        # many picks had ever happened, so identical traffic replayed onto
+        # different engines run-to-run.
+        pos = {id(e): i for i, e in enumerate(self.engines)}
+        n = len(self.engines)
+        return min(healthy, key=lambda e: (e.load(), pos.get(id(e), n)))
 
     def submit(self, prompt, max_new_tokens: int, **kwargs):
         eng = self._pick()

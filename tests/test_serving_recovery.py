@@ -203,6 +203,74 @@ def test_unhealthy_engine_migrates_token_exact_then_readmits(lm):
         fleet.close(timeout=30)
 
 
+@pytest.mark.skipif(__import__("jax").device_count() < 4,
+                    reason="needs 4 virtual devices (conftest)")
+def test_group_member_fault_migrates_cross_group_token_exact(lm):
+    """ISSUE 16: a tp replica group is the routing unit — ONE member's
+    canary fault must eject the WHOLE group (breaker trip) and finish
+    every live request token-exactly on another group, then half-open
+    probing re-admits the group once the member heals."""
+    from paddle_tpu.serving.shardgroup import make_groups
+
+    fleet = DecodeFleet.from_groups(
+        lm.variables, lm.cfg, make_groups(2)[:2],
+        decode=DecodeConfig(group_probe_every_s=0.0, **DC))
+    ga, gb = fleet.engines
+    try:
+        handles = [ga.submit(p, n) for p, n, _ in lm.cases]  # pin to A
+        # arm the canary only once every case is live in decode (same
+        # rationale as the escalation test below: a fault while some
+        # cases still queue migrates just the admitted subset)
+        total_chunks = sum(-(-len(p) // ga.decode_config.prefill_chunk)
+                           for p, _, _ in lm.cases)
+        deadline = time.monotonic() + 60
+        while (time.monotonic() < deadline
+               and ga.metrics.snapshot()["prefill_chunks_total"]
+               < total_chunks):
+            time.sleep(0.005)
+        assert ga.metrics.snapshot()["prefill_chunks_total"] == total_chunks
+        with faults.injected(
+            faults.FaultSpec(faults.GROUP_MEMBER, "error", times=1,
+                             match={"engine": ga.metrics.engine_label,
+                                    "shard": 1})
+        ) as plan:
+            outs = [h.result(timeout=120) for h in handles]
+            assert plan.all_fired()
+            for (_, _, ref), out in zip(lm.cases, outs):
+                assert np.array_equal(out.tokens, ref)
+            assert ga.breaker.state == OPEN
+            snap = ga.metrics.snapshot()
+            assert snap["group_member_faults_total"] == 1, snap
+            assert snap["migrated_total"] == len(lm.cases), snap
+            assert snap["errors_total"] == 0, snap
+            assert gb.metrics.snapshot()["errors_total"] == 0
+            assert gb.decode_step_cache_size() == 1
+        # member healed: routed traffic spends the half-open probe on A
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and ga.breaker.state != CLOSED:
+            p, n, ref = lm.cases[0]
+            out = fleet.submit(p, n).result(timeout=60)
+            assert np.array_equal(out.tokens, ref)
+            time.sleep(0.02)
+        assert ga.breaker.state == CLOSED
+    finally:
+        fleet.close(timeout=30)
+
+
+def test_pick_tiebreak_is_stable_under_equal_load(lm):
+    """Satellite: equal-load routing must be deterministic — repeated
+    picks with identical load land on the same (lowest-index) engine
+    instead of drifting with the half-open rotation counter."""
+    ea = _engine(lm)
+    eb = _engine(lm)
+    fleet = DecodeFleet([ea, eb])
+    try:
+        picks = {id(fleet._pick()) for _ in range(8)}
+        assert picks == {id(ea)}
+    finally:
+        fleet.close(timeout=30)
+
+
 def test_fault_during_recovery_escalates_to_migration(lm):
     """DECODE_RECOVER firing inside the quarantine path must escalate
     one rung (migrate via the fleet) rather than lose requests."""

@@ -28,6 +28,11 @@ actually recovered:
   journal on a fresh engine with already-delivered tokens deduped; plus
   the PR 9 invariants (preempt/resume under page starvation, cancel
   mid-generation, compile-once decode step, zero leaked pages);
+- a tensor-parallel replica group (two tp=2 groups over the virtual
+  mesh) lost ONE member to a canary fault — the WHOLE group's breaker
+  tripped and every live request finished token-exactly on the other
+  group; a stalled member was localized by the per-shard skew watch
+  without ejecting anybody;
 - under mixed-tenant overload at ~10x capacity (plus a transiently
   failing replica), admission control held the interactive p99 SLO, shed
   batch traffic via typed ``AdmissionRejected`` while batch kept its
@@ -60,13 +65,14 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-# the serving phase ejects one replica and survives on the other — that
-# needs at least two devices, so virtualize them on a CPU-only host
+# the serving phase ejects one replica and survives on the other, and the
+# shardgroup phase needs two tp=2 replica groups — that takes four
+# devices, so virtualize them on a CPU-only host
 if os.environ.get("JAX_PLATFORMS") == "cpu" and \
         "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=2"
+        + " --xla_force_host_platform_device_count=4"
     ).strip()
 
 # deadlock canary: run every phase with the core.locks order detector on
@@ -948,6 +954,148 @@ def _disagg_phase(work: str, seed: int) -> None:
         e.kv.assert_no_leaks()
 
 
+def _shardgroup_phase(work: str, seed: int) -> None:
+    """Tensor-parallel replica groups under chaos (ISSUE 16):
+
+    1. ONE member of a tp=2 group hit by a ``GROUP_MEMBER`` canary fault
+       — the WHOLE group must eject (breaker trip) and every live
+       request finish token-exactly on the other group, zero loss; the
+       healed group is re-admitted via the fleet's half-open probe;
+    2. ONE member stalled (not failed) — the per-shard skew watch must
+       localize the slow chip (``serving.group.shard_skew`` +
+       straggler counter) while the group keeps serving token-exactly,
+       without tripping any breaker.
+    """
+    import jax.numpy as jnp
+    from paddle_tpu import models
+    from paddle_tpu.models.transformer_lm import generate
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.resilience.circuit import CLOSED, OPEN
+    from paddle_tpu.serving import DecodeConfig, DecodeFleet
+    from paddle_tpu.serving.shardgroup import make_groups
+
+    rng = np.random.RandomState(seed + 16)
+    spec = models.get_model("transformer_lm", seq_len=64, vocab=97,
+                            d_model=32, d_inner=64, num_heads=4, n_layers=2)
+    cfg = spec.extra["cfg"]
+    variables = spec.model.init(0, *spec.synth_batch(2, rng))
+
+    cases = []
+    for _ in range(3):
+        p = rng.randint(1, 97, size=(int(rng.randint(4, 8)),)).astype(np.int32)
+        n = int(rng.randint(10, 16))
+        ref = np.asarray(generate(variables, jnp.asarray(p[None]), n, cfg))[0]
+        cases.append((p, n, ref))
+
+    def check_exact(outs, tag):
+        for (_, _, ref), out in zip(cases, outs):
+            check(np.array_equal(out.tokens, ref),
+                  f"{tag}: output not token-exact "
+                  f"(got {list(out.tokens)}, want {ref.tolist()})")
+
+    def mk_fleet():
+        return DecodeFleet.from_groups(
+            variables, cfg, make_groups(2)[:2],
+            decode=DecodeConfig(
+                max_slots=3, page_size=4, max_context=40, prefill_chunk=8,
+                num_pages=14, recovery_base_delay_s=0.001,
+                recovery_max_delay_s=0.005, breaker_cooldown_s=0.05,
+                breaker_max_cooldown_s=0.2, group_probe_every_s=0.0))
+
+    # leg 1: member fault -> whole-group ejection, zero-loss migration
+    fleet = mk_fleet()
+    ga, gb = fleet.engines
+    try:
+        handles = [ga.submit(p, n) for p, n, _ in cases]  # pin to A
+        # arm the canary only once every case is live in decode: a probe
+        # fault while some still sit in the admission queue migrates just
+        # the admitted subset, and the queued rest would then finish on
+        # the re-closed group — breaking the all-migrated assertion below
+        total_chunks = sum(-(-len(p) // ga.decode_config.prefill_chunk)
+                           for p, _, _ in cases)
+        deadline = time.monotonic() + 120
+        while (time.monotonic() < deadline
+               and ga.metrics.snapshot()["prefill_chunks_total"]
+               < total_chunks):
+            time.sleep(0.005)
+        check(ga.metrics.snapshot()["prefill_chunks_total"] == total_chunks,
+              "group-kill leg: cases never finished prefill")
+        with _inject(
+            faults.FaultSpec(faults.GROUP_MEMBER, "error", times=1,
+                             match={"engine": ga.metrics.engine_label,
+                                    "shard": 1}),
+            seed=seed,
+        ) as plan:
+            outs = [h.result(timeout=300) for h in handles]
+            check(plan.all_fired(),
+                  f"group member fault never fired: {plan.stats()}")
+        check_exact(outs, "group-kill")
+        check(ga.breaker.state == OPEN,
+              "one member died but the group's breaker stayed closed")
+        snap = ga.metrics.snapshot()
+        check(snap["group_member_faults_total"] == 1,
+              f"member fault not counted: {snap}")
+        check(snap["migrated_total"] == len(cases),
+              f"group ejection lost requests: {snap}")
+        check(snap["errors_total"] == 0
+              and gb.metrics.snapshot()["errors_total"] == 0,
+              "group ejection failed requests")
+        check(gb.decode_step_cache_size() == 1,
+              "surviving group's step recompiled under migration")
+        # healed member: half-open probing re-admits the whole group
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and ga.breaker.state != CLOSED:
+            p, n, ref = cases[0]
+            out = fleet.submit(p, n).result(timeout=120)
+            check(np.array_equal(out.tokens, ref),
+                  "re-admission probe output not token-exact")
+            time.sleep(0.02)
+        check(ga.breaker.state == CLOSED,
+              "healed group never re-admitted by the half-open probe")
+        print(f"[chaos] shardgroup: member fault ejected whole group, "
+              f"{snap['migrated_total']} request(s) migrated token-exact, "
+              f"group re-admitted")
+    finally:
+        fleet.close(timeout=30)
+
+    # leg 2: member STALL -> straggler localized, nobody ejected
+    fleet = mk_fleet()
+    ga, gb = fleet.engines
+    try:
+        with _inject(
+            faults.FaultSpec(faults.GROUP_MEMBER, "stall", times=10 ** 9,
+                             stall_s=0.02,
+                             match={"engine": ga.metrics.engine_label,
+                                    "shard": 0}),
+            seed=seed,
+        ) as plan:
+            handles = [ga.submit(p, n) for p, n, _ in cases]
+            outs = [h.result(timeout=300) for h in handles]
+            check(plan.all_fired(),
+                  f"group member stall never fired: {plan.stats()}")
+            check_exact(outs, "group-stall")
+            snap = ga.metrics.snapshot()
+            # the probe cadence may need a few more passes than the
+            # traffic took to reach min_samples on both shards
+            deadline = time.monotonic() + 60
+            while (time.monotonic() < deadline
+                   and snap["shard_stragglers_total"] == 0):
+                time.sleep(0.01)
+                snap = ga.metrics.snapshot()
+        check(snap["shard_stragglers_total"] >= 1,
+              f"stalled shard never localized: {snap}")
+        check(snap["group_member_faults_total"] == 0,
+              f"a stall must not count as a member fault: {snap}")
+        check(ga.breaker.state == CLOSED,
+              "a stalled (not failed) member must not eject the group")
+        check(snap["errors_total"] == 0, f"stall leg failed requests: {snap}")
+        print(f"[chaos] shardgroup: stalled shard localized "
+              f"({snap['shard_stragglers_total']} straggler flag(s)), "
+              f"group kept serving, 0 failed")
+    finally:
+        fleet.close(timeout=30)
+
+
 def _overload_phase(work: str, seed: int) -> None:
     """Mixed-tenant overload at ~10x drain capacity with a transiently
     failing replica: interactive p99 must hold its SLO, batch must shed
@@ -1148,6 +1296,8 @@ def main(argv=None) -> int:
         _deadlock_canary("spec_decode")
         _disagg_phase(work, args.seed)
         _deadlock_canary("disagg")
+        _shardgroup_phase(work, args.seed)
+        _deadlock_canary("shardgroup")
         _overload_phase(work, args.seed)
         _deadlock_canary("overload")
 
