@@ -11,9 +11,18 @@ Three layers, one diagnostics vocabulary (:mod:`.diagnostics`):
   repo-specific invariants (stdlib only);
 * :mod:`.concurrency_lint` — AST lint for the locking discipline that
   ``core.locks`` enforces at runtime (raw primitives, unbounded waits,
-  callbacks/blocking I/O under a lock).
+  callbacks/blocking I/O under a lock);
+* :mod:`.retrace_lint` — AST lint for the compile-once discipline
+  (trace-frozen config reads, dynamic-closure ``len()``, jit-in-loop,
+  dict-order-dependent donate/shardings, missing ``static_argnums``);
+* :mod:`.shard_analysis` — zero-FLOP sharding-layout analyzer: propagates
+  a ``GroupLayout``'s PartitionSpecs over an ``eval_shape`` param tree
+  and reports dead rules, silent degrades (with HBM cost), cross-layout
+  conflicts, KV-geometry violations, and a static tp comm report (lazy
+  import: pulls in jax).
 
-CLI: ``python -m paddle_tpu.analysis [paths...] [--verify-program DIR]``.
+CLI: ``python -m paddle_tpu.analysis [paths...] [--only PASS]
+[--verify-program DIR]`` — aggregated exit code over all passes.
 """
 
 from __future__ import annotations
@@ -26,6 +35,7 @@ from paddle_tpu.analysis.diagnostics import (
     has_errors,
 )
 from paddle_tpu.analysis.concurrency_lint import lint_concurrency
+from paddle_tpu.analysis.retrace_lint import lint_retrace
 from paddle_tpu.analysis.source_lint import lint_file, lint_source
 from paddle_tpu.analysis.verifier import (
     VerificationError,
@@ -38,24 +48,40 @@ __all__ = [
     "Diagnostic",
     "ERROR",
     "WARNING",
+    "analyze_layout",
+    "analyze_model",
+    "compare_layouts",
     "format_diagnostics",
     "has_errors",
     "lint_concurrency",
     "lint_file",
+    "lint_group_layout_or_raise",
     "lint_model",
+    "lint_retrace",
     "lint_source",
+    "tp_comm_report",
     "VerificationError",
     "verify_or_raise",
     "verify_program",
     "verify_text",
 ]
 
+# jax-importing entry points, loaded lazily so the verifier path (used
+# inside PassManager) stays stdlib-light.
+_LAZY = {
+    "lint_model": "paddle_tpu.analysis.model_lint",
+    "analyze_layout": "paddle_tpu.analysis.shard_analysis",
+    "analyze_model": "paddle_tpu.analysis.shard_analysis",
+    "compare_layouts": "paddle_tpu.analysis.shard_analysis",
+    "lint_group_layout_or_raise": "paddle_tpu.analysis.shard_analysis",
+    "tp_comm_report": "paddle_tpu.analysis.shard_analysis",
+}
+
 
 def __getattr__(name):
-    # lint_model imports jax; load it only when asked for so that the
-    # verifier path (used inside PassManager) stays stdlib-light.
-    if name == "lint_model":
-        from paddle_tpu.analysis.model_lint import lint_model
+    mod = _LAZY.get(name)
+    if mod is not None:
+        import importlib
 
-        return lint_model
+        return getattr(importlib.import_module(mod), name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

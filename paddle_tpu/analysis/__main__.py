@@ -1,10 +1,20 @@
 """CLI: ``python -m paddle_tpu.analysis``.
 
-Default action lints Python sources (the whole ``paddle_tpu`` package when
-no paths are given) with both the general source lint and the concurrency
-lint. ``--verify-program DIR`` additionally verifies an exported native
-program directory (``program.txt`` + ``weights.bin``). Exit status 1 when
-any error-severity diagnostic was produced.
+One aggregated exit code over a registry of passes:
+
+* ``source`` — repo-invariant AST lint (:mod:`.source_lint`);
+* ``concurrency`` — locking-discipline AST lint (:mod:`.concurrency_lint`);
+* ``retrace`` — compile-once retrace lint (:mod:`.retrace_lint`);
+* ``shard`` — static sharding-layout analysis of the shipped
+  ``default_layout()`` over ``transformer_lm`` at tp ∈ {1, 2, 4}
+  (:mod:`.shard_analysis`; needs jax, so it is skipped when explicit
+  paths are given — it analyzes the model, not files).
+
+``--only PASS`` (repeatable) restricts the run; ``--verify-program DIR``
+additionally verifies an exported native program directory
+(``program.txt`` + ``weights.bin``). Exit status 1 when ANY selected
+pass produced an error-severity diagnostic — one aggregated gate, not
+per-pass ad-hoc codes.
 """
 
 from __future__ import annotations
@@ -12,15 +22,56 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-from typing import List
+from typing import Callable, Dict, List, Optional, Sequence
 
-from paddle_tpu.analysis.concurrency_lint import lint_concurrency
 from paddle_tpu.analysis.diagnostics import Diagnostic, format_diagnostics, has_errors
-from paddle_tpu.analysis.source_lint import lint_source
-from paddle_tpu.analysis.verifier import verify_text
+
+_SHARD_TPS = (1, 2, 4)
+
+
+def _run_source(paths: Optional[Sequence[str]]) -> List[Diagnostic]:
+    from paddle_tpu.analysis.source_lint import lint_source
+
+    return list(lint_source(paths or None))
+
+
+def _run_concurrency(paths: Optional[Sequence[str]]) -> List[Diagnostic]:
+    from paddle_tpu.analysis.concurrency_lint import lint_concurrency
+
+    return list(lint_concurrency(paths or None))
+
+
+def _run_retrace(paths: Optional[Sequence[str]]) -> List[Diagnostic]:
+    from paddle_tpu.analysis.retrace_lint import lint_retrace
+
+    return list(lint_retrace(paths or None))
+
+
+def _run_shard(paths: Optional[Sequence[str]]) -> List[Diagnostic]:
+    # model-based, not path-based: analyze the shipped default layout at
+    # the tp degrees the serving stack actually runs
+    from paddle_tpu.analysis.shard_analysis import analyze_model
+
+    diags: List[Diagnostic] = []
+    for tp in _SHARD_TPS:
+        found, _report = analyze_model(tp=tp)
+        diags.extend(found)
+    return diags
+
+
+# name -> (runner, path_based). Path-based passes lint the given files;
+# the shard pass analyzes the model and only runs on whole-repo checks.
+PASSES: Dict[str, tuple] = {
+    "source": (_run_source, True),
+    "concurrency": (_run_concurrency, True),
+    "retrace": (_run_retrace, True),
+    "shard": (_run_shard, False),
+}
 
 
 def _verify_program_dir(path: str) -> List[Diagnostic]:
+    from paddle_tpu.analysis.verifier import verify_text
+
     prog_path = os.path.join(path, "program.txt") if os.path.isdir(path) else path
     with open(prog_path, "r", encoding="utf-8") as f:
         text = f.read()
@@ -35,11 +86,17 @@ def _verify_program_dir(path: str) -> List[Diagnostic]:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
-        description="paddle_tpu static analysis: source lint + program verifier",
+        description="paddle_tpu static analysis: "
+        + ", ".join(PASSES) + " + program verifier",
     )
     ap.add_argument(
         "paths", nargs="*",
-        help="files/directories to source-lint (default: the paddle_tpu package)",
+        help="files/directories to lint (default: the paddle_tpu package)",
+    )
+    ap.add_argument(
+        "--only", action="append", choices=sorted(PASSES), default=None,
+        metavar="PASS",
+        help="run only this pass (repeatable): " + ", ".join(sorted(PASSES)),
     )
     ap.add_argument(
         "--verify-program", metavar="DIR", default=None,
@@ -48,22 +105,35 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--no-source-lint", action="store_true",
-        help="skip the source lint (e.g. with --verify-program alone)",
+        help="skip all lint passes (e.g. with --verify-program alone)",
     )
     args = ap.parse_args(argv)
 
+    selected = list(args.only) if args.only else list(PASSES)
+    if args.no_source_lint and not args.only:
+        selected = []
+
     diags: List[Diagnostic] = []
-    if not args.no_source_lint:
-        diags.extend(lint_source(args.paths or None))
-        diags.extend(lint_concurrency(args.paths or None))
+    by_pass: Dict[str, int] = {}
+    for name in selected:
+        runner, path_based = PASSES[name]
+        if not path_based and args.paths and not args.only:
+            continue  # model-based pass on a file-list invocation
+        found = runner(args.paths or None)
+        by_pass[name] = len(found)
+        diags.extend(found)
     if args.verify_program:
-        diags.extend(_verify_program_dir(args.verify_program))
+        found = _verify_program_dir(args.verify_program)
+        by_pass["verify-program"] = len(found)
+        diags.extend(found)
 
     if diags:
         print(format_diagnostics(diags))
     n_err = sum(1 for d in diags if d.severity == "error")
     n_warn = len(diags) - n_err
-    print(f"paddle_tpu.analysis: {n_err} error(s), {n_warn} warning(s)")
+    detail = ", ".join(f"{k}={v}" for k, v in by_pass.items())
+    print(f"paddle_tpu.analysis: {n_err} error(s), {n_warn} warning(s)"
+          + (f" [{detail}]" if detail else ""))
     return 1 if has_errors(diags) else 0
 
 

@@ -1,0 +1,394 @@
+"""Retrace lint: AST checks for the compile-once discipline.
+
+The serving stack's throughput rests on one invariant: the hot jitted
+programs (``paged_decode_step``, the train step, the handoff gather)
+compile ONCE and are reused forever (``decode_step_cache_size() == 1``
+is an acceptance gate). The killers are all the same textual shape — a
+jitted/pjitted function whose closure or arguments capture a
+Python-dynamic value, so a "constant" silently freezes at trace time or
+every new value triggers a fresh trace. This pass catches them at review
+time. Rules:
+
+* ``retrace-config-read`` — ``config.flags()`` / ``os.getenv`` /
+  ``os.environ[...]`` inside traced code: the read runs once at trace
+  time and the program bakes that value in forever (flipping the flag at
+  runtime silently does nothing);
+* ``retrace-dynamic-len`` — ``len()`` of a closure/attribute capture
+  inside traced code (``len()`` of a traced *argument* is shape-static
+  and fine): the length freezes at trace time, and when the captured
+  list grows the program is silently wrong — or, hashed as a static, a
+  new length means a full retrace per size;
+* ``retrace-jit-in-loop`` — a ``jax.jit``/``pjit`` call lexically inside
+  a ``for``/``while`` body: a fresh wrapper per iteration has an empty
+  executable cache, so every iteration recompiles (the executor's
+  LRU-eviction comment documents the same trap for fresh closures);
+* ``retrace-dict-order`` — ``in_shardings``/``out_shardings``/
+  ``donate_argnums``/``static_argnums`` built from ``.keys()`` /
+  ``.values()`` / ``.items()`` without ``sorted(...)``: two processes
+  (or two runs) disagreeing on insertion order donate or shard
+  *different arguments* — wrap the iteration in ``sorted``;
+* ``retrace-missing-static`` — a directly ``@jax.jit``-decorated
+  function branching on a bare parameter (``if flag:`` / ``while n:`` /
+  ``range(n)``) that ``static_argnums``/``static_argnames`` does not
+  cover: a tracer cannot take a Python branch — mark it static (and know
+  each distinct value compiles its own program). ``is``/``is not``
+  comparisons are exempt (``if rng is not None`` is trace-safe).
+
+Traced code means: a function decorated with ``jax.jit``/``pjit`` (bare,
+called, or via ``functools.partial(jax.jit, ...)``), or a function whose
+NAME is wrapped by a ``jax.jit``/``pjit`` call anywhere in the same
+module (including through ``functools.partial`` / ``jax.grad`` /
+``jax.vmap`` / ``jax.checkpoint``), plus everything lexically nested
+inside one. Cross-module wrapping is invisible to a per-file AST pass —
+the usual precision/recall trade (the concurrency lint documents the
+same one); the runtime ``decode_step_cache_size`` gate has no such blind
+spot.
+
+Wired into ``python -m paddle_tpu.analysis`` (the ``retrace`` pass) and
+the whole-tree-clean test in ``tests/test_retrace_lint.py``. Suppress a
+finding with ``# lint: allow`` on the offending line.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Sequence, Set
+
+from paddle_tpu.analysis.diagnostics import ERROR, WARNING, Diagnostic
+from paddle_tpu.analysis.source_lint import _dotted, default_roots
+
+__all__ = ["lint_retrace", "lint_file", "default_roots"]
+
+_SUPPRESS = "# lint: allow"
+
+# call chains that wrap a function for tracing (last dotted segment)
+_JIT_NAMES = ("jit", "pjit")
+# transform wrappers to unwrap when hunting for the jitted function name:
+# jax.jit(functools.partial(step, ...)) / jax.jit(jax.grad(loss))
+_UNWRAP_NAMES = ("partial", "grad", "value_and_grad", "vmap", "checkpoint",
+                 "remat")
+# jit kwargs whose value must not depend on dict iteration order
+_ORDER_KWARGS = ("in_shardings", "out_shardings", "donate_argnums",
+                 "donate_argnames", "static_argnums", "static_argnames")
+# trace-frozen environment reads
+_ENV_READS = ("os.getenv", "os.environ.get")
+
+
+def _is_jit_chain(node: ast.AST) -> bool:
+    chain = _dotted(node)
+    return bool(chain) and chain.rsplit(".", 1)[-1] in _JIT_NAMES
+
+
+def _wrapped_name(node: ast.AST) -> Optional[str]:
+    """The function NAME a jit target ultimately wraps: unwraps nested
+    partial/grad/vmap/... calls down to a bare Name."""
+    while isinstance(node, ast.Call):
+        chain = _dotted(node.func) or ""
+        if chain.rsplit(".", 1)[-1] not in _UNWRAP_NAMES:
+            return None
+        if not node.args:
+            return None
+        node = node.args[0]
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _jit_decoration(node) -> Optional[ast.Call]:
+    """If the def is jit-decorated, the decorator Call (or a synthetic
+    marker for the bare ``@jax.jit`` form); else None."""
+    for dec in node.decorator_list:
+        if _is_jit_chain(dec):
+            return ast.Call(func=dec, args=[], keywords=[])  # bare @jax.jit
+        if isinstance(dec, ast.Call):
+            if _is_jit_chain(dec.func):
+                return dec
+            # @functools.partial(jax.jit, static_argnums=...)
+            chain = _dotted(dec.func) or ""
+            if chain.rsplit(".", 1)[-1] == "partial" and dec.args \
+                    and _is_jit_chain(dec.args[0]):
+                return dec
+    return None
+
+
+def _static_params(node, dec: ast.Call) -> Set[str]:
+    """Parameter names the decorator marks static (literal
+    static_argnums/static_argnames only; dynamic expressions disable the
+    missing-static check rather than guess)."""
+    params = [a.arg for a in node.args.posonlyargs + node.args.args] \
+        if hasattr(node.args, "posonlyargs") else [a.arg for a in node.args.args]
+    static: Set[str] = set()
+    for kw in dec.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    static.add(n.value)
+        elif kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    if 0 <= n.value < len(params):
+                        static.add(params[n.value])
+    return static
+
+
+class _JitIndex(ast.NodeVisitor):
+    """Pre-pass: names of functions wrapped by a jit/pjit call anywhere
+    in the module."""
+
+    def __init__(self) -> None:
+        self.jitted: Set[str] = set()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_jit_chain(node.func) and node.args:
+            target = node.args[0]
+            name = target.id if isinstance(target, ast.Name) \
+                else _wrapped_name(target)
+            if name:
+                self.jitted.add(name)
+        self.generic_visit(node)
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, source_lines: List[str], jitted: Set[str]):
+        self.path = path
+        self.lines = source_lines
+        self.jitted = jitted
+        self.diags: List[Diagnostic] = []
+        self._loop_depth = 0
+        self._traced = False          # inside a jit-wrapped function body
+        self._fn_locals: Set[str] = set()   # params + assigned names
+        self._static: Set[str] = set()      # decorator-declared static params
+        self._params: Set[str] = set()
+
+    def _diag(self, code: str, message: str, node: ast.AST,
+              severity: str = ERROR) -> None:
+        line_no = getattr(node, "lineno", 0)
+        src = self.lines[line_no - 1] if 0 < line_no <= len(self.lines) else ""
+        if _SUPPRESS in src:
+            return
+        self.diags.append(Diagnostic(
+            code, message, severity=severity,
+            where=f"{self.path}:{line_no}", source=src,
+        ))
+
+    # -- lexical context ---------------------------------------------------
+
+    def visit_For(self, node) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_While = visit_For
+    visit_AsyncFor = visit_For
+
+    def _collect_locals(self, node) -> Set[str]:
+        names: Set[str] = set()
+        a = node.args
+        for arg in (getattr(a, "posonlyargs", []) + a.args + a.kwonlyargs
+                    + ([a.vararg] if a.vararg else [])
+                    + ([a.kwarg] if a.kwarg else [])):
+            names.add(arg.arg)
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                names.add(n.id)
+        return names
+
+    def _visit_fn(self, node) -> None:
+        dec = _jit_decoration(node)
+        traced = self._traced or dec is not None \
+            or getattr(node, "name", None) in self.jitted
+        saved = (self._traced, self._fn_locals, self._static, self._params,
+                 self._loop_depth)
+        # a def's body runs when CALLED, not where it appears: loop depth
+        # does not propagate in (the autotune make_fn pattern is fine)
+        self._loop_depth = 0
+        if traced and not self._traced:
+            self._fn_locals = self._collect_locals(node)
+            self._params = {a.arg for a in getattr(node.args, "posonlyargs", [])
+                            + node.args.args + node.args.kwonlyargs}
+            self._static = _static_params(node, dec) if dec is not None else set()
+            self._traced = True
+            if dec is not None:
+                self._check_python_branches(node)
+        elif traced:
+            # nested def inside traced code: locals accumulate
+            self._fn_locals = self._fn_locals | self._collect_locals(node)
+        self.generic_visit(node)
+        (self._traced, self._fn_locals, self._static, self._params,
+         self._loop_depth) = saved
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_Lambda(self, node) -> None:
+        saved = self._loop_depth
+        self._loop_depth = 0
+        self.generic_visit(node)
+        self._loop_depth = saved
+
+    # -- rule: missing static_argnums (decorated defs only) ----------------
+
+    def _check_python_branches(self, node) -> None:
+        dynamic = self._params - self._static
+        for n in ast.walk(node):
+            test = None
+            if isinstance(n, (ast.If, ast.While)):
+                test = n.test
+            elif isinstance(n, ast.Call) and _dotted(n.func) == "range" \
+                    and n.args:
+                test = n.args[0]
+            if test is None:
+                continue
+            for name in self._bare_branch_names(test):
+                if name in dynamic:
+                    self._diag(
+                        "retrace-missing-static",
+                        f"parameter {name!r} takes a Python branch inside a "
+                        "jitted function but is not in static_argnums/"
+                        "static_argnames — a tracer cannot branch; mark it "
+                        "static (each distinct value compiles its own "
+                        "program) or lift the branch out of the jit",
+                        n if hasattr(n, "lineno") else node,
+                        severity=WARNING,
+                    )
+
+    @staticmethod
+    def _bare_branch_names(test: ast.AST) -> Set[str]:
+        """Bare parameter Names a Python branch would force to a bool —
+        `x`, `not x`, `x and y`, `x == c`. Identity tests (`x is None`)
+        and attribute/subscript reads (`x.ndim == 2`, shape-static) are
+        trace-safe and exempt."""
+        out: Set[str] = set()
+        stack = [test]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+            elif isinstance(n, ast.UnaryOp) and isinstance(n.op, ast.Not):
+                stack.append(n.operand)
+            elif isinstance(n, ast.BoolOp):
+                stack.extend(n.values)
+            elif isinstance(n, ast.Compare):
+                if all(not isinstance(op, (ast.Is, ast.IsNot))
+                       for op in n.ops):
+                    stack.append(n.left)
+                    stack.extend(n.comparators)
+        return out
+
+    # -- rules on calls ----------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_jit_chain(node.func):
+            if self._loop_depth:
+                self._diag(
+                    "retrace-jit-in-loop",
+                    "jax.jit/pjit called inside a loop body: each iteration "
+                    "builds a fresh wrapper with an empty executable cache, "
+                    "so every call recompiles — hoist the jit out of the "
+                    "loop (or cache it keyed on the static config)",
+                    node,
+                )
+            self._check_order_kwargs(node)
+        if self._traced:
+            self._check_traced_call(node)
+        self.generic_visit(node)
+
+    def _check_order_kwargs(self, node: ast.Call) -> None:
+        for kw in node.keywords:
+            if kw.arg not in _ORDER_KWARGS:
+                continue
+            has_iter = any(
+                isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("keys", "values", "items")
+                for n in ast.walk(kw.value))
+            has_sorted = any(
+                isinstance(n, ast.Call) and _dotted(n.func) == "sorted"
+                for n in ast.walk(kw.value))
+            if has_iter and not has_sorted:
+                self._diag(
+                    "retrace-dict-order",
+                    f"{kw.arg}= built from dict .keys()/.values()/.items() "
+                    "without sorted(): insertion order decides which "
+                    "arguments are donated/sharded, and two processes (or a "
+                    "code motion) that disagree silently donate DIFFERENT "
+                    "buffers — iterate in sorted() order",
+                    node,
+                )
+
+    def _check_traced_call(self, node: ast.Call) -> None:
+        chain = _dotted(node.func) or ""
+        last = chain.rsplit(".", 1)[-1] if chain else ""
+        if chain in _ENV_READS or last == "flags":
+            self._diag(
+                "retrace-config-read",
+                f"{chain or last}() inside traced code is read ONCE at "
+                "trace time and baked into the compiled program — flipping "
+                "it at runtime silently does nothing; read the flag outside "
+                "the jit and pass it in (static arg or closure rebuilt on "
+                "change)",
+                node,
+            )
+        elif chain == "len" and node.args:
+            target = node.args[0]
+            capture = None
+            if isinstance(target, ast.Name) \
+                    and target.id not in self._fn_locals:
+                capture = target.id
+            elif isinstance(target, ast.Attribute):
+                capture = _dotted(target) or target.attr
+            if capture is not None:
+                self._diag(
+                    "retrace-dynamic-len",
+                    f"len({capture}) inside traced code measures a "
+                    "closure/attribute capture: the length freezes at trace "
+                    "time, and when the captured container changes the "
+                    "program is silently stale (or retraces per size) — "
+                    "pass the data in as a traced argument",
+                    node,
+                    severity=WARNING,
+                )
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if self._traced and _dotted(node.value) == "os.environ":
+            self._diag(
+                "retrace-config-read",
+                "os.environ[...] inside traced code is frozen at trace "
+                "time — read it outside the jit and pass it in",
+                node,
+            )
+        self.generic_visit(node)
+
+
+def lint_file(path: str, text: Optional[str] = None) -> List[Diagnostic]:
+    """Retrace-lint one Python file."""
+    if text is None:
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError as e:
+        return [Diagnostic("syntax-error", str(e),
+                           where=f"{path}:{e.lineno or 0}")]
+    index = _JitIndex()
+    index.visit(tree)
+    linter = _Linter(path, text.splitlines(), index.jitted)
+    linter.visit(tree)
+    return linter.diags
+
+
+def lint_retrace(paths: Optional[Sequence[str]] = None) -> List[Diagnostic]:
+    """Lint a set of files/directories (default: the paddle_tpu package)."""
+    targets: List[str] = []
+    for p in (list(paths) if paths else default_roots()):
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                targets.extend(
+                    os.path.join(dirpath, f)
+                    for f in sorted(filenames) if f.endswith(".py")
+                )
+        else:
+            targets.append(p)
+    diags: List[Diagnostic] = []
+    for path in targets:
+        diags.extend(lint_file(path))
+    return diags

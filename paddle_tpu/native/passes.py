@@ -27,6 +27,8 @@ __all__ = [
     "Program",
     "Pass",
     "PassManager",
+    "add_verify_hook",
+    "remove_verify_hook",
     "register_pass",
     "get_pass",
     "default_pipeline",
@@ -384,6 +386,31 @@ def _verify_default() -> bool:
     return bool(config.flags().verify_passes) or "PYTEST_CURRENT_TEST" in os.environ
 
 
+# Extra checks run at every verify point (before the pipeline, after each
+# pass), alongside the IR verifier: ``hook(prog, where)`` raising fails the
+# pipeline attributed to that exact point. The static analyses register
+# here (e.g. ``analysis.shard_analysis.lint_group_layout_or_raise`` bound
+# to a layout, or a retrace lint over generated sources) so layout/retrace
+# gates ride the same verify-between-passes discipline as SSA/shape checks.
+_VERIFY_HOOKS: List[Callable[["Program", str], None]] = []
+
+
+def add_verify_hook(hook: Callable[["Program", str], None]) -> Callable:
+    """Register ``hook(prog, where)`` to run at every PassManager verify
+    point. Returns the hook so it can be used as a decorator."""
+    _VERIFY_HOOKS.append(hook)
+    return hook
+
+
+def remove_verify_hook(hook: Callable[["Program", str], None]) -> None:
+    """Unregister a hook added with :func:`add_verify_hook` (missing hooks
+    are ignored, so teardown paths can call this unconditionally)."""
+    try:
+        _VERIFY_HOOKS.remove(hook)
+    except ValueError:
+        pass
+
+
 class PassManager:
     """Apply a pass pipeline; optionally dump the program after each pass
     (``<dump_dir>/pass_<NN>_<name>.txt``) for pipeline debugging.
@@ -410,6 +437,8 @@ class PassManager:
             from paddle_tpu.analysis import verifier
 
             verifier.verify_or_raise(prog, where="before any pass")
+            for hook in list(_VERIFY_HOOKS):
+                hook(prog, "before any pass")
         if dump_dir:
             os.makedirs(dump_dir, exist_ok=True)
             with open(os.path.join(dump_dir, "pass_00_input.txt"), "w") as f:
@@ -422,4 +451,6 @@ class PassManager:
                     f.write(prog.serialize())
             if verify:
                 verifier.verify_or_raise(prog, where=f"after pass '{p.name}'")
+                for hook in list(_VERIFY_HOOKS):
+                    hook(prog, f"after pass '{p.name}'")
         return prog

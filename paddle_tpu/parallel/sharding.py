@@ -10,7 +10,7 @@ MultiDevSSAGraphBuilder (replicate params everywhere + allreduce grads —
 from __future__ import annotations
 
 import fnmatch
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -20,6 +20,10 @@ from paddle_tpu.framework import ParamInfo, Variables
 
 # A rule table: ordered (glob-pattern, PartitionSpec) pairs, first match wins.
 ShardingRules = Sequence[Tuple[str, P]]
+
+# why a sharded dim was dropped to replicated (degraded_dims reasons)
+MISSING_AXIS = "missing-axis"
+NON_DIVISIBLE = "non-divisible"
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -52,20 +56,79 @@ def spec_for(
     return fallback
 
 
-def degrade_spec(mesh: Mesh, spec: P, shape: Tuple[int, ...]) -> P:
+def mesh_axis_sizes(mesh: Mesh) -> Dict[str, int]:
+    """``{axis_name: size}`` for a mesh — the only mesh fact the degrade
+    logic (and the static shard analyzer) needs, so both can run from a
+    plain dict without touching devices."""
+    return {
+        name: int(size) for name, size in zip(mesh.axis_names, mesh.devices.shape)
+    }
+
+
+def degraded_dims(
+    axis_sizes: Mapping[str, int], spec: P, shape: Tuple[int, ...]
+) -> List[Tuple[int, str, str]]:
+    """Which sharded dims :func:`degrade_spec` would drop to replicated,
+    as ``(dim_index, axis_name, reason)`` — reason ``MISSING_AXIS`` (the
+    documented any-mesh fallback) or ``NON_DIVISIBLE`` (the silent one:
+    the axis exists but its size doesn't divide the dim). Pure function of
+    the mesh's axis sizes so ``analysis.shard_analysis`` predicts exactly
+    what the runtime does."""
+    dims = tuple(spec) + (None,) * max(0, len(shape) - len(spec))
+    out: List[Tuple[int, str, str]] = []
+    for i, (dim_size, axis) in enumerate(zip(shape, dims)):
+        if axis is None:
+            continue
+        n = axis_sizes.get(axis)
+        if n is None:
+            out.append((i, axis, MISSING_AXIS))
+        elif dim_size % n != 0:
+            out.append((i, axis, NON_DIVISIBLE))
+    return out
+
+
+def degrade_spec(
+    mesh: Mesh,
+    spec: P,
+    shape: Tuple[int, ...],
+    *,
+    name: Optional[str] = None,
+    quiet: bool = False,
+) -> P:
     """Per-dim degradation to replicated: drop a sharded dim when its mesh
     axis is missing or its size doesn't divide the dim (same contract as
     ``param_shardings`` so one model definition runs on any mesh/tp shape).
-    The spec is right-padded with None to the array rank."""
-    axis_sizes = {
-        name: int(size) for name, size in zip(mesh.axis_names, mesh.devices.shape)
-    }
+    The spec is right-padded with None to the array rank.
+
+    A NON-DIVISIBLE drop is the silent surprise — the layout author asked
+    for a shard and got full replication — so it logs a ``warn_once`` per
+    (param, axis) and counts ``sharding.degraded_total`` (labels: param,
+    axis) unless ``quiet``; the static analyzer reports the same set as
+    ``shard-silent-degrade``, so runtime counters and static reports
+    agree. A missing axis stays silent: that is the documented fallback
+    that lets one model definition run on any mesh shape."""
+    axis_sizes = mesh_axis_sizes(mesh)
+    dropped = degraded_dims(axis_sizes, spec, shape)
+    if not quiet:
+        from paddle_tpu.core import logging as ptlog
+        from paddle_tpu.core import profiler as prof
+
+        label = name or "<unnamed>"
+        for dim, axis, reason in dropped:
+            if reason != NON_DIVISIBLE:
+                continue
+            prof.inc_counter("sharding.degraded_total",
+                             labels={"param": label, "axis": axis})
+            ptlog.warn_once(
+                ("sharding.degrade", label, axis, dim),
+                "sharding: dim %d (size %d) of %s is not divisible by mesh "
+                "axis %r (size %d) — degrading to replicated, losing the "
+                "per-device memory split on that dim",
+                dim, shape[dim], label, axis, axis_sizes[axis],
+            )
+    drop = {i for i, _, _ in dropped}
     dims = tuple(spec) + (None,) * max(0, len(shape) - len(spec))
-    out = []
-    for dim_size, axis in zip(shape, dims):
-        n = axis_sizes.get(axis) if axis is not None else None
-        out.append(axis if (n is not None and dim_size % n == 0) else None)
-    return P(*out)
+    return P(*(None if i in drop else axis for i, axis in enumerate(dims[: len(shape)])))
 
 
 def batch_sharding(mesh: Mesh, axis: str = "data", ndim: int = 2) -> NamedSharding:

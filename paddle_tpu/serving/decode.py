@@ -200,6 +200,11 @@ class DecodeConfig:
     # per-shard probe-time skew (vs the median shard) that flags a
     # straggler chip inside the group
     group_skew_ratio: float = 4.0
+    # statically lint the GroupLayout against the actual param tree + KV
+    # geometry BEFORE placing anything on devices (analysis.shard_analysis):
+    # layout errors (dead rules, rank mismatches, kv-geometry violations)
+    # raise here instead of surfacing as a wrong placement on a pod
+    lint_layout: bool = True
 
 
 @dataclasses.dataclass
@@ -434,6 +439,18 @@ class DecodeEngine:
             self._v_pages = jnp.zeros(pshape, self._cache_dtype)
             kvs = rep = None
         else:
+            if dconf.lint_layout:
+                # fail on a bad layout BEFORE any device_put: errors raise
+                # with every finding listed, warnings warn_once
+                from paddle_tpu.analysis.shard_analysis import (
+                    lint_group_layout_or_raise,
+                )
+
+                lint_group_layout_or_raise(
+                    params, self._layout, group.mesh,
+                    kv_page_shape=pshape, kv_geometry=self._kv.geometry(),
+                    where=f"DecodeEngine[{group.name}]",
+                )
             self._params = self._layout.shard_params(group, params)
             kvs = self._layout.kv_page_sharding(group, pshape)
             rep = self._layout.replicated(group)

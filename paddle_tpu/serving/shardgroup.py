@@ -121,14 +121,25 @@ class GroupLayout:
     """PartitionSpecs per param class over a replica group's mesh (the
     SpecLayout pattern: named axes + a spec per parameter family, except
     driven by a first-match rule table over param NAMES so the serving
-    path needs no model-code cooperation)."""
+    path needs no model-code cooperation).
+
+    ``optional`` lists rule patterns allowed to match no parameter — the
+    swiglu gate projections exist only in that FFN variant, so their
+    rules are not dead on a relu model. Any other zero-match rule is a
+    ``shard-dead-rule`` finding in ``analysis.shard_analysis`` (stale
+    after a param rename, or a layout for the wrong model family).
+    ``kv_rule`` overrides the default head-dim KV-page spec; the static
+    analyzer checks it against ``PagedKVCache.geometry()`` — page-id and
+    page-offset dims must stay global across the group."""
 
     tp_axis: str = TP_AXIS
     rules: ShardingRules = _TRANSFORMER_LM_RULES
+    optional: Tuple[str, ...] = ("*/ffn/gate/w", "*/ffn/gate/b")
+    kv_rule: Optional[P] = None
 
     def param_spec(self, name: str, shape: Tuple[int, ...], mesh: Mesh) -> P:
         spec = spec_for(name, self.rules, ndim=len(shape))
-        return degrade_spec(mesh, spec, shape)
+        return degrade_spec(mesh, spec, shape, name=name)
 
     def param_sharding(
         self, group: ReplicaGroup, name: str, shape: Tuple[int, ...]
@@ -139,10 +150,12 @@ class GroupLayout:
         """KV pages sharded along heads; degrades to replicated when the
         kv-head count doesn't divide tp (the same model still serves, just
         without the memory win)."""
+        if self.kv_rule is not None:
+            return degrade_spec(mesh, self.kv_rule, shape, name="kv_pages")
         dims = [None] * len(shape)
         if len(shape) > KV_HEAD_DIM:
             dims[KV_HEAD_DIM] = self.tp_axis
-        return degrade_spec(mesh, P(*dims), shape)
+        return degrade_spec(mesh, P(*dims), shape, name="kv_pages")
 
     def kv_page_sharding(
         self, group: ReplicaGroup, shape: Tuple[int, ...]

@@ -71,6 +71,38 @@ def test_registry_and_custom_pass():
     del P._REGISTRY["test_drop_neg"]
 
 
+def test_verify_hooks_run_at_every_verify_point():
+    seen = []
+    hook = P.add_verify_hook(lambda prog, where: seen.append(where))
+    try:
+        P.PassManager().run(P.Program.parse(PROG), verify=True)
+    finally:
+        P.remove_verify_hook(hook)
+    # before the pipeline + after each default pass, same attribution
+    # points as the IR verifier
+    assert seen[0] == "before any pass"
+    assert [w for w in seen[1:]] == [
+        f"after pass '{p.name}'" for p in P.default_pipeline()]
+    # removed: a later run never calls it again
+    n = len(seen)
+    P.PassManager().run(P.Program.parse(PROG), verify=True)
+    assert len(seen) == n
+
+
+def test_verify_hook_failure_attributes_the_pass():
+    def bomb(prog, where):
+        if where != "before any pass":
+            raise ValueError(f"layout gate tripped {where}")
+
+    P.add_verify_hook(bomb)
+    try:
+        with pytest.raises(ValueError, match="after pass 'copy-prop'"):
+            P.PassManager().run(P.Program.parse(PROG), verify=True)
+    finally:
+        P.remove_verify_hook(bomb)
+        P.remove_verify_hook(bomb)  # double-remove is a no-op
+
+
 def test_pass_dump_files(tmp_path):
     dump = str(tmp_path / "dumps")
     P.PassManager().run(P.Program.parse(PROG), dump_dir=dump)

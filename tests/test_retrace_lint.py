@@ -1,0 +1,269 @@
+"""Compile-once retrace lint (``paddle_tpu/analysis/retrace_lint.py``):
+the AST pass that catches jitted functions capturing Python-dynamic
+values. The repo's own tree must lint clean (the same bar as the source
+and concurrency lints) and each rule must catch its reconstructed bug.
+"""
+import subprocess
+import sys
+import textwrap
+
+from paddle_tpu.analysis.retrace_lint import lint_file, lint_retrace
+
+
+def _lint(code: str, path: str = "snippet.py"):
+    return lint_file(path, textwrap.dedent(code))
+
+
+def _codes(diags):
+    return [d.code for d in diags]
+
+
+# ---- whole-tree cleanliness (acceptance bar) -----------------------------
+
+
+def test_whole_tree_lints_clean():
+    diags = lint_retrace()
+    assert [d for d in diags if d.severity == "error"] == [], \
+        "\n".join(str(d) for d in diags)
+
+
+# ---- retrace-jit-in-loop -------------------------------------------------
+
+
+def test_jit_in_loop_is_flagged():
+    diags = _lint("""
+        import jax
+        for lr in rates:
+            step = jax.jit(make_step(lr))
+    """)
+    assert _codes(diags) == ["retrace-jit-in-loop"]
+    assert diags[0].severity == "error"
+
+
+def test_jit_inside_function_defined_in_loop_is_fine():
+    # the autotune pattern: the def's body runs when CALLED, not per
+    # iteration — a fresh wrapper per call site is the caller's choice
+    diags = _lint("""
+        import jax
+        for shape in shapes:
+            def make_fn(shape=shape):
+                return jax.jit(loss)
+            fns.append(make_fn)
+    """)
+    assert diags == []
+
+
+def test_jit_at_module_level_is_fine():
+    assert _lint("import jax\nstep = jax.jit(loss)\n") == []
+
+
+# ---- retrace-config-read -------------------------------------------------
+
+
+def test_config_read_inside_jitted_function():
+    diags = _lint("""
+        import jax
+        from paddle_tpu.core import config
+
+        @jax.jit
+        def step(x):
+            if config.flags().check_nan:
+                x = x + 1
+            return x
+    """)
+    assert _codes(diags) == ["retrace-config-read"]
+
+
+def test_env_read_inside_traced_code():
+    diags = _lint("""
+        import jax, os
+
+        def step(x):
+            return x * float(os.environ["SCALE"]) + float(os.getenv("B"))
+
+        f = jax.jit(step)
+    """)
+    assert sorted(_codes(diags)) == ["retrace-config-read",
+                                     "retrace-config-read"]
+
+
+def test_config_read_outside_traced_code_is_fine():
+    diags = _lint("""
+        from paddle_tpu.core import config
+        def setup():
+            return config.flags().check_nan
+    """)
+    assert diags == []
+
+
+# ---- retrace-dynamic-len -------------------------------------------------
+
+
+def test_len_of_closure_capture_in_traced_code():
+    diags = _lint("""
+        import jax
+        batches = []
+
+        @jax.jit
+        def step(x):
+            return x * len(batches)
+    """)
+    assert _codes(diags) == ["retrace-dynamic-len"]
+    assert diags[0].severity == "warning"
+
+
+def test_len_of_traced_argument_is_fine():
+    # len() of an argument is shape-derived and static per compilation
+    diags = _lint("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * len(x)
+    """)
+    assert diags == []
+
+
+def test_len_of_self_attribute_in_traced_code():
+    diags = _lint("""
+        import jax
+
+        def step(self, x):
+            return x * len(self.queue)
+
+        f = jax.jit(step)
+    """)
+    assert _codes(diags) == ["retrace-dynamic-len"]
+
+
+# ---- retrace-missing-static ----------------------------------------------
+
+
+def test_python_branch_on_uncovered_param():
+    diags = _lint("""
+        import jax
+
+        @jax.jit
+        def step(x, flag):
+            if flag:
+                x = x * 2
+            return x
+    """)
+    assert _codes(diags) == ["retrace-missing-static"]
+
+
+def test_static_argnums_covers_the_branch():
+    diags = _lint("""
+        import jax, functools
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def step(x, flag):
+            if flag:
+                x = x * 2
+            return x
+    """)
+    assert diags == []
+
+
+def test_static_argnames_covers_the_branch():
+    diags = _lint("""
+        import jax, functools
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def gen(x, n):
+            for _ in range(n):
+                x = x + 1
+            return x
+    """)
+    assert diags == []
+
+
+def test_identity_comparison_is_trace_safe():
+    diags = _lint("""
+        import jax
+
+        @jax.jit
+        def step(x, rng):
+            if rng is not None:
+                x = x + 1
+            return x
+    """)
+    assert diags == []
+
+
+# ---- retrace-dict-order --------------------------------------------------
+
+
+def test_donate_from_dict_values_without_sorted():
+    diags = _lint("""
+        import jax
+        f = jax.jit(step, donate_argnums=tuple(idx.values()))
+    """)
+    assert _codes(diags) == ["retrace-dict-order"]
+
+
+def test_donate_from_sorted_dict_values_is_fine():
+    diags = _lint("""
+        import jax
+        f = jax.jit(step, donate_argnums=tuple(sorted(idx.values())))
+        g = jax.jit(step, in_shardings=in_sh, out_shardings=out_sh)
+    """)
+    assert diags == []
+
+
+# ---- suppression + reconstructed end-to-end bug --------------------------
+
+
+def test_lint_allow_suppresses():
+    diags = _lint("""
+        import jax
+        for lr in rates:
+            step = jax.jit(make_step(lr))  # lint: allow
+    """)
+    assert diags == []
+
+
+def test_reconstructed_dynamic_closure_retrace_bug():
+    """The ISSUE's fixture: a serving loop whose jitted step captures a
+    growing request list — trace-frozen length AND a jit rebuilt per
+    request. Both hazards must surface in one pass."""
+    diags = _lint("""
+        import jax
+
+        pending = []
+
+        def decode_step(params, tokens):
+            batch = tokens[: len(pending)]
+            return params, batch
+
+        def serve(params, reqs):
+            for r in reqs:
+                pending.append(r)
+                step = jax.jit(decode_step)
+                params, _ = step(params, r.tokens)
+    """)
+    assert sorted(_codes(diags)) == ["retrace-dynamic-len",
+                                     "retrace-jit-in-loop"]
+
+
+def test_syntax_error_is_reported_not_raised():
+    diags = _lint("def broken(:\n")
+    assert _codes(diags) == ["syntax-error"]
+
+
+# ---- CLI integration -----------------------------------------------------
+
+
+def test_cli_only_retrace_flags_fixture(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "for lr in rates:\n"
+        "    f = jax.jit(loss)\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis",
+         "--only", "retrace", str(bad)],
+        capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "retrace-jit-in-loop" in proc.stdout
+    assert "1 error(s)" in proc.stdout

@@ -106,6 +106,62 @@ def test_degrade_spec_drops_non_divisible_dims():
     assert degrade_spec(mesh, P(TP_AXIS), (64, 32)) == P(TP_AXIS, None)
 
 
+def test_spec_for_overlapping_rules_earlier_shadows_later():
+    # the general rule first: the specific one below it can never win
+    shadowed = (("*/q/*", P("tp")), ("*/q/w", P(None, "tp")))
+    assert spec_for("layer_0/self_attn/q/w", shadowed) == P("tp")
+    # specific-before-general is the intended ordering
+    ordered = (("*/q/w", P(None, "tp")), ("*/q/*", P("tp")))
+    assert spec_for("layer_0/self_attn/q/w", ordered) == P(None, "tp")
+    assert spec_for("layer_0/self_attn/q/b", ordered) == P("tp")
+
+
+def test_group_layout_with_zero_matches_replicates_everything():
+    mesh = tp_submesh(jax.devices()[:2])
+    layout = GroupLayout(rules=(("other_model/*", P(None, "tp")),),
+                         optional=())
+    assert layout.param_spec("layer_0/self_attn/q/w", (32, 32), mesh) == \
+        P(None, None)
+    assert layout.param_spec("emb/embedding/word_emb", (97, 32), mesh) == \
+        P(None, None)
+
+
+# ---- layout lint at engine init (analysis.shard_analysis wiring) -----------
+
+
+def test_engine_init_rejects_bad_layout_before_placement():
+    cfg, variables, _ = _build()
+    group = make_groups(2)[0]
+    bad = GroupLayout(rules=(("*/self_attn/qq/w", P(None, TP_AXIS)),),
+                      optional=())
+    with pytest.raises(EnforceError, match="shard-dead-rule"):
+        DecodeEngine(variables, cfg, decode=DecodeConfig(**DC),
+                     group=group, layout=bad)
+
+
+def test_engine_init_lint_layout_off_places_anyway():
+    cfg, variables, _ = _build()
+    group = make_groups(2)[0]
+    bad = GroupLayout(rules=(("*/self_attn/qq/w", P(None, TP_AXIS)),),
+                      optional=())
+    eng = DecodeEngine(variables, cfg,
+                       decode=DecodeConfig(lint_layout=False, **DC),
+                       group=group, layout=bad)
+    try:
+        # dead rule means no param matched: everything degraded/replicated
+        assert eng._params is not None
+    finally:
+        eng.close()
+
+
+def test_engine_init_accepts_default_layout():
+    # the lint is ON by default and the shipped layout must be clean for
+    # the swiglu variant too (gate rules are load-bearing there)
+    cfg, variables, _ = _build(ffn_activation="swiglu")
+    eng = _engine(variables, cfg, group=make_groups(2)[0])
+    eng.close()
+
+
 # ---- group construction ----------------------------------------------------
 
 
