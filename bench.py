@@ -1212,6 +1212,179 @@ def serve_disagg_main(n_rounds: int = 4) -> dict:
     return result
 
 
+def serve_host_tier_main(n_rounds: int = 3) -> dict:
+    """Hierarchical KV host tier benchmark (``bench.py --serve-host-tier``):
+    the same two-tenant shared-system-prompt workload served by a
+    two-engine ``DecodeFleet`` two ways on CPU JAX —
+
+    - **no tier**: radix prefix caches only, capped small enough that ONE
+      engine's tree holds one tenant's working set; least-loaded routing
+      interleaves both tenants onto both engines, so the shared prefixes
+      churn out of the trees and most prompt tokens re-pay prefill;
+    - **tiered**: a shared ``HostPagePool`` behind both engines plus
+      prefix-digest routing — each tenant's traffic converges on the
+      engine already holding its prefix, and pages the capped trees do
+      evict demote to host RAM and promote back instead of re-prefilling.
+
+    Headline metric: fleet-wide prefix-cache hit fraction of prompt
+    tokens with the tier+routing on (``host_tier_prefix_hit_frac``,
+    higher is better, gated), with the untiered fraction alongside — the
+    gap is the tier's effective-capacity win. The promote path runs on
+    the decode loop thread, so the leg also storms the warm tiered fleet
+    with prefix traffic while interactive decodes are in flight and
+    reports their p99 (``host_tier_decode_p99_storm_ms``, lower is
+    better, gated) against the untiered fleet's number — promotion must
+    stay decode-p99-neutral. Prints ONE JSON line."""
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from paddle_tpu import models
+    from paddle_tpu.serving import (DecodeConfig, DecodeEngine, DecodeFleet,
+                                    HostPagePool)
+
+    result = {
+        "metric": "host_tier_prefix_hit_frac",
+        "value": 0.0,
+        "unit": "frac",
+        "notes": [],
+    }
+    try:
+        result["device_kind"] = jax.devices()[0].device_kind
+        from paddle_tpu.core import locks as _locks
+        _locks.set_enabled(False)  # production default; measured elsewhere
+        vocab, ps = 512, 8
+        spec = models.get_model(
+            "transformer_lm", seq_len=128, vocab=vocab, d_model=64,
+            d_inner=128, num_heads=4, n_layers=2)
+        cfg = spec.extra["cfg"]
+        rng = np.random.RandomState(0)
+        variables = spec.model.init(0, *spec.synth_batch(2, rng))
+        # the radix budget (8 pages) holds ONE tenant's 6-page system
+        # prompt plus tails — not both tenants'. That cap is the whole
+        # experiment: without the tier, whatever routing interleaves onto
+        # an engine churns; with it, evictions come back as promotes.
+        dconf = dict(max_slots=4, page_size=ps, max_context=128,
+                     prefill_chunk=16, num_pages=64, prefix_cache=True,
+                     prefix_cache_pages=8)
+        prefixes = [rng.randint(1, vocab, size=(48,)).astype(np.int32)
+                    for _ in range(2)]
+        reqs = []
+        for i in range(12):  # six requests per tenant
+            tail = rng.randint(1, vocab,
+                               size=(int(rng.randint(4, 9)),)
+                               ).astype(np.int32)
+            reqs.append((np.concatenate([prefixes[i % 2], tail]), 8))
+        # shuffled submit order per wave: least-loaded placement then
+        # lands an arbitrary tenant mix on each engine (the fleet-wide
+        # working set, ~14 pages, overflows any one 8-page tree), while
+        # digest routing keeps each tenant pinned to its warm engine
+        # regardless of order
+        orders = [rng.permutation(len(reqs)) for _ in range(n_rounds)]
+        steady = [(rng.randint(1, vocab,
+                               size=(int(rng.randint(8, 13)),)
+                               ).astype(np.int32), 48)
+                  for _ in range(3)]
+
+        def storm_wave(fleet):
+            """Interactive decodes in flight, then the prefix storm lands
+            on top (demotes + promotes on the tiered fleet); returns the
+            interactive requests' completion latencies."""
+            lats = [0.0] * len(steady)
+            t_sub = []
+            handles = []
+            for p, mnt in steady:
+                handles.append(fleet.submit(p, mnt))
+                t_sub.append(time.perf_counter())
+            storm_handles = [fleet.submit(p, 2) for p, _ in reqs]
+
+            def waiter(i):
+                handles[i].result(timeout=600)
+                lats[i] = time.perf_counter() - t_sub[i]
+
+            threads = [threading.Thread(target=waiter, args=(i,))
+                       for i in range(len(handles))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for h in storm_handles:
+                h.result(timeout=600)
+            return lats
+
+        def run_config(with_tier):
+            pool = (HostPagePool(max_bytes=8 << 20, page_size=ps)
+                    if with_tier else None)
+            kw = dict(dconf, prefix_digest=with_tier)
+            engines = [DecodeEngine(variables, cfg,
+                                    decode=DecodeConfig(**kw),
+                                    host_tier=pool)
+                       for _ in range(2)]
+            fleet = DecodeFleet(engines)
+
+            def counts():
+                tot = {"prompt_tokens_total": 0, "prefix_hit_tokens_total": 0,
+                       "host_promoted_pages_total": 0}
+                for e in engines:
+                    snap = e.metrics.snapshot()
+                    for k in tot:
+                        tot[k] += snap[k]
+                return tot
+
+            # warm: jits + seed each tenant's prefix once, off the clock
+            for pfx in prefixes:
+                fleet.submit(pfx, 4).result(timeout=600)
+            before = counts()
+            for r in range(n_rounds):
+                handles = [fleet.submit(*reqs[i]) for i in orders[r]]
+                for h in handles:
+                    h.result(timeout=600)
+            after = counts()
+            prompt_toks = (after["prompt_tokens_total"]
+                           - before["prompt_tokens_total"])
+            hit_toks = (after["prefix_hit_tokens_total"]
+                        - before["prefix_hit_tokens_total"])
+            promoted = counts()["host_promoted_pages_total"]
+            # p99 probe on the warm fleet: storms re-touch both tenants'
+            # prefixes, so the tiered loop threads interleave demote +
+            # promote work with the live decodes being timed
+            storm_lats = []
+            for _ in range(n_rounds):
+                storm_lats.extend(storm_wave(fleet))
+            fleet.close(timeout=120)
+            for e in engines:
+                e.kv.assert_no_leaks()
+            p99 = float(np.percentile(storm_lats, 99)) * 1e3
+            return hit_toks / max(prompt_toks, 1), p99, promoted
+
+        no_tier_frac, no_tier_p99, _ = run_config(False)
+        tier_frac, tier_p99, promoted = run_config(True)
+
+        result["value"] = round(tier_frac, 3)
+        result["no_tier_prefix_hit_frac"] = round(no_tier_frac, 3)
+        result["host_tier_decode_p99_storm_ms"] = round(tier_p99, 1)
+        result["no_tier_decode_p99_storm_ms"] = round(no_tier_p99, 1)
+        result["host_tier_promoted_pages"] = promoted
+        result["requests"] = 2 * (1 + n_rounds * (len(reqs) + len(steady)
+                                                  + len(reqs)))
+        result["notes"].append(
+            "tier+routing prefix hit frac "
+            f"{tier_frac:.3f} vs {no_tier_frac:.3f} untiered "
+            f"({promoted} pages promoted from host RAM)")
+        if tier_frac <= no_tier_frac:
+            result["notes"].append(
+                "WARNING: host tier + digest routing did not raise the "
+                "fleet prefix hit fraction")
+    except Exception as e:  # same robustness contract as main(): always JSON
+        result["notes"].append(
+            f"serve_host_tier_failed: {type(e).__name__}: {e}"[:300])
+    print(json.dumps(result))
+    return result
+
+
 def tune_child_main(cache_dir: str, mode: str) -> dict:
     """``bench.py --tune-child <cache_dir> <cold|warm>``: construct the
     warm-restart probe engine against a shared persistent compile cache +
@@ -1480,6 +1653,9 @@ if __name__ == "__main__":
     elif "--serve-disagg" in sys.argv:
         serve_disagg_main(
             n_rounds=int(os.environ.get("PT_BENCH_DISAGG_ROUNDS", "4")))
+    elif "--serve-host-tier" in sys.argv:
+        serve_host_tier_main(
+            n_rounds=int(os.environ.get("PT_BENCH_HOST_TIER_ROUNDS", "3")))
     elif "--serve-decode" in sys.argv:
         serve_decode_main(
             n_requests=int(os.environ.get("PT_BENCH_DECODE_REQS", "24")))

@@ -61,6 +61,7 @@ __all__ = [
     "DECODE_STEP",
     "DECODE_RECOVER",
     "DISAGG_HANDOFF",
+    "HOST_TIER",
     "GROUP_MEMBER",
     "DEVICE_LOST",
     "PREEMPT_NOTICE",
@@ -88,6 +89,14 @@ DECODE_RECOVER = "serving.decode.recover"
 # models a torn/failed transfer, which must degrade to re-prefill on
 # another worker (never a lost request)
 DISAGG_HANDOFF = "serving.disagg.handoff"
+# hierarchical KV host tier (serving.host_tier.HostPagePool): fires on
+# the promote path (ctx op="promote" — a "nan" spec corrupts the fetched
+# page bytes BEFORE CRC verification, so a bit-flipped host page must be
+# quarantined and the request re-prefilled token-exactly) and on the
+# demote path (ctx op="demote" — a "stall" models slow host memory and
+# must never extend the pool's lock hold or stall the decode loop's
+# step path beyond the stalled iteration)
+HOST_TIER = "serving.host_tier"
 # per-member canary of a tensor-parallel replica group
 # (serving.shardgroup.probe_members): fires once per shard with
 # ctx={engine, shard, device}, so chaos can fail or stall exactly ONE chip
@@ -118,6 +127,7 @@ def registered_points() -> List[str]:
         DECODE_STEP,
         DECODE_RECOVER,
         DISAGG_HANDOFF,
+        HOST_TIER,
         GROUP_MEMBER,
         DEVICE_LOST,
         PREEMPT_NOTICE,

@@ -71,6 +71,11 @@ from paddle_tpu.serving.disagg import (
     HandoffCorrupt,
     HandoffPayload,
 )
+from paddle_tpu.serving.host_tier import (
+    HostPageCorrupt,
+    HostPagePool,
+    prefix_digests,
+)
 from paddle_tpu.serving.engine import (
     DeadlineExceeded,
     EngineClosedError,
@@ -136,6 +141,9 @@ __all__ = [
     "PagedKVCache",
     "PageAllocator",
     "RadixPrefixCache",
+    "HostPagePool",
+    "HostPageCorrupt",
+    "prefix_digests",
     "SCRATCH_PAGE",
     "DecodeFleet",
     "EngineUnhealthy",

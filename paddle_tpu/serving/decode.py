@@ -52,6 +52,7 @@ import itertools
 import os
 import threading
 import time
+import zlib
 from collections import deque
 from typing import Any, Callable, Deque, Dict, List, Optional
 
@@ -85,6 +86,7 @@ from paddle_tpu.serving.engine import (
     PendingResult,
     ServingConfig,
 )
+from paddle_tpu.serving.host_tier import HostPageCorrupt, HostPagePool
 from paddle_tpu.serving.kv_cache import SCRATCH_PAGE, PagedKVCache
 from paddle_tpu.serving.metrics import DecodeMetrics
 from paddle_tpu.serving.prefix_cache import RadixPrefixCache
@@ -205,6 +207,22 @@ class DecodeConfig:
     # layout errors (dead rules, rank mismatches, kv-geometry violations)
     # raise here instead of surfacing as a wrong placement on a pod
     lint_layout: bool = True
+    # -- hierarchical KV host tier (serving.host_tier) --------------------
+    # byte budget for a PRIVATE host-RAM page pool behind the radix tree
+    # (requires prefix_cache): radix inserts write through to host RAM,
+    # radix misses whose continuation the pool holds promote back
+    # asynchronously. None = no private pool; pass a shared HostPagePool
+    # to DecodeEngine(host_tier=...) for fleet-wide sharing + crash
+    # recovery (the pool survives any one engine's kill()).
+    host_tier_bytes: Optional[int] = None
+    # publish a compact per-prefix digest set for prefix-aware fleet
+    # routing: DecodeFleet/DisaggRouter route each prompt to the engine
+    # with the longest cached prefix (least-loaded tiebreak)
+    prefix_digest: bool = False
+    # promote-apply budget: pages implanted from the host tier per loop
+    # iteration — bounds added per-iteration latency so promotion stays
+    # decode-p99-neutral (the bench leg pins this)
+    host_promote_pages_per_iter: int = 4
 
 
 @dataclasses.dataclass
@@ -388,6 +406,7 @@ class DecodeEngine:
         draft_cfg: Optional[dict] = None,
         group: Optional[ReplicaGroup] = None,
         layout: Optional[GroupLayout] = None,
+        host_tier: Optional[HostPagePool] = None,
     ):
         self.config = config or ServingConfig()
         self.decode_config = dconf = decode or DecodeConfig()
@@ -544,6 +563,40 @@ class DecodeEngine:
             self._copy_page_d = (self._copy_page if group is None
                                  or not self._spec_k else jax.jit(
                                      _copy, out_shardings=dkvs))
+
+        # -- hierarchical KV host tier (serving.host_tier) ----------------
+        # a pool passed in is SHARED (fleet-wide prefix sharing + crash
+        # recovery: it survives this engine's kill()); host_tier_bytes
+        # builds a private one. Draft-model engines skip the tier — the
+        # pool carries only target-cache pages, and adopting them without
+        # the draft's would desynchronize speculation (same rationale as
+        # handoff adoption degrading to re-prefill).
+        self._host_tier: Optional[HostPagePool] = host_tier
+        if self._host_tier is None and dconf.host_tier_bytes:
+            self._host_tier = HostPagePool(dconf.host_tier_bytes,
+                                           dconf.page_size)
+        if self._host_tier is not None and self._spec_k:
+            ptlog.warning(
+                "host tier disabled for engine %s: the pool carries only "
+                "target-cache pages, which a speculative engine cannot "
+                "adopt", self.config.engine_label)
+            self._host_tier = None
+        if self._host_tier is not None:
+            enforce(self._prefix is not None,
+                    "host tier requires DecodeConfig(prefix_cache=True): "
+                    "it extends the radix tree, not the raw page pool")
+            enforce(self._host_tier.compatible(dconf.page_size),
+                    f"host tier page_size {self._host_tier.page_size} != "
+                    f"engine page_size {dconf.page_size}")
+        # promote jobs applied on the loop thread, budgeted per iteration
+        # (host_promote_pages_per_iter); keys dedup in-flight prefixes
+        self._promote_jobs: Deque = deque()
+        self._promote_keys: set = set()
+        # prefix-aware routing digest: republished (lock-free swap of an
+        # immutable frozenset) on the loop thread whenever the tree's
+        # digest_version moved; fleets read it from any thread
+        self._digest_pub: frozenset = frozenset()
+        self._digest_seen = -1
 
         # tenants / scheduler / admission — same wiring as ServingEngine,
         # but deadline feasibility runs through the per-token cost model
@@ -997,14 +1050,16 @@ class DecodeEngine:
             self._admit_handoffs()
             self._admit()
             t0 = time.perf_counter()
+            did_promote = self._apply_promotes()
             did_prefill = self._prefill_some()
             did_step = self._decode_step()
-            if did_prefill or did_step:
+            if did_prefill or did_step or did_promote:
                 self.metrics.set_pages(self._kv.pages_in_use,
                                        self._kv.pages_free)
                 self.metrics.set_active_slots(len(self._active))
                 self.metrics.set_load(self.load())
                 self.metrics.set_queue_depth(self._queue.qsize())
+                self._publish_digest()
                 if self._loop_trace is not None:
                     tracing.record_span(
                         "serving.decode.step", t0, time.perf_counter(),
@@ -1024,6 +1079,9 @@ class DecodeEngine:
             self._pending_admit.append(req)
         if self._prefix is not None:
             self._prefix.clear()  # drained: drop the tree's page refs
+        self._promote_jobs.clear()
+        self._promote_keys.clear()
+        self._publish_digest()  # tree gone: publish the empty digest
         self.metrics.set_active_slots(0)
         self.metrics.set_pages(self._kv.pages_in_use, self._kv.pages_free)
 
@@ -1207,6 +1265,14 @@ class DecodeEngine:
             return
         pages = self._prefix.match(req.seq, max_pages)
         m = len(pages)
+        # hierarchical KV: the tree's true depth (pre-CoW-shrink) is the
+        # promote frontier — when the host tier holds the NEXT page of
+        # this prefix, enqueue an async promote so the next same-prefix
+        # request hits in HBM. THIS request prefills as usual either way
+        # (token-exact regardless of promotion timing).
+        if (self._host_tier is not None and m < max_pages
+                and self._host_tier.contains(req.seq, m + 1)):
+            self._host_request_promote(req.seq, max_pages)
         while m > 0:
             c0 = (m * ps) // C
             lo = (c0 * C) // ps  # first logical page the next chunk touches
@@ -1239,6 +1305,178 @@ class DecodeEngine:
         runlog.emit("decode_prefix_hit", hit_tokens=m * ps,
                     saved_chunks=c0, cow=cow_done,
                     engine=self.metrics.engine_label)
+
+    # -- hierarchical KV host tier (serving.host_tier) ---------------------
+
+    def _host_demote(self, req: _DecodeRequest, n_full: int) -> None:
+        """Write-through demote: gather ``req``'s first ``n_full`` fully-
+        written pages off-device and store them in the host tier. Called
+        on the loop thread right after the radix insert, while the tree
+        holds refs — the pages are immutable and cannot be recycled under
+        a stale key. Also the crash-recovery write: with a SHARED pool,
+        these bytes outlive this engine's kill(), so a restarted engine
+        repopulates its tree from here after journal replay."""
+        if self._host_tier is None:
+            return
+        import jax.numpy as jnp
+
+        pages = self._kv.slot_pages(req.slot)[:n_full]
+        wrote = 0
+        bp = 0
+        try:
+            for i, p in enumerate(pages):
+                if self._host_tier.contains(req.seq, i + 1):
+                    continue  # shared prefix already demoted — dedup
+                k = np.asarray(self._gather_page(self._k_pages,
+                                                 jnp.int32(p)))
+                v = np.asarray(self._gather_page(self._v_pages,
+                                                 jnp.int32(p)))
+                res = self._host_tier.put(
+                    req.seq, i, k, v, engine=self.metrics.engine_label)
+                wrote += res["added"]
+                if res["evicted"]:
+                    bp += 1
+        except Exception as e:
+            # demote is strictly best-effort: an injected stall/error (or
+            # real host-memory pressure) must never fail the request —
+            # the page simply stays HBM-only
+            ptlog.warning("host-tier demote failed: %r; page stays "
+                          "HBM-only", e)
+        if wrote:
+            self.metrics.record_host_demote(wrote)
+        if bp:
+            self.metrics.record_host_backpressure(bp)
+        self.metrics.set_host_tier_bytes(self._host_tier.bytes_used,
+                                         self._host_tier.max_bytes)
+
+    def _host_request_promote(self, seq: np.ndarray, want_pages: int) -> None:
+        """Enqueue an async promote of this prefix up to ``want_pages``
+        pages; dedup by prefix digest so a storm of same-prefix requests
+        enqueues one job. The hit is counted HERE (the routing-visible
+        event), not at apply time."""
+        ps = self.decode_config.page_size
+        toks = np.asarray(seq[:want_pages * ps], np.int32)
+        key = zlib.crc32(toks.tobytes()) & 0xFFFFFFFF
+        if key in self._promote_keys:
+            return
+        self._promote_keys.add(key)
+        self._promote_jobs.append((key, toks, want_pages))
+        self.metrics.record_host_hit()
+
+    def _apply_promotes(self) -> bool:
+        """Apply queued host-tier promotions on the loop thread, at most
+        ``host_promote_pages_per_iter`` pages per iteration — off the
+        request path (the enqueueing request prefilled normally) and
+        bounded so promotion stays decode-p99-neutral.
+
+        Each application re-checks the tree (``peek``) because the job
+        may be stale: a concurrent admission may have prefilled the
+        prefix already, or eviction may have shortened it since enqueue.
+        Page ownership follows the loader-handoff discipline documented
+        on ``PageAllocator.refcounts``: alloc (ref 1) → implant →
+        ``insert`` refs for the tree (→ 2) → free the loader ref (→ 1,
+        tree-owned). A CRC failure quarantines the host page and drops
+        the job — the prefix simply stays cold and re-prefills."""
+        if self._host_tier is None or not self._promote_jobs:
+            return False
+        import jax.numpy as jnp
+
+        ps = self.decode_config.page_size
+        budget = self.decode_config.host_promote_pages_per_iter
+        did = False
+        while budget > 0 and self._promote_jobs:
+            key, toks, want = self._promote_jobs.popleft()
+            if self._prefix.max_pages is not None:
+                # promoting past the tree's own size cap is wasted motion:
+                # the insert would be trimmed right back out
+                want = min(want, self._prefix.max_pages)
+            tree_pages = self._prefix.peek(toks, want)
+            d = len(tree_pages)
+            if d >= want:  # stale: someone prefilled it meanwhile
+                self._promote_keys.discard(key)
+                continue
+            t0 = time.perf_counter()
+            try:
+                got = self._host_tier.get(
+                    toks, d, engine=self.metrics.engine_label)
+            except HostPageCorrupt:
+                # bit-flipped host page: quarantined by the pool; the
+                # prefix stays cold and the next request re-prefills
+                # token-exactly instead of trusting it
+                self.metrics.record_host_quarantine()
+                self._promote_keys.discard(key)
+                continue
+            except Exception as e:
+                ptlog.warning("host-tier promote read failed: %r", e)
+                self._promote_keys.discard(key)
+                continue
+            if got is None:  # evicted from the pool since enqueue
+                self._promote_keys.discard(key)
+                continue
+            alloced = self._kv.allocator.alloc(1)
+            if alloced is None:
+                # never steal device pages from live traffic for a
+                # warm-ahead; drop the job — the next admission re-probes
+                self._promote_keys.discard(key)
+                continue
+            page = alloced[0]
+            p = jnp.int32(page)
+            self._k_pages = self._implant_page(
+                self._k_pages, p, jnp.asarray(got[0], self._cache_dtype))
+            self._v_pages = self._implant_page(
+                self._v_pages, p, jnp.asarray(got[1], self._cache_dtype))
+            self._prefix.insert(toks[:(d + 1) * ps], tree_pages + [page])
+            self._kv.allocator.free([page])  # hand ownership to the tree
+            budget -= 1
+            did = True
+            self.metrics.record_host_promote(time.perf_counter() - t0)
+            # progress guard: the insert can be trimmed straight back out
+            # (size-cap eviction, allocator pressure). Re-enqueue only on
+            # real depth growth — otherwise a capped tree and a warm pool
+            # would promote-evict-promote forever and the loop never idles
+            nd = len(self._prefix.peek(toks, want))
+            if d < nd < want and self._host_tier.contains(toks, nd + 1):
+                self._promote_jobs.append((key, toks, want))
+            else:
+                self._promote_keys.discard(key)
+        if did:
+            self.metrics.set_pages(self._kv.pages_in_use,
+                                   self._kv.pages_free)
+        return did
+
+    def _publish_digest(self) -> None:
+        """Republish the routing digest when the tree changed. Loop-thread
+        only; readers (DecodeFleet._pick, any thread) see an immutable
+        frozenset swapped atomically under the GIL."""
+        if not self.decode_config.prefix_digest or self._prefix is None:
+            return
+        v = self._prefix.digest_version
+        if v != self._digest_seen:
+            self._digest_seen = v
+            self._digest_pub = self._prefix.digests()
+
+    def prefix_digest(self) -> frozenset:
+        """The engine's published prefix-digest set (empty unless
+        ``DecodeConfig.prefix_digest``). Lock-free snapshot."""
+        return self._digest_pub
+
+    def prefix_match_depth(self, digests: "List[int]") -> int:
+        """Longest prefix (in pages) of a prompt's digest chain (from
+        :func:`serving.host_tier.prefix_digests`) this engine has cached.
+        The routing score: fleets send each prompt to the deepest match."""
+        pub = self._digest_pub
+        depth = 0
+        for dg in digests:
+            if dg not in pub:
+                break
+            depth += 1
+        return depth
+
+    @property
+    def host_tier(self) -> Optional[HostPagePool]:
+        """The engine's host-RAM page pool (shared or private; None when
+        the tier is off)."""
+        return self._host_tier
 
     def _ensure_pages(self, req: _DecodeRequest, n_positions: int) -> bool:
         """Grow ``req``'s slot to ``n_positions``, evicting prefix-cache
@@ -1355,6 +1593,9 @@ class DecodeEngine:
                     if n_full:
                         self._prefix.insert(
                             req.seq, self._kv.slot_pages(req.slot)[:n_full])
+                        # write-through demote: the same immutable pages,
+                        # while the tree holds refs (no recycle race)
+                        self._host_demote(req, n_full)
                 req.phase = "decode"
                 req.cur_len = len(req.seq)
                 # the final chunk's sample IS the next token after the
@@ -1992,6 +2233,12 @@ class DecodeEngine:
         self._kv.release_all()
         if self._prefix is not None:
             self._prefix.clear()
+        # the host tier is deliberately NOT cleared: a shared pool is the
+        # crash-recovery substrate — the restarted engine repopulates its
+        # radix tree from it (the recovery ladder's adopt-from-host-tier
+        # rung, between "re-prefill locally" and "migrate")
+        self._promote_jobs.clear()
+        self._promote_keys.clear()
         for req in drained:
             if not req.handle.done():
                 req.handle._fail(exc)

@@ -326,12 +326,14 @@ class DisaggRouter(DecodeFleet):
     # -- request intake ----------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int, **kwargs):
-        """Route to the least-loaded healthy prefill-role worker; with
-        none available (all converted away, breakers open), any healthy
-        worker takes the request end-to-end — degraded, never down."""
-        eng = self._pick(candidates=self.workers(PREFILL))
+        """Route to the healthy prefill-role worker with the longest
+        cached prefix of ``prompt`` (least-loaded tiebreak — see
+        ``DecodeFleet._pick``); with none available (all converted away,
+        breakers open), any healthy worker takes the request end-to-end —
+        degraded, never down."""
+        eng = self._pick(candidates=self.workers(PREFILL), prompt=prompt)
         if eng is None:
-            eng = self._pick()
+            eng = self._pick(prompt=prompt)
         if eng is None:
             raise EngineUnhealthy(
                 "no healthy worker (all breakers open or draining)")

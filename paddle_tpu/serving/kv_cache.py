@@ -70,6 +70,20 @@ class PageAllocator:
     def refcount(self, page: int) -> int:
         return self._refs[page]
 
+    def refcounts(self) -> List[int]:
+        """Copy of every page's refcount (diagnostics; the host-tier
+        promote tests pin the ownership-handoff discipline with this).
+
+        The handoff pattern for loading externally-held page bytes (host
+        tier promote, handoff adoption into a cache structure): the
+        loader ``alloc(1)``\\ s the page (ref 1, loader-owned), implants
+        the bytes, hands ownership to the long-lived holder (e.g.
+        ``RadixPrefixCache.insert`` takes its own ref → 2), then
+        ``free``\\ s its loader ref (→ 1, holder-owned). If the holder
+        declined the page (already cached), the final ``free`` returns
+        it to the pool — never a leak, never a double-own."""
+        return list(self._refs)
+
     def alloc(self, n: int) -> Optional[List[int]]:
         """``n`` distinct page ids (each with refcount 1), or None if fewer
         than ``n`` are free."""

@@ -327,6 +327,11 @@ class DecodeMetrics:
             "serving.decode.request_latency_seconds",
             help="End-to-end decode request latency (submit to last token).",
             buckets=_LATENCY_BUCKETS)
+        reg.histogram(
+            "serving.host_tier.promote_seconds",
+            help="Wall time to promote one host-tier page into the radix "
+                 "tree (CRC verify + device implant + insert).",
+            buckets=_LATENCY_BUCKETS)
         self.requests_total = 0
         self.responses_total = 0
         self.tokens_total = 0          # generated tokens across all requests
@@ -356,6 +361,13 @@ class DecodeMetrics:
         self.prefix_hit_tokens_total = 0  # prompt tokens served from cache
         self.prefix_saved_chunks_total = 0  # prefill chunks skipped outright
         self.cow_copies_total = 0         # copy-on-write page copies
+        # hierarchical KV host tier (serving.host_tier.* families)
+        self.host_tier_hits_total = 0     # admissions whose continuation
+        #                                   the host tier held (promote queued)
+        self.host_promoted_pages_total = 0  # pages implanted tree-ward
+        self.host_demoted_pages_total = 0   # pages written through to host
+        self.host_quarantined_total = 0     # CRC-failed host pages dropped
+        self.host_backpressure_total = 0    # demotes that forced LRU eviction
         # disaggregated prefill/decode (serving.disagg.* families)
         self.handoffs_out_total = 0       # prefilled requests published
         self.handoffs_in_total = 0        # handed-off requests adopted
@@ -565,6 +577,61 @@ class DecodeMetrics:
                 return 0.0
             return self.prefix_hit_tokens_total / self.prompt_tokens_total
 
+    # -- hierarchical KV host tier (serving.host_tier.* families) ------------
+
+    def record_host_hit(self) -> None:
+        """An admission's radix miss had its continuation resident in the
+        host tier — a promote job was enqueued (the request itself
+        prefills as usual; the NEXT hit lands in HBM)."""
+        with self._lock:
+            self.host_tier_hits_total += 1
+        prof.inc_counter("serving.host_tier.hits_total", labels=self._labels)
+
+    def record_host_promote(self, seconds: float) -> None:
+        """One host page promoted into the radix tree (CRC verify +
+        device implant + tree insert), timed for the p99-neutrality
+        gate: promotion is budgeted per loop iteration, so this
+        histogram bounds what it can cost a decode step."""
+        with self._lock:
+            self.host_promoted_pages_total += 1
+        prof.inc_counter("serving.host_tier.promoted_pages_total",
+                         labels=self._labels)
+        prof.observe("serving.host_tier.promote_seconds", seconds,
+                     labels=self._labels)
+
+    def record_host_demote(self, pages: int) -> None:
+        with self._lock:
+            self.host_demoted_pages_total += pages
+        prof.inc_counter("serving.host_tier.demoted_pages_total", pages,
+                         labels=self._labels)
+
+    def record_host_quarantine(self, n: int = 1) -> None:
+        """A host page failed CRC verification at promote time and was
+        quarantined — the request re-prefills token-exactly instead."""
+        with self._lock:
+            self.host_quarantined_total += n
+        prof.inc_counter("serving.host_tier.quarantined_total", n,
+                         labels=self._labels)
+
+    def record_host_backpressure(self, n: int = 1) -> None:
+        """A demote pushed the pool past its byte budget and forced LRU
+        eviction. The gauge mirror is what the watch layer's
+        demote-backpressure rule subscribes to: a sustained climb means
+        the fleet's warm working set outgrew host RAM."""
+        with self._lock:
+            self.host_backpressure_total += n
+            total = self.host_backpressure_total
+        prof.inc_counter("serving.host_tier.backpressure_total", n,
+                         labels=self._labels)
+        prof.set_gauge("serving.host_tier.demote_backpressure", total,
+                       labels=self._labels)
+
+    def set_host_tier_bytes(self, used: int, budget: int) -> None:
+        prof.set_gauge("serving.host_tier.bytes_used", used,
+                       labels=self._labels)
+        prof.set_gauge("serving.host_tier.bytes_budget", budget,
+                       labels=self._labels)
+
     # -- disaggregated prefill/decode (serving.disagg.* families) ------------
 
     def record_handoff_out(self) -> None:
@@ -706,6 +773,11 @@ class DecodeMetrics:
                 "prefix_hit_tokens_total": self.prefix_hit_tokens_total,
                 "prefix_saved_chunks_total": self.prefix_saved_chunks_total,
                 "cow_copies_total": self.cow_copies_total,
+                "host_tier_hits_total": self.host_tier_hits_total,
+                "host_promoted_pages_total": self.host_promoted_pages_total,
+                "host_demoted_pages_total": self.host_demoted_pages_total,
+                "host_quarantined_total": self.host_quarantined_total,
+                "host_backpressure_total": self.host_backpressure_total,
                 "handoffs_out_total": self.handoffs_out_total,
                 "handoffs_in_total": self.handoffs_in_total,
                 "group_member_faults_total": self.group_member_faults_total,

@@ -33,7 +33,10 @@ single loop thread — no locking.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+import zlib
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
 
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.serving.kv_cache import PageAllocator
@@ -43,9 +46,13 @@ __all__ = ["RadixPrefixCache"]
 
 class _Node:
     """One cached page: ``key`` is the page's token chunk, ``page`` the
-    physical page id the tree holds a ref on."""
+    physical page id the tree holds a ref on. ``digest`` is the running
+    CRC32 of the full token prefix this node terminates (chained from
+    the parent's digest) — the unit of the compact prefix digest the
+    fleet's prefix-aware routing matches against."""
 
-    __slots__ = ("key", "page", "parent", "children", "last_used")
+    __slots__ = ("key", "page", "parent", "children", "last_used",
+                 "digest")
 
     def __init__(self, key: Tuple[int, ...], page: int,
                  parent: Optional["_Node"]):
@@ -54,6 +61,7 @@ class _Node:
         self.parent = parent
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
         self.last_used = 0
+        self.digest = 0
 
 
 class RadixPrefixCache:
@@ -74,6 +82,11 @@ class RadixPrefixCache:
         self._root = _Node((), -1, None)
         self._nodes: List[_Node] = []  # all non-root nodes, for evict scans
         self._tick = 0
+        # per-node prefix digests (see _Node.digest) and a version stamp
+        # bumped on every membership change — the engine republishes its
+        # routing digest only when this moved
+        self._digests: Set[int] = set()
+        self.digest_version = 0
         # counters surfaced through DecodeMetrics / bench
         self.lookups = 0
         self.hits = 0
@@ -87,6 +100,13 @@ class RadixPrefixCache:
     @property
     def num_pages(self) -> int:
         return len(self._nodes)
+
+    def digests(self) -> frozenset:
+        """Immutable snapshot of the per-prefix digests currently cached
+        (one per node — the page-aligned token prefix it terminates).
+        The engine publishes this for prefix-aware fleet routing; take a
+        fresh snapshot after ``digest_version`` moves."""
+        return frozenset(self._digests)
 
     def stats(self) -> Dict[str, int]:
         return {
@@ -126,6 +146,27 @@ class RadixPrefixCache:
             self.hit_tokens += len(pages) * ps
         return pages
 
+    def peek(self, tokens: Sequence[int],
+             max_pages: Optional[int] = None) -> List[int]:
+        """:meth:`match` without the stat bumps or LRU touch — internal
+        probes (e.g. the host-tier promote apply path re-checking current
+        tree depth) must not inflate hit-rate counters or keep a prefix
+        artificially warm."""
+        ps = self.page_size
+        limit = len(tokens) // ps
+        if max_pages is not None:
+            limit = min(limit, max_pages)
+        node = self._root
+        pages: List[int] = []
+        for i in range(limit):
+            key = tuple(int(t) for t in tokens[i * ps:(i + 1) * ps])
+            child = node.children.get(key)
+            if child is None:
+                break
+            pages.append(child.page)
+            node = child
+        return pages
+
     def insert(self, tokens: Sequence[int], pages: Sequence[int]) -> int:
         """Record ``pages`` (the slot's first ``len(pages)`` logical pages,
         fully written with the K/V of ``tokens``) under their token path.
@@ -146,12 +187,18 @@ class RadixPrefixCache:
             if child is None:
                 self.allocator.ref([page])
                 child = _Node(key, int(page), node)
+                child.digest = zlib.crc32(
+                    np.asarray(key, np.int32).tobytes(),
+                    node.digest) & 0xFFFFFFFF
                 node.children[key] = child
                 self._nodes.append(child)
+                self._digests.add(child.digest)
                 added += 1
             child.last_used = self._tick
             node = child
         self.inserted_pages += added
+        if added:
+            self.digest_version += 1
         if self.max_pages is not None and self.num_pages > self.max_pages:
             self.evict(pages_needed=0,
                        max_evictions=self.num_pages - self.max_pages)
@@ -182,7 +229,10 @@ class RadixPrefixCache:
             dropped += 1
             leaf.parent.children.pop(leaf.key, None)
             self._nodes.remove(leaf)
+            self._digests.discard(leaf.digest)
         self.evicted_pages += dropped
+        if dropped:
+            self.digest_version += 1
         return freed
 
     def clear(self) -> int:
@@ -194,5 +244,8 @@ class RadixPrefixCache:
             self.allocator.free([node.page])
         self._nodes.clear()
         self._root.children.clear()
+        self._digests.clear()
+        if n:
+            self.digest_version += 1
         self.evicted_pages += n
         return n

@@ -482,7 +482,8 @@ class DecodeFleet:
         return [engines[(k + i) % n] for i in range(n)] if n else []
 
     def _pick(self, exclude: Optional[Any] = None,
-              candidates: Optional[List[Any]] = None) -> Optional[Any]:
+              candidates: Optional[List[Any]] = None,
+              prompt: Optional[Any] = None) -> Optional[Any]:
         order = [e for e in self._order(candidates)
                  if e is not exclude and not e.closed
                  and id(e) not in self._draining]
@@ -508,10 +509,40 @@ class DecodeFleet:
         # different engines run-to-run.
         pos = {id(e): i for i, e in enumerate(self.engines)}
         n = len(self.engines)
+        # prefix-aware routing: rank by the longest cached prefix of the
+        # prompt first (engines publish compact per-prefix digest sets
+        # when DecodeConfig.prefix_digest is on; others match depth 0),
+        # then least-loaded, then stable index. A digest is advisory —
+        # worst case the match is stale and the engine just prefills, so
+        # routing optimality degrades but never correctness.
+        depth = self._match_depth_fn(prompt) if prompt is not None else None
+        if depth is not None:
+            return min(healthy, key=lambda e: (-depth(e), e.load(),
+                                               pos.get(id(e), n)))
         return min(healthy, key=lambda e: (e.load(), pos.get(id(e), n)))
 
+    @staticmethod
+    def _match_depth_fn(prompt) -> Optional[Any]:
+        """Cached-prefix depth scorer for one prompt, or None when no
+        digest chain applies. Digest chains are memoized per page size —
+        a homogeneous fleet computes the CRC chain once per submit."""
+        from paddle_tpu.serving.host_tier import prefix_digests
+        memo: Dict[int, List[int]] = {}
+
+        def depth(eng) -> int:
+            match = getattr(eng, "prefix_match_depth", None)
+            dconf = getattr(eng, "decode_config", None)
+            if match is None or dconf is None:
+                return 0
+            ps = dconf.page_size
+            if ps not in memo:
+                memo[ps] = prefix_digests(prompt, ps)
+            return match(memo[ps])
+
+        return depth
+
     def submit(self, prompt, max_new_tokens: int, **kwargs):
-        eng = self._pick()
+        eng = self._pick(prompt=prompt)
         if eng is None:
             raise EngineUnhealthy(
                 "no healthy decode engine (all breakers open or cooling)")

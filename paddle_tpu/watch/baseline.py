@@ -53,7 +53,8 @@ INFO_ONLY = "info"
 
 _HIGHER_SUFFIXES = ("_per_sec", "_per_s", "_throughput", "_speedup")
 _HIGHER_CONTAINS = ("_per_sec_", "_per_sec")  # e.g. decode_tok_per_sec_bs8
-_HIGHER_EXACT = ("mfu", "goodput_frac", "handoff_quiet_throughput_frac")
+_HIGHER_EXACT = ("mfu", "goodput_frac", "handoff_quiet_throughput_frac",
+                 "host_tier_prefix_hit_frac")
 _LOWER_SUFFIXES = ("_seconds", "_ms", "_s", "_latency", "_overhead_pct")
 _LOWER_CONTAINS = ("_ms_", "latency")
 

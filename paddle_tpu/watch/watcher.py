@@ -141,6 +141,14 @@ def default_rules() -> List[WatchRule]:
         WatchRule("serving.decode.load",
                   det_mod.EwmaDetector(alpha=0.2, z_threshold=8.0,
                                        min_samples=16)),
+        # hierarchical KV host tier (serving.host_tier): cumulative count
+        # of demotes that forced an LRU eviction from the host pool. A
+        # sustained climb means the fleet's warm prefix working set no
+        # longer fits host RAM — promote hit rate is about to decay and
+        # the tier budget needs raising
+        WatchRule("serving.host_tier.demote_backpressure",
+                  det_mod.EwmaDetector(alpha=0.2, z_threshold=8.0,
+                                       min_samples=16)),
     ]
 
 

@@ -1,0 +1,355 @@
+"""paddle_tpu.serving.host_tier — hierarchical KV resilience tier tests.
+
+Unit level: the :class:`HostPagePool` contract — exact-key put/get
+roundtrip, dedup, the LRU byte bound with demote backpressure, CRC
+quarantine of a bit-flipped page (:class:`HostPageCorrupt`), and the
+:func:`prefix_digests` chain the prefix-aware routing matches on.
+
+Engine level: write-through demote at radix-insert time, async budgeted
+promote repopulating a COLD radix tree from a shared pool (the
+crash-recovery rung: ``kill()`` leaves the pool intact and a fresh
+engine over the same pool serves the same prompts token-exactly with
+promoted pages), a private pool via ``DecodeConfig.host_tier_bytes``,
+the ownership-handoff refcount discipline, corrupt-on-promote
+degrading to token-exact re-prefill, and prefix-aware
+``DecodeFleet``/``DisaggRouter`` routing by published digest sets.
+"""
+
+import time
+import types
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import models
+from paddle_tpu.models.transformer_lm import generate
+from paddle_tpu.resilience import faults
+from paddle_tpu.serving import (
+    DecodeConfig,
+    DecodeEngine,
+    DecodeFleet,
+    HostPageCorrupt,
+    HostPagePool,
+    ServingConfig,
+    prefix_digests,
+)
+
+VOCAB = 97
+
+DC = dict(max_slots=3, page_size=4, max_context=40, prefill_chunk=8,
+          num_pages=30, prefix_cache=True,
+          recovery_base_delay_s=0.001, recovery_max_delay_s=0.005,
+          breaker_cooldown_s=0.05, breaker_max_cooldown_s=0.2)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    yield
+    faults.clear()
+
+
+# ---- prefix digests --------------------------------------------------------
+
+
+def test_prefix_digests_chain():
+    toks = list(range(1, 13))
+    d = prefix_digests(toks, 4)
+    assert len(d) == 3  # one per full page; the partial tail never digests
+    assert prefix_digests(toks + [99], 4) == d
+    # chained: a longer prefix extends, a diverging one splits at the page
+    assert prefix_digests(toks[:8], 4) == d[:2]
+    fork = prefix_digests(toks[:8] + [77] * 4, 4)
+    assert fork[:2] == d[:2] and fork[2] != d[2]
+    assert prefix_digests([], 4) == []
+
+
+# ---- pool unit level -------------------------------------------------------
+
+
+def _page(seed, shape=(2, 4, 4, 8)):
+    return np.random.RandomState(seed).rand(*shape).astype(np.float32)
+
+
+def test_pool_put_get_roundtrip_and_dedup():
+    pool = HostPagePool(max_bytes=1 << 20, page_size=4)
+    toks = list(range(10, 22))
+    k0, v0 = _page(0), _page(1)
+    assert pool.put(toks, 0, k0, v0) == {"added": 1, "evicted": 0}
+    assert pool.put(toks, 0, k0, v0) == {"added": 0, "evicted": 0}  # dedup
+    assert pool.put(toks, 1, _page(2), _page(3))["added"] == 1
+    assert pool.contains(toks, 1) and pool.contains(toks, 2)
+    assert not pool.contains(toks, 3)  # only 2 pages stored
+    assert not pool.contains(toks[:3], 1)  # shorter than one page
+    k, v = pool.get(toks, 0)
+    np.testing.assert_array_equal(k, k0)
+    np.testing.assert_array_equal(v, v0)
+    assert pool.get(toks, 2) is None  # miss
+    # a different prompt sharing no prefix misses even at page 0
+    assert pool.get([88] * 12, 0) is None
+    s = pool.stats()
+    assert s["puts"] == 2 and s["hits"] == 1 and s["misses"] == 2
+    assert pool.clear() == 2
+    assert pool.bytes_used == 0
+
+
+def test_pool_lru_byte_bound_backpressure():
+    one = _page(0).nbytes * 2  # one entry = K blob + V blob
+    pool = HostPagePool(max_bytes=3 * one, page_size=4)
+    prompts = [[100 + i] * 4 for i in range(4)]
+    for i, p in enumerate(prompts[:3]):
+        assert pool.put(p, 0, _page(i), _page(i))["evicted"] == 0
+    assert pool.bytes_used == 3 * one
+    # touch prompt 0 so prompt 1 is the LRU victim
+    assert pool.get(prompts[0], 0) is not None
+    res = pool.put(prompts[3], 0, _page(3), _page(3))
+    assert res == {"added": 1, "evicted": 1}
+    assert pool.bytes_used <= pool.max_bytes
+    assert not pool.contains(prompts[1], 1)  # LRU evicted
+    assert pool.contains(prompts[0], 1)
+    assert pool.stats()["backpressure"] == 1
+    with pytest.raises(Exception):  # one page larger than the whole budget
+        HostPagePool(max_bytes=8, page_size=4).put(
+            prompts[0], 0, _page(0), _page(0))
+
+
+def test_pool_crc_quarantine_on_bit_flip():
+    pool = HostPagePool(max_bytes=1 << 20, page_size=4)
+    toks = list(range(1, 5))
+    pool.put(toks, 0, _page(0), _page(1))
+    # flip one bit of the stored K blob — host-memory corruption
+    (key, entry), = pool._entries.items()
+    entry.k_blob = bytes([entry.k_blob[0] ^ 0x01]) + entry.k_blob[1:]
+    with pytest.raises(HostPageCorrupt):
+        pool.get(toks, 0)
+    assert pool.stats()["quarantined"] == 1
+    assert pool.get(toks, 0) is None  # gone, a plain miss now
+    pool.quarantine(key)  # idempotent on a missing key
+    assert pool.stats()["quarantined"] == 1
+
+
+def test_pool_injected_corruption_quarantines():
+    pool = HostPagePool(max_bytes=1 << 20, page_size=4)
+    toks = list(range(1, 9))
+    pool.put(toks, 0, _page(0), _page(1))
+    with faults.injected(faults.FaultSpec(faults.HOST_TIER, "nan",
+                                          match={"op": "promote"})):
+        with pytest.raises(HostPageCorrupt):
+            pool.get(toks, 0)
+    assert pool.stats()["quarantined"] == 1
+    with faults.injected(faults.FaultSpec(faults.HOST_TIER, "error",
+                                          match={"op": "demote"})):
+        with pytest.raises(OSError):
+            pool.put(toks, 1, _page(2), _page(3))
+    assert not pool.contains(toks, 2)  # the faulted demote stored nothing
+
+
+# ---- engine level ----------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lm():
+    """Tiny LM + greedy references over prompts sharing a 14-token system
+    prefix (3 full pages at page_size=4)."""
+    spec = models.get_model("transformer_lm", seq_len=64, vocab=VOCAB,
+                            d_model=32, d_inner=64, num_heads=4, n_layers=2)
+    cfg = spec.extra["cfg"]
+    rng = np.random.RandomState(11)
+    variables = spec.model.init(0, *spec.synth_batch(2, rng))
+    sys_prefix = rng.randint(1, VOCAB, size=(14,)).astype(np.int32)
+    cases = []
+    for _ in range(5):
+        tail = rng.randint(1, VOCAB,
+                           size=(int(rng.randint(2, 8)),)).astype(np.int32)
+        prompt = np.concatenate([sys_prefix, tail])
+        n = int(rng.randint(6, 12))
+        ref = np.asarray(generate(variables, jnp.asarray(prompt[None]),
+                                  n, cfg))[0]
+        cases.append((prompt, n, ref))
+    return types.SimpleNamespace(cfg=cfg, variables=variables, cases=cases)
+
+
+def _engine(lm, label="e", pool=None, **over):
+    kw = dict(DC)
+    kw.update(over)
+    return DecodeEngine(lm.variables, lm.cfg,
+                        config=ServingConfig(engine_label=label),
+                        decode=DecodeConfig(**kw), host_tier=pool)
+
+
+def _serve(eng, cases):
+    handles = [eng.submit(p, n) for p, n, _ in cases]
+    outs = [h.result(timeout=300) for h in handles]
+    for (prompt, n, ref), out in zip(cases, outs):
+        assert np.array_equal(out.tokens, ref), (
+            f"diverged for Tp={len(prompt)} N={n}")
+
+
+def test_write_through_demote_and_dedup(lm):
+    pool = HostPagePool(max_bytes=1 << 20, page_size=DC["page_size"])
+    eng = _engine(lm, pool=pool)
+    try:
+        _serve(eng, lm.cases)
+        snap = eng.metrics.snapshot()
+        assert snap["host_demoted_pages_total"] > 0
+        # every case's shared 3-page system prefix demotes ONCE (dedup)
+        sys_key_pages = 14 // DC["page_size"]
+        assert pool.contains(lm.cases[0][0], sys_key_pages)
+        assert pool.stats()["puts"] == snap["host_demoted_pages_total"]
+    finally:
+        eng.close()
+    eng.kv.assert_no_leaks()
+    # the pool outlives the engine — close() does not clear it
+    assert pool.num_pages > 0
+
+
+def test_kill_then_fresh_engine_repopulates_from_pool(lm):
+    """The crash-recovery rung: engine A demotes write-through, dies
+    abruptly (kill(): radix tree gone, HBM pages released). A fresh
+    engine over the SAME pool serves the same prompts token-exactly and
+    repopulates its radix tree by promotion instead of paying full
+    prefill for every request."""
+    pool = HostPagePool(max_bytes=1 << 20, page_size=DC["page_size"])
+    ea = _engine(lm, label="a", pool=pool)
+    try:
+        _serve(ea, lm.cases)
+    finally:
+        ea.kill()
+    ea.kv.assert_no_leaks()
+    demoted = pool.num_pages
+    assert demoted > 0  # kill() left the tier intact
+
+    eb = _engine(lm, label="b", pool=pool)
+    try:
+        _serve(eb, lm.cases)
+        snap = eb.metrics.snapshot()
+        assert snap["host_tier_hits_total"] > 0
+        assert snap["host_promoted_pages_total"] > 0
+        assert snap["host_quarantined_total"] == 0
+        # promoted pages entered the tree via the ownership handoff:
+        # after drain the tree's clear() returns every one of them
+    finally:
+        eb.close()
+    eb.kv.assert_no_leaks()
+
+
+def test_private_pool_promotes_after_tree_eviction(lm):
+    """DecodeConfig.host_tier_bytes builds a private pool. The radix
+    tree is capped to 4 pages: the 3-page shared system prefix stays
+    warm while every case's diverging deep page competes for the last
+    slot, so after the first round at most one case is fully resident.
+    Re-inferring each case then finds its deep page evicted from HBM but
+    warm in the host tier — the admission probe enqueues a promote and
+    the page re-enters the tree from host RAM, never re-prefilled."""
+    eng = _engine(lm, host_tier_bytes=1 << 20, prefix_cache_pages=4)
+    try:
+        assert eng.host_tier is not None
+        _serve(eng, lm.cases)
+        for prompt, n, ref in lm.cases:
+            out = eng.infer(prompt, n)
+            assert np.array_equal(out.tokens, ref)
+        snap = eng.metrics.snapshot()
+        assert snap["host_demoted_pages_total"] > 0
+        assert snap["host_tier_hits_total"] > 0
+        assert snap["host_promoted_pages_total"] > 0
+    finally:
+        eng.close()
+    eng.kv.assert_no_leaks()
+
+
+def test_corrupt_on_promote_quarantines_and_stays_exact(lm):
+    """Every promote read is corrupted (injected bit flip before CRC
+    verify): the pages are quarantined, never implanted, and every
+    request still completes token-exactly via ordinary prefill."""
+    pool = HostPagePool(max_bytes=1 << 20, page_size=DC["page_size"])
+    ea = _engine(lm, label="ca", pool=pool)
+    try:
+        _serve(ea, lm.cases)
+    finally:
+        ea.kill()
+    eb = _engine(lm, label="cb", pool=pool)
+    try:
+        with faults.injected(faults.FaultSpec(
+                faults.HOST_TIER, "nan", times=10 ** 9,
+                match={"op": "promote"})):
+            _serve(eb, lm.cases)
+        snap = eb.metrics.snapshot()
+        assert snap["host_quarantined_total"] > 0
+        assert snap["host_promoted_pages_total"] == 0
+    finally:
+        eb.close()
+    eb.kv.assert_no_leaks()
+
+
+def test_promote_refcount_ownership_handoff(lm):
+    """After promotion the tree is the page's only owner (refcount 1 from
+    insert; the loader's alloc ref was dropped) — drain then proves no
+    promoted page leaks."""
+    pool = HostPagePool(max_bytes=1 << 20, page_size=DC["page_size"])
+    ea = _engine(lm, label="ra", pool=pool)
+    try:
+        _serve(ea, lm.cases)
+    finally:
+        ea.kill()
+    eb = _engine(lm, label="rb", pool=pool)
+    try:
+        _serve(eb, lm.cases)
+        assert eb.metrics.snapshot()["host_promoted_pages_total"] > 0
+        # quiesce: with no live slots every allocated page must be
+        # tree-owned with refcount exactly 1
+        deadline = time.monotonic() + 10
+        while eb.load() > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        refs = eb.kv.allocator.refcounts()
+        held = [r for r in refs[1:] if r > 0]  # skip scratch
+        assert held and all(r == 1 for r in held)
+        assert len(held) == eb.prefix.num_pages
+    finally:
+        eb.close()
+    eb.kv.assert_no_leaks()
+
+
+def test_config_validation(lm):
+    with pytest.raises(Exception):
+        _engine(lm, host_tier_bytes=1 << 20, prefix_cache=False)
+    with pytest.raises(Exception):
+        pool = HostPagePool(max_bytes=1 << 20, page_size=8)  # wrong geometry
+        _engine(lm, pool=pool)
+
+
+# ---- prefix-aware routing --------------------------------------------------
+
+
+def test_prefix_aware_fleet_routing(lm):
+    """Warm engine B with one prompt; the fleet then routes that prompt
+    (and its siblings sharing the system prefix) to B by digest match,
+    while a prefix-less prompt still load-balances."""
+    ea = _engine(lm, label="ra0", prefix_digest=True)
+    eb = _engine(lm, label="rb1", prefix_digest=True)
+    fleet = DecodeFleet([ea, eb])
+    try:
+        prompt, n, ref = lm.cases[0]
+        out = eb.infer(prompt, n)  # warm B directly
+        assert np.array_equal(out.tokens, ref)
+        # digest publication runs on B's loop thread; poll briefly
+        deadline = time.monotonic() + 5
+        while not eb.prefix_digest() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        digs = prefix_digests(prompt, DC["page_size"])
+        assert eb.prefix_match_depth(digs) >= 3  # the 3-page system prefix
+        assert ea.prefix_match_depth(digs) == 0
+        # equal load, so only the digest can break the tie toward B
+        assert fleet._pick(prompt=prompt) is eb
+        for p, _, _ in lm.cases[1:]:
+            assert fleet._pick(prompt=p) is eb  # shared system prefix
+        # no cached prefix anywhere: falls back to stable least-loaded
+        cold = np.asarray([90, 91, 92, 93, 94, 95, 96, 90], np.int32)
+        assert fleet._pick(prompt=cold) is ea
+        # end-to-end: submit routes to B and stays exact
+        out = fleet.submit(prompt, n).result(timeout=300)
+        assert np.array_equal(out.tokens, ref)
+        assert ea.metrics.snapshot()["requests_total"] == 0
+    finally:
+        fleet.close(timeout=60)
+    ea.kv.assert_no_leaks()
+    eb.kv.assert_no_leaks()

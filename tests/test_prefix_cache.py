@@ -251,6 +251,36 @@ def test_prefix_cow_fires_and_stays_exact(lm):
     eng.kv.assert_no_leaks()
 
 
+def test_promote_races_concurrent_evict_stays_exact(lm):
+    """Hierarchical-KV regression: a host-tier promote job enqueued at
+    admission can be STALE by the time the loop applies it — the tree
+    meanwhile grew past it (another request prefilled the prefix) or
+    shrank under it (size-cap trim / allocator-pressure eviction). Storm
+    shared-prefix traffic over a starved pool with a 4-page tree cap and
+    a private host tier so both stale shapes occur, and pin the
+    contract: outputs stay token-exact, the apply-side re-check never
+    double-inserts (every refcounted page drains clean), and the engine
+    quiesces rather than promote-evict livelocking."""
+    kw = dict(DC, prefix_cache_pages=4, host_tier_bytes=1 << 20)
+    kw.pop("spec_tokens")  # host tier requires a draft-free engine
+    eng = DecodeEngine(lm.variables, lm.cfg, decode=DecodeConfig(**kw))
+    try:
+        for _ in range(3):
+            handles = [eng.submit(p, n) for p, n, _ in lm.cases]
+            outs = [h.result(timeout=300) for h in handles]
+            for (prompt, n, ref), out in zip(lm.cases, outs):
+                assert np.array_equal(out.tokens, ref), (
+                    f"promote/evict race corrupted decode for "
+                    f"Tp={len(prompt)} N={n}")
+        snap = eng.metrics.snapshot()
+        assert snap["host_demoted_pages_total"] > 0
+        assert snap["host_tier_hits_total"] > 0
+        assert snap["preempted_total"] >= 1  # the pool really was starved
+    finally:
+        eng.close()
+    eng.kv.assert_no_leaks()
+
+
 def test_migration_mid_speculation_refcounts_clean(lm):
     """Engine A dies mid-speculation (DECODE_STEP faults every verify
     iteration until its breaker trips): the fleet migrates every live

@@ -954,6 +954,161 @@ def _disagg_phase(work: str, seed: int) -> None:
         e.kv.assert_no_leaks()
 
 
+def _host_tier_phase(work: str, seed: int) -> None:
+    """Hierarchical KV host tier under chaos (ISSUE 18):
+
+    1. a stalled demote (slow host memory) during a shared-system-prompt
+       storm changes nothing: every output token-exact, the stall never
+       wedges a lock or the decode loop;
+    2. an engine killed mid-traffic loses zero requests AND its
+       replacement repopulates its radix tree FROM THE HOST TIER: the
+       shared pool survives ``kill()``, journal replay resumes the
+       in-flight requests, and the replacement serves them with
+       promoted pages (host hits), token-exact, no page leaks anywhere;
+    3. a corrupted host page at promote time (bit flip before the CRC
+       check) is quarantined — never implanted — and the affected
+       requests still complete token-exact via ordinary re-prefill.
+    """
+    import jax.numpy as jnp
+    from paddle_tpu import models
+    from paddle_tpu.models.transformer_lm import generate
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import (
+        DecodeConfig,
+        DecodeEngine,
+        DecodeFleet,
+        HostPagePool,
+        replay_journal,
+        resume_incomplete,
+    )
+
+    rng = np.random.RandomState(seed + 18)
+    spec = models.get_model("transformer_lm", seq_len=64, vocab=97,
+                            d_model=32, d_inner=64, num_heads=4, n_layers=2)
+    cfg = spec.extra["cfg"]
+    variables = spec.model.init(0, *spec.synth_batch(2, rng))
+
+    pool = HostPagePool(max_bytes=1 << 20, page_size=4)
+
+    def mk_engine(**over):
+        kw = dict(max_slots=3, page_size=4, max_context=40, prefill_chunk=8,
+                  num_pages=30, prefix_cache=True, prefix_digest=True,
+                  recovery_base_delay_s=0.001, recovery_max_delay_s=0.005)
+        kw.update(over)
+        return DecodeEngine(variables, cfg, decode=DecodeConfig(**kw),
+                            host_tier=pool)
+
+    # the shared-system-prompt storm: every prompt opens with the same
+    # 14-token prefix (3 full pages), the tier's natural working set
+    sys_prefix = rng.randint(1, 97, size=(14,)).astype(np.int32)
+    cases = []
+    for _ in range(6):
+        tail = rng.randint(1, 97,
+                           size=(int(rng.randint(2, 8)),)).astype(np.int32)
+        p = np.concatenate([sys_prefix, tail])
+        n = int(rng.randint(8, 14))
+        ref = np.asarray(generate(variables, jnp.asarray(p[None]), n, cfg))[0]
+        cases.append((p, n, ref))
+    by_prompt = {tuple(p.tolist()): ref for p, _, ref in cases}
+
+    wal = os.path.join(work, "host_tier.wal")
+    ea = mk_engine(journal_path=wal)
+    eb = mk_engine()
+    fleet = DecodeFleet([ea, eb])
+    a2 = ec = None
+    try:
+        # leg 1: storm round with demotes STALLING (slow host memory) —
+        # the tier is strictly best-effort, so nothing may change
+        with _inject(
+            faults.FaultSpec(faults.HOST_TIER, "stall", stall_s=0.05,
+                             times=2, match={"op": "demote"}),
+            seed=seed,
+        ) as plan:
+            outs = [fleet.submit(p, n).result(timeout=300)
+                    for p, n, _ in cases]
+            check(plan.all_fired(),
+                  f"demote stalls never fired: {plan.stats()}")
+        for (_, _, ref), out in zip(cases, outs):
+            check(np.array_equal(out.tokens, ref),
+                  "storm output not token-exact under stalled demotes")
+        check(pool.num_pages > 0, "storm demoted nothing into the tier")
+
+        # leg 2: kill one engine mid-traffic. Its handles fail typed (a
+        # crash is a crash), but zero requests are LOST: journal replay
+        # resumes every in-flight one on a replacement engine that warms
+        # its empty radix tree from the host tier instead of re-paying
+        # full prefill for the storm's shared prefix.
+        handles = [ea.submit(p, n) for p, n, _ in cases]
+        ea.kill()
+        failed = 0
+        for h, (_, _, ref) in zip(handles, cases):
+            try:
+                out = h.result(timeout=10)
+                check(np.array_equal(out.tokens, ref),
+                      "pre-kill completion not token-exact")
+            except Exception:
+                failed += 1
+        check(failed >= 1, "kill() interrupted nothing — phase too slow")
+        check(pool.num_pages > 0, "kill() wiped the host tier")
+        a2 = mk_engine()
+        rep = replay_journal(wal)
+        resumed = resume_incomplete(a2, wal)
+        # every resumed request failed its handle, but the converse has a
+        # benign window: _finish writes the fin record BEFORE resolving
+        # the handle, so a kill() landing between the two fails a handle
+        # whose request the journal already marks finished
+        check(1 <= len(resumed) <= failed,
+              f"resumed {len(resumed)} vs {failed} failed in-flight")
+        for rid, (rh, n_delivered) in resumed.items():
+            out = rh.result(timeout=300)
+            ref = by_prompt[tuple(rep[rid].prompt.tolist())]
+            check(np.array_equal(out.tokens, ref),
+                  f"request {rid} not token-exact after the crash")
+        snap = a2.metrics.snapshot()
+        check(snap["host_tier_hits_total"] > 0,
+              f"replacement engine never probed the tier: {snap}")
+        check(snap["host_promoted_pages_total"] > 0,
+              f"replacement engine re-prefilled instead of promoting: "
+              f"{snap}")
+        print(f"[chaos] host_tier: killed an engine mid-storm, resumed "
+              f"{failed} in-flight requests token-exact, replacement "
+              f"promoted {snap['host_promoted_pages_total']} pages from "
+              f"the host tier")
+
+        # leg 3: corrupt-on-promote — a bit-flipped host page must be
+        # quarantined by the CRC check, never implanted, and the
+        # requests re-prefill token-exactly
+        ec = mk_engine()
+        with _inject(
+            faults.FaultSpec(faults.HOST_TIER, "nan", times=2,
+                             match={"op": "promote"}),
+            seed=seed,
+        ) as plan:
+            outs = [ec.submit(p, n) for p, n, _ in cases]
+            outs = [h.result(timeout=300) for h in outs]
+            check(plan.all_fired(),
+                  f"promote corruptions never fired: {plan.stats()}")
+        for (_, _, ref), out in zip(cases, outs):
+            check(np.array_equal(out.tokens, ref),
+                  "output not token-exact after a corrupted promote")
+        snap = ec.metrics.snapshot()
+        check(snap["host_quarantined_total"] == 2,
+              f"corrupted pages not quarantined: {snap}")
+        check(pool.stats()["quarantined"] == 2,
+              f"pool quarantine counter wrong: {pool.stats()}")
+        print(f"[chaos] host_tier: {snap['host_quarantined_total']} "
+              f"corrupted host pages quarantined, every request "
+              f"token-exact via re-prefill")
+    finally:
+        fleet.close(timeout=60)
+        for e in (a2, ec):
+            if e is not None:
+                e.close()
+    for e in (ea, eb, a2, ec):
+        if e is not None:
+            e.kv.assert_no_leaks()
+
+
 def _shardgroup_phase(work: str, seed: int) -> None:
     """Tensor-parallel replica groups under chaos (ISSUE 16):
 
@@ -1296,6 +1451,8 @@ def main(argv=None) -> int:
         _deadlock_canary("spec_decode")
         _disagg_phase(work, args.seed)
         _deadlock_canary("disagg")
+        _host_tier_phase(work, args.seed)
+        _deadlock_canary("host_tier")
         _shardgroup_phase(work, args.seed)
         _deadlock_canary("shardgroup")
         _overload_phase(work, args.seed)
