@@ -25,7 +25,13 @@ rules; this one encodes them:
   ``start_trace``/``record_span`` call sites follow the same dotted
   lowercase convention (``serving.execute``): the merged Chrome-trace
   export and ``phase_totals`` group timeline rows by that prefix, and a
-  free-form name fragments the timeline.
+  free-form name fragments the timeline. The fleet-observability
+  families (``serving.fleet.*``, ``flight_recorder.*``) ride the same
+  rule — the ``/fleet`` and ``/trace/<id>`` views group by it;
+* ``fleet-metric-kind`` — ``serving.fleet.*`` families are *recomputed*
+  on every ``FleetView.rollup()`` and must be published with
+  ``set_gauge``: an ``inc_counter``/``observe`` there accumulates across
+  rollup calls and silently double-counts the fleet.
 
 Runnable as ``python -m paddle_tpu.analysis`` and over the whole tree in
 ``tests/test_source_lint.py`` (so the gate rides tier-1). Suppress a
@@ -73,6 +79,11 @@ _METRIC_PREFIX_RE = re.compile(r"^[a-z][a-z0-9_]*\.[a-z0-9_.]*$")
 # naming convention matches metrics (dotted lowercase) so the Chrome-trace
 # export and phase_totals() group rows by subsystem prefix
 _SPAN_FNS = ("record_event", "start_span", "start_trace", "record_span")
+
+# fleet rollup families are recomputed (not accumulated) every
+# FleetView.rollup() — only set_gauge may publish them
+_FLEET_PREFIX = "serving.fleet."
+_FLEET_GAUGE_ONLY_FNS = ("inc_counter", "observe")
 
 
 def default_roots() -> List[str]:
@@ -284,6 +295,16 @@ class _Linter(ast.NodeVisitor):
                     f"metric name {arg0.value!r} is not dotted "
                     "subsystem.snake_case (e.g. 'trainer.steps_total'); "
                     "un-prefixed names land outside every dashboard query",
+                    node,
+                )
+            elif (arg0.value.startswith(_FLEET_PREFIX)
+                  and chain.rsplit(".", 1)[-1] in _FLEET_GAUGE_ONLY_FNS):
+                self._diag(
+                    "fleet-metric-kind",
+                    f"{arg0.value!r} is a fleet rollup family: it is "
+                    "recomputed on every FleetView.rollup(), so it must be "
+                    "published with set_gauge — a counter/histogram here "
+                    "double-counts the fleet on every rollup call",
                     node,
                 )
         elif isinstance(arg0, ast.JoinedStr):

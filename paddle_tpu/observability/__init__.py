@@ -10,7 +10,14 @@ Four pieces, layered on the counter/gauge bridge in ``core.profiler``:
   FLOPs vs. per-device peak, plus goodput/badput accounting;
 - :mod:`~paddle_tpu.observability.exporter` — stdlib Prometheus
   ``/metrics`` + ``/healthz`` HTTP endpoint, plus ``/runlog/tail?n=`` and
-  ``/trace`` debug endpoints (last runlog events / merged Chrome trace).
+  ``/trace`` debug endpoints (last runlog events / merged Chrome trace);
+- :mod:`~paddle_tpu.observability.fleet` — fleet-scope rollup of
+  per-engine serving telemetry (``serving.fleet.*`` families, ``/fleet``
+  endpoint) and cross-engine trace reconstruction (``/trace/<id>``);
+- :mod:`~paddle_tpu.observability.flight_recorder` — post-mortem bundle
+  writer: on breaker trip / engine fault / chaos ``kill()``, dumps span
+  + runlog + alert tails, held locks, KV refcounts, and breaker/host-tier
+  state to a bounded directory of JSON bundles.
 
 Cross-cutting: when :mod:`paddle_tpu.tracing` is imported, every runlog
 event emitted inside an active span carries ``trace_id``/``span_id``
@@ -33,8 +40,17 @@ import threading
 from typing import Optional
 
 from paddle_tpu.core import locks
-from paddle_tpu.observability import exporter, metrics, mfu, runlog
+from paddle_tpu.observability import (
+    exporter,
+    fleet,
+    flight_recorder,
+    metrics,
+    mfu,
+    runlog,
+)
 from paddle_tpu.observability.exporter import MetricsServer, render_text
+from paddle_tpu.observability.fleet import FleetView
+from paddle_tpu.observability.flight_recorder import FlightRecorder
 from paddle_tpu.observability.metrics import (
     MetricRegistry,
     default_registry,
@@ -53,6 +69,10 @@ __all__ = [
     "runlog",
     "mfu",
     "exporter",
+    "fleet",
+    "flight_recorder",
+    "FleetView",
+    "FlightRecorder",
     "MetricRegistry",
     "MetricsServer",
     "GoodputTracker",

@@ -248,6 +248,14 @@ class _Handler(BaseHTTPRequestHandler):
             body, status = self._trace()
             self.send_response(status)
             self.send_header("Content-Type", JSON_CONTENT_TYPE)
+        elif path.startswith("/trace/"):
+            body, status = self._trace_by_id(path[len("/trace/"):])
+            self.send_response(status)
+            self.send_header("Content-Type", JSON_CONTENT_TYPE)
+        elif path == "/fleet":
+            body, status = self._fleet()
+            self.send_response(status)
+            self.send_header("Content-Type", JSON_CONTENT_TYPE)
         elif path == "/alerts":
             body, status = self._alerts(query)
             self.send_response(status)
@@ -377,6 +385,43 @@ class _Handler(BaseHTTPRequestHandler):
             return (json.dumps({"error": repr(e)}).encode() + b"\n", 500)
         return json.dumps(doc).encode() + b"\n", 200
 
+    @staticmethod
+    def _trace_by_id(trace_id: str) -> Tuple[bytes, int]:
+        """One request's cross-engine timeline: all spans for the trace
+        id, the engine hop order, ``validate_trace(multi_engine=True)``
+        problems (empty = no orphans), and correlated runlog events."""
+        from paddle_tpu.observability import fleet as _fleet
+
+        if not re.fullmatch(r"[0-9a-f]{32}", trace_id):
+            return (json.dumps(
+                {"error": "trace id must be 32 lowercase hex chars"}
+            ).encode() + b"\n", 400)
+        try:
+            doc = _fleet.trace_doc(trace_id)
+        except Exception as e:  # never take the exporter down with tracing
+            return (json.dumps({"error": repr(e)}).encode() + b"\n", 500)
+        if not doc["spans"] and not doc["events"]:
+            return (json.dumps({"error": "unknown trace id",
+                                "trace_id": trace_id}).encode() + b"\n", 404)
+        return json.dumps(doc).encode() + b"\n", 200
+
+    @staticmethod
+    def _fleet() -> Tuple[bytes, int]:
+        """Merged fleet rollups from every installed
+        :class:`~paddle_tpu.observability.fleet.FleetView` — the
+        ``serving.fleet.*`` numbers plus per-engine snapshots."""
+        from paddle_tpu.observability import fleet as _fleet
+
+        try:
+            views = _fleet.installed_views()
+            doc = [v.doc() for v in views]
+        except Exception as e:  # never take the exporter down with serving
+            return (json.dumps({"error": repr(e)}).encode() + b"\n", 500)
+        if not doc:
+            return (json.dumps({"error": "no fleet views installed"}
+                               ).encode() + b"\n", 404)
+        return json.dumps(doc).encode() + b"\n", 200
+
     def log_message(self, fmt, *args):  # quiet: route through framework log
         ptlog.vlog(2, "metrics exporter: " + fmt, *args)
 
@@ -389,8 +434,12 @@ class MetricsServer:
     the ``paddle_tpu.watch`` hub), ``/slo`` (installed SLO engines'
     current compliance/burn-rate status), ``/tenants`` (installed
     serving admission controllers' per-tenant quotas, queue depths, and
-    shed/brownout state), and ``/locks`` (the ``core.locks`` held-locks
-    registry, lock-order graph, and any recorded order violations)."""
+    shed/brownout state), ``/locks`` (the ``core.locks`` held-locks
+    registry, lock-order graph, and any recorded order violations),
+    ``/fleet`` (installed ``FleetView`` rollups: merged
+    ``serving.fleet.*`` numbers plus per-engine snapshots), and
+    ``/trace/<trace_id>`` (one request's cross-engine span timeline,
+    hop order, validation problems, and correlated runlog events)."""
 
     def __init__(self, registry: Optional[obs_metrics.MetricRegistry] = None,
                  host: str = "127.0.0.1", port: int = 0):

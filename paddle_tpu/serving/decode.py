@@ -949,7 +949,10 @@ class DecodeEngine:
     def _j_admit(self, req: _DecodeRequest) -> None:
         if self._journal is not None and req.rid is not None:
             self._journal.log_admit(req.rid, req.prompt, req.mnt,
-                                    req.generated, req.tenant, req.cls)
+                                    req.generated, req.tenant, req.cls,
+                                    trace=(req.trace.to_traceparent()
+                                           if req.trace is not None
+                                           else None))
             self.metrics.record_journal_records(1)
 
     def _j_tok(self, req: _DecodeRequest, tok: int) -> None:
@@ -1159,7 +1162,8 @@ class DecodeEngine:
                 if req.trace is not None:
                     tracing.record_span(
                         "serving.decode.queue_wait", req.t_enqueue_pc,
-                        req.t_admit_pc, parent=req.trace)
+                        req.t_admit_pc, parent=req.trace,
+                        engine=self.metrics.engine_label)
 
     def _admit_handoffs(self) -> None:
         """Admit handed-off requests (serving.disagg): implant the
@@ -1184,6 +1188,7 @@ class DecodeEngine:
                 return
             req.slot = slot
             n_pages = -(-int(payload.cur_len) // dconf.page_size)
+            t0_adopt = time.perf_counter()
             ok = False
             # a draft model keeps its own page arrays, which the payload
             # does not carry — re-prefill fills both caches correctly.
@@ -1240,6 +1245,11 @@ class DecodeEngine:
             self._active.append(req)
             self.metrics.record_handoff_in()
             self.metrics.record_slot_admit()
+            if req.trace is not None:
+                tracing.record_span(
+                    "serving.handoff.adopt", t0_adopt, time.perf_counter(),
+                    parent=req.trace, engine=self.metrics.engine_label,
+                    from_engine=payload.src, pages=n_pages, rid=req.rid)
             runlog.emit("handoff_adopted", rid=req.rid,
                         from_engine=payload.src, pages=n_pages,
                         engine=self.metrics.engine_label)
@@ -1272,7 +1282,7 @@ class DecodeEngine:
         # (token-exact regardless of promotion timing).
         if (self._host_tier is not None and m < max_pages
                 and self._host_tier.contains(req.seq, m + 1)):
-            self._host_request_promote(req.seq, max_pages)
+            self._host_request_promote(req.seq, max_pages, trace=req.trace)
         while m > 0:
             c0 = (m * ps) // C
             lo = (c0 * C) // ps  # first logical page the next chunk touches
@@ -1323,6 +1333,7 @@ class DecodeEngine:
         pages = self._kv.slot_pages(req.slot)[:n_full]
         wrote = 0
         bp = 0
+        t0_demote = time.perf_counter()
         try:
             for i, p in enumerate(pages):
                 if self._host_tier.contains(req.seq, i + 1):
@@ -1344,23 +1355,31 @@ class DecodeEngine:
                           "HBM-only", e)
         if wrote:
             self.metrics.record_host_demote(wrote)
+            if req.trace is not None:
+                tracing.record_span(
+                    "serving.host_tier.demote", t0_demote,
+                    time.perf_counter(), parent=req.trace,
+                    engine=self.metrics.engine_label, pages=wrote)
         if bp:
             self.metrics.record_host_backpressure(bp)
         self.metrics.set_host_tier_bytes(self._host_tier.bytes_used,
                                          self._host_tier.max_bytes)
 
-    def _host_request_promote(self, seq: np.ndarray, want_pages: int) -> None:
+    def _host_request_promote(self, seq: np.ndarray, want_pages: int,
+                              trace=None) -> None:
         """Enqueue an async promote of this prefix up to ``want_pages``
         pages; dedup by prefix digest so a storm of same-prefix requests
         enqueues one job. The hit is counted HERE (the routing-visible
-        event), not at apply time."""
+        event), not at apply time. ``trace`` is the enqueueing request's
+        span context — the applied promote parents its span there, so the
+        fleet trace shows which request warmed the prefix."""
         ps = self.decode_config.page_size
         toks = np.asarray(seq[:want_pages * ps], np.int32)
         key = zlib.crc32(toks.tobytes()) & 0xFFFFFFFF
         if key in self._promote_keys:
             return
         self._promote_keys.add(key)
-        self._promote_jobs.append((key, toks, want_pages))
+        self._promote_jobs.append((key, toks, want_pages, trace))
         self.metrics.record_host_hit()
 
     def _apply_promotes(self) -> bool:
@@ -1385,7 +1404,7 @@ class DecodeEngine:
         budget = self.decode_config.host_promote_pages_per_iter
         did = False
         while budget > 0 and self._promote_jobs:
-            key, toks, want = self._promote_jobs.popleft()
+            key, toks, want, job_trace = self._promote_jobs.popleft()
             if self._prefix.max_pages is not None:
                 # promoting past the tree's own size cap is wasted motion:
                 # the insert would be trimmed right back out
@@ -1429,14 +1448,20 @@ class DecodeEngine:
             self._kv.allocator.free([page])  # hand ownership to the tree
             budget -= 1
             did = True
-            self.metrics.record_host_promote(time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            self.metrics.record_host_promote(t1 - t0)
+            parent = job_trace if job_trace is not None else self._loop_trace
+            if parent is not None:
+                tracing.record_span(
+                    "serving.host_tier.promote", t0, t1, parent=parent,
+                    engine=self.metrics.engine_label, page=d)
             # progress guard: the insert can be trimmed straight back out
             # (size-cap eviction, allocator pressure). Re-enqueue only on
             # real depth growth — otherwise a capped tree and a warm pool
             # would promote-evict-promote forever and the loop never idles
             nd = len(self._prefix.peek(toks, want))
             if d < nd < want and self._host_tier.contains(toks, nd + 1):
-                self._promote_jobs.append((key, toks, want))
+                self._promote_jobs.append((key, toks, want, job_trace))
             else:
                 self._promote_keys.discard(key)
         if did:
@@ -1580,7 +1605,8 @@ class DecodeEngine:
             self.cost.observe_chunk(t1 - t0)
             if req.trace is not None:
                 tracing.record_span("serving.decode.prefill", t0, t1,
-                                    parent=req.trace, chunk=c)
+                                    parent=req.trace, chunk=c,
+                                    engine=self.metrics.engine_label)
             req.chunks_done = c + 1
             self._kv.seq_lens[req.slot] = min(chunk_end, len(req.seq))
             budget -= 1
@@ -1800,6 +1826,18 @@ class DecodeEngine:
         breakers and spends half-open probes to re-admit."""
         return self._breaker
 
+    def _flight_dump(self, reason: str) -> None:
+        """Best-effort post-mortem hook: when a FlightRecorder is
+        installed, dump a bundle capturing this engine's terminal state
+        (span/runlog tails, held locks, page refcounts, breaker and
+        host-tier snapshots). Never raises — observability must not
+        alter the failure path it is recording."""
+        try:
+            from paddle_tpu.observability import flight_recorder as fr
+            fr.maybe_dump(reason, engine=self)
+        except Exception as e:
+            ptlog.warning("flight-recorder dump failed: %r", e)
+
     def _note_step_ok(self) -> None:
         """A clean decode iteration: the device is serving again."""
         if not self._consec_faults and not self._breaker_dirty:
@@ -1868,6 +1906,8 @@ class DecodeEngine:
         self.metrics.set_consecutive_faults(self._consec_faults)
         self._breaker_dirty = True
         tripped = self._breaker.record_failure()
+        if tripped:
+            self._flight_dump("engine_fault")
         runlog.emit("decode_step_error", error=repr(exc), recovering=True,
                     consecutive=self._consec_faults, tripped=tripped,
                     engine=self.metrics.engine_label)
@@ -2012,6 +2052,7 @@ class DecodeEngine:
         every live request to the rescue sink for adoption elsewhere."""
         self._breaker.trip()
         self._breaker_dirty = True
+        self._flight_dump("breaker_trip")
         packets = self._drain_packets()
         runlog.emit("engine_unhealthy", engine=self.metrics.engine_label,
                     error=repr(exc), in_flight=len(packets),
@@ -2036,6 +2077,7 @@ class DecodeEngine:
         the migration. Returns the (possibly fresh) handle."""
         if self._closed:
             raise EngineClosedError("engine is closed")
+        t0_rescue = time.perf_counter()
         prompt = np.asarray(packet.prompt, np.int32).reshape(-1)
         req = _DecodeRequest(
             prompt, int(packet.mnt),
@@ -2073,6 +2115,12 @@ class DecodeEngine:
             return req.handle
         self._j_admit(req)
         self.metrics.record_submit()
+        if req.trace is not None:
+            tracing.record_span(
+                "serving.rescue", t0_rescue, time.perf_counter(),
+                parent=req.trace, engine=self.metrics.engine_label,
+                from_engine=from_engine, rid=req.rid,
+                generated=len(req.generated))
         if from_engine is not None:
             runlog.emit(
                 "request_migrated", rid=req.rid, from_engine=from_engine,
@@ -2209,6 +2257,9 @@ class DecodeEngine:
         # particular no fin records for in-flight requests)
         journal, self._journal = self._journal, None
         self._killed = True
+        # post-mortem first, while slots/refcounts still show the crash
+        # state the bundle exists to explain
+        self._flight_dump("kill")
         self._queue.close()
         self._thread.join(5.0)
         if journal is not None and self._journal_owned:

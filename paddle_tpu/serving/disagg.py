@@ -49,6 +49,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from paddle_tpu import tracing
 from paddle_tpu.core import logging as ptlog
 from paddle_tpu.core import profiler as prof
 from paddle_tpu.core.enforce import enforce, enforce_in
@@ -86,6 +87,19 @@ class HandoffCorrupt(RuntimeError):
     the request re-prefills from its journaled host state instead."""
 
 
+def _trace_from_header(header: Optional[str]):
+    """Wire traceparent -> SpanContext. Version-tolerant on both axes:
+    an absent key (old writer) and a malformed value both decode to None
+    — trace context is advisory and must never fail an otherwise-valid
+    handoff."""
+    if not header:
+        return None
+    try:
+        return tracing.SpanContext.from_traceparent(header)
+    except Exception:
+        return None
+
+
 @dataclasses.dataclass
 class HandoffPayload:
     """One prefilled request in transit between workers: host-side
@@ -93,9 +107,13 @@ class HandoffPayload:
     the prefill worker produced. ``cur_len`` positions are covered by the
     pages; ``last_tok`` (= ``generated[-1]``) is the token whose KV write
     is still pending — exactly the mid-decode state the adopting engine's
-    step loop expects. ``handle``/``trace`` are process-local and never
-    serialized; :meth:`from_bytes` leaves them None for the caller to
-    re-attach."""
+    step loop expects. ``handle`` is process-local and never serialized;
+    :meth:`from_bytes` leaves it None for the caller to re-attach.
+    ``trace`` (a :class:`~paddle_tpu.tracing.SpanContext`) DOES ride the
+    wire — as a W3C traceparent string inside the CRC'd header — so the
+    adopting worker's spans parent under the original request trace
+    across processes. Decode is version-tolerant: a payload without the
+    key (pre-fleet-observability writer) adopts with ``trace=None``."""
 
     rid: str
     prompt: np.ndarray
@@ -146,6 +164,8 @@ class HandoffPayload:
             "n_preemptions": int(self.n_preemptions),
             "src": self.src,
             "tp_degree": int(self.tp_degree),
+            "trace": (self.trace.to_traceparent()
+                      if self.trace is not None else None),
             "n_pages": len(self.k_pages),
             "shape": shape,
             "dtype": dtype,
@@ -212,6 +232,7 @@ class HandoffPayload:
             n_preemptions=int(h.get("n_preemptions", 0)),
             src=h.get("src", ""),
             tp_degree=int(h.get("tp_degree", 1)),
+            trace=_trace_from_header(h.get("trace")),
         )
 
     def to_rescue_packet(self) -> RescuePacket:
@@ -351,21 +372,24 @@ class DisaggRouter(DecodeFleet):
             self._journal.log_handoff(
                 payload.rid, payload.prompt, payload.mnt,
                 payload.generated, payload.tenant, payload.cls,
-                src=src.metrics.engine_label, dst=None)
+                src=src.metrics.engine_label, dst=None,
+                trace=(payload.trace.to_traceparent()
+                       if payload.trace is not None else None))
         dst = self._pick(exclude=src, candidates=self.workers(DECODE))
         if dst is None:
             raise EngineUnhealthy(
                 f"request {payload.rid}: no healthy decode-role worker "
                 f"to adopt the handoff")
+        t0_transfer = time.perf_counter()
         try:
             faults.inject(faults.DISAGG_HANDOFF, rid=payload.rid,
                           src=src.metrics.engine_label,
                           dst=dst.metrics.engine_label)
             if self.transport == "serialized":
                 recv = HandoffPayload.from_bytes(payload.to_bytes())
-                # handle/trace are process-local, never on the wire
+                # the handle is process-local, never on the wire; the
+                # trace context round-trips inside the CRC'd header
                 recv.handle = payload.handle
-                recv.trace = payload.trace
                 payload = recv
             dst.adopt_handoff(payload,
                               from_engine=src.metrics.engine_label)
@@ -398,6 +422,13 @@ class DisaggRouter(DecodeFleet):
                 ptlog.warning("handoff ack journaling failed: %r", e)
         self.handoffs_total += 1
         prof.inc_counter("serving.disagg.handoffs")
+        if payload.trace is not None:
+            tracing.record_span(
+                "serving.handoff.transfer", t0_transfer,
+                time.perf_counter(), parent=payload.trace,
+                engine=src.metrics.engine_label,
+                dst=dst.metrics.engine_label, rid=payload.rid,
+                transport=self.transport)
 
     # -- drain-and-convert -------------------------------------------------
 

@@ -209,16 +209,23 @@ class RequestJournal:
             pass  # journal closed mid-flight; close() already synced
 
     def log_admit(self, rid: str, prompt: np.ndarray, mnt: int,
-                  gen_prefix: List[int], tenant: str, cls: str) -> None:
+                  gen_prefix: List[int], tenant: str, cls: str,
+                  trace: Optional[str] = None) -> None:
         """Request accepted (or adopted with an already-generated prefix
         after migration/replay — ``gen_prefix`` keeps the journal
-        self-contained without rewriting token records)."""
-        self._append({
+        self-contained without rewriting token records). ``trace`` is the
+        request's W3C traceparent, journaled so a post-crash replay
+        resumes under the ORIGINAL trace id instead of minting a fresh
+        one — the fleet trace survives the process."""
+        rec = {
             "k": _J_ADMIT, "rid": rid,
             "prompt": [int(t) for t in np.asarray(prompt).reshape(-1)],
             "mnt": int(mnt), "gen": [int(t) for t in gen_prefix],
             "tenant": tenant, "cls": cls,
-        })
+        }
+        if trace is not None:
+            rec["tp"] = trace
+        self._append(rec)
 
     def log_token(self, rid: str, tok: int) -> None:
         self._append({"k": _J_TOK, "rid": rid, "t": int(tok)})
@@ -228,18 +235,23 @@ class RequestJournal:
 
     def log_handoff(self, rid: str, prompt: np.ndarray, mnt: int,
                     gen_prefix: List[int], tenant: str, cls: str,
-                    src: str, dst: Optional[str]) -> None:
+                    src: str, dst: Optional[str],
+                    trace: Optional[str] = None) -> None:
         """A prefill worker published this request's KV pages toward
         ``dst``. The record carries the full request snapshot (like an
         admit record) so replay of THIS journal alone can re-prefill an
         unacked handoff — durability does not depend on the source
-        worker surviving the transfer."""
-        self._append({
+        worker surviving the transfer. ``trace`` keeps the traceparent
+        durable alongside it (same contract as :meth:`log_admit`)."""
+        rec = {
             "k": _J_HOF, "rid": rid,
             "prompt": [int(t) for t in np.asarray(prompt).reshape(-1)],
             "mnt": int(mnt), "gen": [int(t) for t in gen_prefix],
             "tenant": tenant, "cls": cls, "src": src, "dst": dst,
-        })
+        }
+        if trace is not None:
+            rec["tp"] = trace
+        self._append(rec)
         self.flush()  # the handoff record must be durable before transfer
 
     def log_handoff_ack(self, rid: str, dst: str) -> None:
@@ -275,13 +287,16 @@ class RequestJournal:
                 for rid, rr in replayed.items():
                     if rr.finished:
                         continue
-                    f.write(_encode_record({
+                    snap = {
                         "k": _J_ADMIT, "rid": rid,
                         "prompt": [int(t) for t in rr.prompt],
                         "mnt": int(rr.mnt),
                         "gen": [int(t) for t in rr.generated],
                         "tenant": rr.tenant, "cls": rr.cls,
-                    }))
+                    }
+                    if rr.trace is not None:
+                        snap["tp"] = rr.trace
+                    f.write(_encode_record(snap))
                     kept += 1
                 f.flush()
                 os.fsync(f.fileno())  # lint: allow — rare, must be atomic vs appends
@@ -336,6 +351,7 @@ class ReplayedRequest:
     reason: Optional[str] = None
     handed_off: bool = False
     acked: bool = False
+    trace: Optional[str] = None  # W3C traceparent from the admit/hof record
 
 
 def replay_journal(path: str) -> Dict[str, ReplayedRequest]:
@@ -365,6 +381,7 @@ def replay_journal(path: str) -> Dict[str, ReplayedRequest]:
                     generated=[int(t) for t in rec.get("gen", [])],
                     tenant=rec.get("tenant", "default"),
                     cls=rec.get("cls", "interactive"),
+                    trace=rec.get("tp"),
                 )
             elif kind == _J_TOK and rid in out:
                 out[rid].generated.append(int(rec.get("t", 0)))
@@ -386,12 +403,26 @@ def replay_journal(path: str) -> Dict[str, ReplayedRequest]:
                 rr.generated = [int(t) for t in rec.get("gen", [])]
                 rr.handed_off = True
                 rr.acked = False
+                if rec.get("tp") is not None:
+                    rr.trace = rec.get("tp")
             elif kind == _J_ACK and rid in out:
                 out[rid].acked = True
     if n_bad:
         ptlog.warning("journal %s: stopped at a torn/corrupt record "
                       "(%d request(s) recovered before it)", path, len(out))
     return out
+
+
+def _trace_from_traceparent(tracing_mod, header: Optional[str]):
+    """Journaled traceparent -> SpanContext, or None when the record
+    predates trace journaling or carries a malformed header (a corrupt
+    trace must never block replay of an otherwise-valid request)."""
+    if not header:
+        return None
+    try:
+        return tracing_mod.SpanContext.from_traceparent(header)
+    except Exception:
+        return None
 
 
 def resume_incomplete(engine, path: str) -> Dict[str, Tuple[Any, int]]:
@@ -402,6 +433,8 @@ def resume_incomplete(engine, path: str) -> Dict[str, Tuple[Any, int]]:
     dedup contract: the resumed output's first ``n_delivered`` tokens are
     exactly the ones a client may already have received, so a delivery
     layer replays ``tokens[n_delivered:]`` only."""
+    from paddle_tpu import tracing
+
     replayed = replay_journal(path)
     out: Dict[str, Tuple[Any, int]] = {}
     for rid, rr in replayed.items():
@@ -411,6 +444,7 @@ def resume_incomplete(engine, path: str) -> Dict[str, Tuple[Any, int]]:
             rid=rid, prompt=rr.prompt, mnt=rr.mnt,
             generated=list(rr.generated), tenant=rr.tenant, cls=rr.cls,
             t_submit=time.monotonic(),
+            trace=_trace_from_traceparent(tracing, rr.trace),
         )
         handle = engine.adopt_rescue(packet)
         out[rid] = (handle, len(rr.generated))

@@ -374,11 +374,20 @@ def phase_totals(names: Iterable[str]) -> Dict[str, float]:
 _CONTAINMENT_SLACK_US = 500.0
 
 
-def validate_trace(trace_spans: List[Span]) -> List[str]:
+def validate_trace(trace_spans: List[Span],
+                   multi_engine: bool = False) -> List[str]:
     """Structural checks over one trace's spans. Returns a list of problem
     strings — empty means the trace reconstructs end-to-end: exactly one
     root, every parent_id resolves, every span closed and monotonic
-    (t1 >= t0), and children sit inside their parent's interval."""
+    (t1 >= t0), and children sit inside their parent's interval.
+
+    With ``multi_engine=True`` (fleet traces: handoff, migration, crash
+    replay) the containment check is skipped for parent/child pairs whose
+    ``engine`` attrs differ: a migrated request's pre-adoption spans ran
+    on a different engine, before the adopting engine's root interval
+    opened — cross-engine edges carry causality, not wall-clock
+    containment. Identity checks (one trace id, one root, no orphaned
+    parent_ids, closed + monotonic spans) still apply in full."""
     problems: List[str] = []
     if not trace_spans:
         return ["trace has no spans"]
@@ -406,6 +415,8 @@ def validate_trace(trace_spans: List[Span]) -> List[str]:
             problems.append(f"span {s.name!r} has unresolved parent_id {pid}")
             continue
         if parent.t1_us is None:
+            continue
+        if multi_engine and s.attrs.get("engine") != parent.attrs.get("engine"):
             continue
         if (s.t0_us < parent.t0_us - _CONTAINMENT_SLACK_US
                 or s.t1_us > parent.t1_us + _CONTAINMENT_SLACK_US):

@@ -1,0 +1,338 @@
+"""Fleet-scope observability (ISSUE 19) unit contracts.
+
+``FleetView`` rollup math over stub engines (fraction/rate definitions,
+healthy counting, the published ``serving.fleet.*`` gauges), the
+multi-engine trace validator (cross-engine containment waived, identity
+checks kept), ``trace_doc`` reconstruction, the ``/fleet`` and
+``/trace/<id>`` exporter endpoints, and flight-recorder bundle contents,
+atomicity, and retention. The live end-to-end legs (real engines, real
+handoffs, chaos ``kill()``) ride ``tools/obs_smoke.py`` and the trace
+continuity tests in ``test_serving_disagg.py`` /
+``test_serving_recovery.py``.
+"""
+
+import json
+import os
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddle_tpu import tracing
+from paddle_tpu.core import profiler as prof
+from paddle_tpu.core.enforce import EnforceError
+from paddle_tpu.observability import fleet as obs_fleet
+from paddle_tpu.observability import flight_recorder
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability.exporter import MetricsServer
+
+
+# ---- stub fleet -------------------------------------------------------------
+
+
+class _StubBreaker:
+    def __init__(self, state):
+        self._state = state
+
+    def snapshot(self):
+        return {"state": self._state, "consecutive_failures": 0,
+                "trips_total": 0, "recoveries_total": 0, "retry_in_s": 0.0}
+
+
+class _StubEngine:
+    closed = False
+
+    def __init__(self, label, snap, state="closed"):
+        self.metrics = types.SimpleNamespace(
+            engine_label=label, snapshot=lambda s=snap: dict(s))
+        self.breaker = _StubBreaker(state)
+
+    def load(self):
+        return 0.25
+
+
+class _StubFleet:
+    def __init__(self, engines):
+        self.engines = engines
+
+    def snapshot(self):
+        return {"engines": [{"engine": e.metrics.engine_label}
+                            for e in self.engines],
+                "rescued_total": 3, "rescue_failed_total": 1}
+
+
+def _two_engine_fleet():
+    ea = _StubEngine("a", {"prompt_tokens_total": 100,
+                           "prefix_hit_tokens_total": 30,
+                           "requests_total": 10,
+                           "host_tier_hits_total": 4,
+                           "host_promoted_pages_total": 5,
+                           "handoffs_in_total": 2,
+                           "migrated_total": 1,
+                           "step_faults_total": 0})
+    eb = _StubEngine("b", {"prompt_tokens_total": 100,
+                           "prefix_hit_tokens_total": 20,
+                           "requests_total": 10,
+                           "host_tier_hits_total": 6,
+                           "host_promoted_pages_total": 5,
+                           "handoffs_in_total": 1,
+                           "migrated_total": 0,
+                           "step_faults_total": 2},
+                     state="open")
+    return _StubFleet([ea, eb])
+
+
+# ---- rollup math ------------------------------------------------------------
+
+
+def test_rollup_merges_per_engine_snapshots():
+    view = obs_fleet.FleetView(_two_engine_fleet(), name="t0")
+    roll = view.rollup()
+    assert roll["engines"] == 2
+    assert roll["engines_healthy"] == 1  # b's breaker is open
+    assert roll["prefix_hit_frac"] == pytest.approx(50 / 200)
+    assert roll["host_tier_hit_rate"] == pytest.approx(10 / 20)
+    assert roll["host_tier_promote_rate"] == pytest.approx(10 / 10)
+    assert roll["handoffs_total"] == 3
+    assert roll["rescued_total"] == 3.0
+    assert roll["rescue_failed_total"] == 1.0
+    assert roll["migrated_total"] == 1.0
+    assert roll["step_faults_total"] == 2.0
+
+
+def test_rollup_publishes_fleet_gauges():
+    view = obs_fleet.FleetView(_two_engine_fleet(), name="t1")
+    view.rollup()
+    reg = obs_metrics.default_registry()
+    assert reg.get("serving.fleet.engines",
+                   labels={"fleet": "t1"}) == 2.0
+    assert reg.get("serving.fleet.engines_healthy",
+                   labels={"fleet": "t1"}) == 1.0
+    assert reg.get("serving.fleet.prefix_hit_frac",
+                   labels={"fleet": "t1"}) == pytest.approx(0.25)
+    assert reg.get("serving.fleet.breaker_open",
+                   labels={"fleet": "t1", "engine": "a"}) == 0.0
+    assert reg.get("serving.fleet.breaker_open",
+                   labels={"fleet": "t1", "engine": "b"}) == 1.0
+    assert reg.get("serving.fleet.load",
+                   labels={"fleet": "t1", "engine": "a"}) == 0.25
+
+
+def test_rollup_zero_denominators_do_not_divide():
+    fleet = _StubFleet([_StubEngine("z", {})])
+    roll = obs_fleet.FleetView(fleet, name="t2").rollup()
+    assert roll["prefix_hit_frac"] == 0.0
+    assert roll["host_tier_hit_rate"] == 0.0
+    assert roll["host_tier_promote_rate"] == 0.0
+
+
+def test_rollup_reexports_shard_skew_per_group():
+    prof.set_gauge("serving.group.shard_skew", 0.3, labels={"engine": "a"})
+    view = obs_fleet.FleetView(_two_engine_fleet(), name="t3")
+    view.rollup()
+    reg = obs_metrics.default_registry()
+    assert reg.get("serving.fleet.shard_skew",
+                   labels={"fleet": "t3", "group": "a"}) == pytest.approx(0.3)
+
+
+def test_rollup_includes_autoscaler_actions():
+    auto = types.SimpleNamespace(actions_total={"scale_decode": 2})
+    view = obs_fleet.FleetView(_two_engine_fleet(), name="t4",
+                               autoscaler=auto)
+    roll = view.rollup()
+    assert roll["autoscaler_actions"] == {"scale_decode": 2}
+    reg = obs_metrics.default_registry()
+    assert reg.get("serving.fleet.autoscaler_actions",
+                   labels={"fleet": "t4", "action": "scale_decode"}) == 2.0
+
+
+def test_fleet_view_requires_engines():
+    with pytest.raises(EnforceError):
+        obs_fleet.FleetView(object())
+
+
+def test_install_registry_idempotent():
+    view = obs_fleet.FleetView(_StubFleet([]), name="t5")
+    obs_fleet.install(view)
+    obs_fleet.install(view)
+    try:
+        assert obs_fleet.installed_views().count(view) == 1
+    finally:
+        obs_fleet.uninstall(view)
+    assert view not in obs_fleet.installed_views()
+
+
+# ---- multi-engine trace validation + trace_doc ------------------------------
+
+
+def _cross_engine_trace():
+    """A root on engine b whose child on engine a sits OUTSIDE the root's
+    window — legal across engines (clocks differ), an error within one."""
+    root = tracing.SpanContext.new_trace()
+    tracing.record_span("serving.decode.request", 10.0, 11.0,
+                        context=root, engine="b")
+    tracing.record_span("serving.decode.prefill", 8.0, 9.0,
+                        parent=root, engine="a")
+    return root
+
+
+def test_validate_trace_multi_engine_waives_cross_engine_containment():
+    root = _cross_engine_trace()
+    spans = tracing.spans_for_trace(root.trace_id)
+    assert tracing.validate_trace(spans, multi_engine=True) == []
+    problems = tracing.validate_trace(spans)
+    assert problems and any("serving.decode.prefill" in p for p in problems)
+
+
+def test_validate_trace_multi_engine_still_rejects_orphans():
+    root = _cross_engine_trace()
+    orphan_ctx = tracing.SpanContext(
+        root.trace_id, "c0ffee0123456789", "dead0123456789ab")
+    tracing.record_span("serving.rescue", 10.2, 10.4,
+                        context=orphan_ctx, engine="c")
+    spans = tracing.spans_for_trace(root.trace_id)
+    problems = tracing.validate_trace(spans, multi_engine=True)
+    assert problems and any("unresolved parent" in p for p in problems)
+
+
+def test_trace_doc_reconstructs_hops_and_spans():
+    root = _cross_engine_trace()
+    doc = obs_fleet.trace_doc(root.trace_id)
+    assert doc["trace_id"] == root.trace_id
+    assert doc["problems"] == []
+    assert doc["engines"] == ["a", "b"]  # order of first appearance
+    assert [s["name"] for s in doc["spans"]] == [
+        "serving.decode.prefill", "serving.decode.request"]
+    assert doc["events"] == []  # no runlog installed in this test
+
+
+def test_trace_doc_unknown_trace_reports_no_spans():
+    doc = obs_fleet.trace_doc("f" * 32)
+    assert doc["spans"] == []
+    assert doc["problems"] == ["trace has no spans"]
+
+
+# ---- exporter endpoints -----------------------------------------------------
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode("utf-8"))
+
+
+def test_fleet_endpoint_serves_installed_views():
+    srv = MetricsServer(port=0).start()
+    try:
+        status, doc = _get(srv.url + "/fleet")
+        assert status == 404 and "error" in doc  # nothing installed
+        view = obs_fleet.FleetView(_two_engine_fleet(), name="http")
+        obs_fleet.install(view)
+        try:
+            status, doc = _get(srv.url + "/fleet")
+            assert status == 200
+            assert len(doc) == 1 and doc[0]["fleet"] == "http"
+            assert doc[0]["rollup"]["engines"] == 2
+            assert set(doc[0]["metrics"]) == {"a", "b"}
+        finally:
+            obs_fleet.uninstall(view)
+    finally:
+        srv.close()
+
+
+def test_trace_by_id_endpoint():
+    srv = MetricsServer(port=0).start()
+    try:
+        status, doc = _get(srv.url + "/trace/not-a-trace-id")
+        assert status == 400 and "error" in doc
+        status, doc = _get(srv.url + "/trace/" + "e" * 32)
+        assert status == 404 and "error" in doc
+        root = _cross_engine_trace()
+        status, doc = _get(srv.url + "/trace/" + root.trace_id)
+        assert status == 200
+        assert doc["engines"] == ["a", "b"]
+        assert doc["problems"] == []
+        # exact /trace (no id) still serves the Chrome-trace document
+        status, doc = _get(srv.url + "/trace")
+        assert status == 200 and "traceEvents" in doc
+    finally:
+        srv.close()
+
+
+# ---- flight recorder --------------------------------------------------------
+
+
+def _wrecked_engine():
+    return types.SimpleNamespace(
+        metrics=types.SimpleNamespace(
+            engine_label="wreck",
+            snapshot=lambda: {"requests_total": 7}),
+        breaker=_StubBreaker("open"),
+        kv=types.SimpleNamespace(
+            allocator=types.SimpleNamespace(refcounts=lambda: [1, 0, 2])),
+        host_tier=types.SimpleNamespace(stats=lambda: {"pages": 3}),
+    )
+
+
+def test_maybe_dump_is_noop_without_recorder():
+    flight_recorder.uninstall()
+    assert flight_recorder.maybe_dump("breaker_trip") is None
+
+
+def test_bundle_contents_and_atomicity(tmp_path):
+    rec = flight_recorder.FlightRecorder(os.fspath(tmp_path), keep=4)
+    path = rec.dump("breaker_trip", engine=_wrecked_engine())
+    assert os.path.basename(path).startswith("flightrec_")
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    with open(path, "r", encoding="utf-8") as f:
+        bundle = json.load(f)
+    assert bundle["format"] == "paddle_tpu.flightrec.v1"
+    assert bundle["reason"] == "breaker_trip"
+    assert bundle["engine"] == "wreck"
+    assert bundle["kv_refcounts"] == [1, 0, 2]
+    assert bundle["host_tier"] == {"pages": 3}
+    assert bundle["breaker"]["state"] == "open"
+    assert bundle["metrics"] == {"requests_total": 7}
+    for key in ("spans", "runlog", "alerts", "locks", "ts_unix", "pid"):
+        assert key in bundle, key
+
+
+def test_bundle_without_engine_still_writes(tmp_path):
+    rec = flight_recorder.FlightRecorder(os.fspath(tmp_path))
+    path = rec.dump("kill")
+    with open(path, "r", encoding="utf-8") as f:
+        bundle = json.load(f)
+    assert bundle["reason"] == "kill"
+    assert "engine" not in bundle
+
+
+def test_retention_prunes_oldest(tmp_path):
+    rec = flight_recorder.FlightRecorder(os.fspath(tmp_path), keep=2)
+    for _ in range(3):
+        rec.dump("engine_fault")
+    bundles = rec.bundles()
+    assert len(bundles) == 2
+    seqs = [json.load(open(p))["seq"] for p in bundles]
+    assert seqs == [2, 3]  # the first dump was pruned
+
+
+def test_recorder_rejects_bad_knobs(tmp_path):
+    with pytest.raises(EnforceError):
+        flight_recorder.FlightRecorder(os.fspath(tmp_path), keep=0)
+    with pytest.raises(EnforceError):
+        flight_recorder.FlightRecorder(os.fspath(tmp_path), span_tail=-1)
+
+
+def test_installed_recorder_serves_maybe_dump(tmp_path):
+    rec = flight_recorder.install(
+        flight_recorder.FlightRecorder(os.fspath(tmp_path)))
+    try:
+        assert flight_recorder.installed() is rec
+        path = flight_recorder.maybe_dump("kill", engine=_wrecked_engine())
+        assert path is not None and os.path.exists(path)
+    finally:
+        flight_recorder.uninstall()
+    assert flight_recorder.installed() is None

@@ -103,7 +103,8 @@ def test_handoff_payload_round_trip():
     assert q.n_preemptions == 2 and q.t_submit == 1.5
     for a, b in zip(p.k_pages + p.v_pages, q.k_pages + q.v_pages):
         np.testing.assert_array_equal(a, b)
-    # process-local fields never cross the wire
+    # the handle is process-local and never crosses the wire; the trace
+    # header does ride it, but this payload carries none
     assert q.handle is None and q.trace is None
 
 
@@ -129,6 +130,91 @@ def test_handoff_payload_to_rescue_packet():
     assert rp.rid == p.rid and rp.generated == p.generated
     assert rp.prompt.tolist() == p.prompt.tolist()
     assert rp.mnt == p.mnt and rp.tenant == p.tenant
+
+
+# ---- trace continuity across the handoff boundary ---------------------------
+
+
+def test_handoff_payload_trace_rides_the_wire():
+    """The W3C traceparent crosses the CRC'd wire and restores the same
+    (trace_id, span_id) identity; absent or malformed headers decode to
+    no trace — version tolerance, never a reject."""
+    from paddle_tpu import tracing
+    from paddle_tpu.serving.disagg import _trace_from_header
+
+    p = _payload()
+    p.trace = tracing.SpanContext.new_trace()
+    q = HandoffPayload.from_bytes(p.to_bytes())
+    assert q.trace is not None
+    assert q.trace.trace_id == p.trace.trace_id
+    assert q.trace.span_id == p.trace.span_id
+    assert _trace_from_header(None) is None
+    assert _trace_from_header("not-a-traceparent") is None
+    assert _trace_from_header("00-zz-bad-01") is None
+
+
+@pytest.mark.parametrize("transport", ["device", "serialized"])
+def test_handoff_trace_one_id_no_orphans(lm, transport):
+    """A request that crosses the prefill→decode boundary must leave ONE
+    trace: prefill spans on the publisher, transfer/adopt spans at the
+    boundary, the root recorded by the finishing engine — and
+    ``validate_trace(multi_engine=True)`` finds no orphans."""
+    from paddle_tpu import tracing
+
+    pre, dec = _engine(lm), _engine(lm)
+    router = DisaggRouter([pre, dec], [PREFILL, DECODE],
+                          transport=transport)
+    try:
+        prompt, n, ref = lm.cases[0]
+        h = router.submit(prompt, n)
+        out = h.result(timeout=120)
+        assert np.array_equal(out.tokens, ref)
+        assert h.trace is not None
+        spans = tracing.spans_for_trace(h.trace.trace_id)
+        assert tracing.validate_trace(spans, multi_engine=True) == []
+        names = {s.name for s in spans}
+        assert {"serving.decode.queue_wait", "serving.decode.prefill",
+                "serving.handoff.transfer", "serving.handoff.adopt",
+                "serving.decode.request"} <= names, names
+        engines = {s.attrs.get("engine") for s in spans} - {None}
+        assert engines == {pre.metrics.engine_label,
+                           dec.metrics.engine_label}
+        # exactly one root, recorded by the engine that FINISHED the
+        # request — adoption must not mint a second identity
+        roots = [s for s in spans if s.context.parent_id is None]
+        assert len(roots) == 1, [(s.name, s.attrs) for s in roots]
+        assert roots[0].name == "serving.decode.request"
+        assert roots[0].attrs["engine"] == dec.metrics.engine_label
+    finally:
+        router.close(30)
+    pre.kv.assert_no_leaks()
+    dec.kv.assert_no_leaks()
+
+
+def test_faulted_transfer_keeps_trace_through_reprefill(lm):
+    """Rung 2 (reject + re-prefill on the decode worker) rides the rescue
+    path — the adopted request must keep the submitter's trace id."""
+    from paddle_tpu import tracing
+
+    pre, dec = _engine(lm), _engine(lm)
+    router = DisaggRouter([pre, dec], [PREFILL, DECODE],
+                          transport="serialized")
+    try:
+        with faults.injected(
+            faults.FaultSpec(faults.DISAGG_HANDOFF, "error", times=1)
+        ):
+            prompt, n, ref = lm.cases[0]
+            h = router.submit(prompt, n)
+            out = h.result(timeout=120)
+        assert np.array_equal(out.tokens, ref)
+        assert h.trace is not None
+        spans = tracing.spans_for_trace(h.trace.trace_id)
+        assert tracing.validate_trace(spans, multi_engine=True) == []
+        assert "serving.rescue" in {s.name for s in spans}
+    finally:
+        router.close(30)
+    pre.kv.assert_no_leaks()
+    dec.kv.assert_no_leaks()
 
 
 # ---- end-to-end handoff: both transports, token-exact -----------------------

@@ -492,3 +492,104 @@ def test_close_enforces_drain_deadline_force_finishes(lm):
         assert out.finish_reason == "drain_timeout"
         assert len(out.tokens) < lm.cases[0][1]  # partial, not hung
     eng.kv.assert_no_leaks()
+
+
+# ---- trace continuity: rescue, restart replay, compaction (fleet obs) ------
+
+
+def test_migration_keeps_one_trace_across_engines(lm):
+    """A breaker-trip migration must CONTINUE the submitter's trace on
+    the rescuing engine: one trace id, a ``serving.rescue`` span naming
+    both engines, zero orphans, and the root recorded by the engine that
+    finished the request."""
+    from paddle_tpu import tracing
+
+    ea, eb = _engine(lm), _engine(lm)
+    fleet = DecodeFleet([ea, eb])
+    try:
+        with faults.injected(
+            faults.FaultSpec(faults.DECODE_STEP, "error", after=1,
+                             times=10 ** 9,
+                             match={"engine": ea.metrics.engine_label})
+        ):
+            p, n, ref = lm.cases[0]
+            h = ea.submit(p, n)  # pin to A; A's breaker will trip
+            out = h.result(timeout=120)
+        assert np.array_equal(out.tokens, ref)
+        assert h.trace is not None
+        spans = tracing.spans_for_trace(h.trace.trace_id)
+        assert tracing.validate_trace(spans, multi_engine=True) == []
+        assert "serving.rescue" in {s.name for s in spans}
+        engines = {s.attrs.get("engine") for s in spans} - {None}
+        assert engines == {ea.metrics.engine_label,
+                           eb.metrics.engine_label}
+        roots = [s for s in spans if s.context.parent_id is None]
+        assert len(roots) == 1, [(s.name, s.attrs) for s in roots]
+        assert roots[0].attrs["engine"] == eb.metrics.engine_label
+    finally:
+        fleet.close(timeout=30)
+
+
+def test_journal_replay_restores_trace_ids(tmp_path):
+    """Admit/handoff records carry the W3C traceparent ("tp"); replay
+    surfaces it, pre-trace records replay as trace-less, and compaction
+    keeps it in the rewritten snapshot."""
+    from paddle_tpu import tracing
+
+    path = os.fspath(tmp_path / "j.wal")
+    ctx = tracing.SpanContext.new_trace()
+    j = RequestJournal(path, fsync_every=1)
+    j.log_admit("r1", np.array([5, 6], np.int32), 4, [], "default",
+                "interactive", trace=ctx.to_traceparent())
+    j.log_token("r1", 7)
+    j.log_admit("r2", np.array([9], np.int32), 3, [], "default",
+                "interactive")  # a pre-trace writer's record
+    rep = replay_journal(path)
+    assert rep["r1"].trace == ctx.to_traceparent()
+    assert rep["r2"].trace is None
+    # compaction rewrites snapshots: the traceparent must survive it
+    j.compact()
+    j.close()
+    rep2 = replay_journal(path)
+    assert rep2["r1"].trace == ctx.to_traceparent()
+    assert rep2["r1"].generated == [7]
+    assert rep2["r2"].trace is None
+
+
+def test_restart_resume_continues_original_trace(lm, tmp_path):
+    """Crash → journal replay: the resumed request decodes under the
+    ORIGINAL trace id (restored from the journaled traceparent), not a
+    freshly minted one — the fleet trace survives the process."""
+    from paddle_tpu import tracing
+
+    path = os.fspath(tmp_path / "decode.wal")
+    e1 = _engine(lm, journal_path=path, journal_fsync_every=1)
+    p, n, ref = lm.cases[0]
+    h1 = e1.submit(p, n)
+    assert h1.trace is not None
+    deadline = time.monotonic() + 60
+    while (e1.metrics.snapshot()["tokens_total"] < 2
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    e1.kill()
+    with pytest.raises(Exception):
+        h1.result(timeout=10)
+
+    e2 = _engine(lm, journal_path=path)
+    try:
+        resumed = resume_incomplete(e2, path)
+        assert len(resumed) == 1
+        (handle, _n_delivered), = resumed.values()
+        out = handle.result(timeout=120)
+        assert np.array_equal(out.tokens, ref)
+        assert handle.trace is not None
+        assert handle.trace.trace_id == h1.trace.trace_id  # SAME trace
+        spans = tracing.spans_for_trace(h1.trace.trace_id)
+        assert tracing.validate_trace(spans, multi_engine=True) == []
+        # the killed engine never finished the request, so exactly one
+        # root exists: the resuming engine's
+        roots = [s for s in spans if s.context.parent_id is None]
+        assert len(roots) == 1
+        assert roots[0].attrs["engine"] == e2.metrics.engine_label
+    finally:
+        e2.close(timeout=30)

@@ -271,13 +271,21 @@ def test_same_degree_handoff_adopts_pages():
     router = DisaggRouter([pre, dec], [PREFILL, DECODE],
                           transport="serialized")
     try:
-        outs = [router.submit(p, n).result(timeout=120)
-                for p, n, _ in cases]
+        from paddle_tpu import tracing
+
+        handles = [router.submit(p, n) for p, n, _ in cases]
+        outs = [h.result(timeout=120) for h in handles]
         for (_, _, ref), out in zip(cases, outs):
             assert np.array_equal(out.tokens, ref)
         snap = dec.metrics.snapshot()
         assert snap["handoffs_in_total"] == len(cases), snap
         assert snap["recovered_total"] == 0, snap
+        # adoption continues the submitter's trace across the groups
+        for h in handles:
+            assert h.trace is not None
+            spans = tracing.spans_for_trace(h.trace.trace_id)
+            assert tracing.validate_trace(spans, multi_engine=True) == []
+            assert "serving.handoff.adopt" in {s.name for s in spans}
     finally:
         router.close(30)
     pre.kv.assert_no_leaks()
@@ -295,13 +303,27 @@ def test_cross_degree_handoff_degrades_to_reprefill():
     router = DisaggRouter([pre, dec], [PREFILL, DECODE],
                           transport="serialized")
     try:
-        outs = [router.submit(p, n).result(timeout=120)
-                for p, n, _ in cases]
+        from paddle_tpu import tracing
+
+        handles = [router.submit(p, n) for p, n, _ in cases]
+        outs = [h.result(timeout=120) for h in handles]
         for (_, _, ref), out in zip(cases, outs):
             assert np.array_equal(out.tokens, ref)
         snap = dec.metrics.snapshot()
         assert snap["handoffs_in_total"] == 0, snap
         assert snap["recovered_total"] == len(cases), snap
+        # the refused adoption re-prefills on the decode worker — still
+        # ONE trace per request, with the root on the finishing engine
+        # and no adopt span (the pages never implanted)
+        for h in handles:
+            assert h.trace is not None
+            spans = tracing.spans_for_trace(h.trace.trace_id)
+            assert tracing.validate_trace(spans, multi_engine=True) == []
+            names = {s.name for s in spans}
+            assert "serving.handoff.adopt" not in names
+            roots = [s for s in spans if s.context.parent_id is None]
+            assert len(roots) == 1
+            assert roots[0].attrs["engine"] == dec.metrics.engine_label
     finally:
         router.close(30)
     pre.kv.assert_no_leaks()

@@ -184,6 +184,27 @@ def test_span_name_rule():
     assert _lint(ok) == []
 
 
+def test_fleet_metric_kind_rule():
+    src = """
+    from paddle_tpu.core import profiler as prof
+
+    def publish(n):
+        prof.inc_counter("serving.fleet.handoffs_total")   # accumulates
+        prof.observe("serving.fleet.load", n)              # accumulates
+    """
+    diags = _lint(src)
+    assert _codes(diags).count("fleet-metric-kind") == 2
+    ok = """
+    from paddle_tpu.core import profiler as prof
+
+    def publish(n):
+        prof.set_gauge("serving.fleet.load", n)            # recomputed: ok
+        prof.inc_counter("serving.handoffs_total")         # not a fleet family
+        prof.inc_counter("flight_recorder.bundles_total")  # true counter: ok
+    """
+    assert _lint(ok) == []
+
+
 def test_suppression_comment():
     src = "def f(x):\n    assert x  # lint: allow\n    return x\n"
     assert _lint(src) == []

@@ -22,7 +22,14 @@ exporter enabled, then:
   ``serving.request`` root) and one training step (data_wait / h2d /
   step_compute under ``trainer.step``) — with ``device.hbm.*`` gauges in
   the scrape and the ``/trace`` + ``/runlog/tail?n=`` debug endpoints
-  answering.
+  answering;
+- runs a two-engine disaggregated request (prefill role → CRC'd handoff
+  → decode role) and a forced cross-engine migration, then reconstructs
+  each request's span tree from ``/trace/<trace_id>``: ONE trace id
+  spanning ≥2 engines, zero orphaned spans
+  (``validate_trace(multi_engine=True)`` returns no problems), with
+  ``/fleet`` serving the merged ``serving.fleet.*`` rollup and a chaos
+  ``kill()`` leaving a complete flight-recorder bundle on disk.
 
 Exit code 0 = the scrape parsed and every contract held; 1 = anything
 missing or malformed. CI-registered next to ``tools/chaos_smoke.py``
@@ -322,6 +329,147 @@ def _trace_phase(work: str, serving_traces: list) -> None:
           f"reconstructed, /trace + /runlog/tail answered")
 
 
+def _fleet_phase(work: str, seed: int) -> None:
+    """Fleet observability: one request's trace across ≥2 engines via the
+    disagg handoff AND via a forced migration, the ``/fleet`` rollup, the
+    ``/trace/<id>`` endpoint, and a flight-recorder bundle after a chaos
+    ``kill()``."""
+    import urllib.error
+
+    import paddle_tpu as pt
+    from paddle_tpu import models, tracing
+    from paddle_tpu.observability import fleet as obs_fleet
+    from paddle_tpu.observability import flight_recorder
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import (
+        DecodeConfig,
+        DecodeEngine,
+        DecodeFleet,
+        DisaggRouter,
+    )
+    from paddle_tpu.serving.disagg import DECODE, PREFILL
+
+    vocab = 97
+    spec = models.get_model("transformer_lm", seq_len=64, vocab=vocab,
+                            d_model=32, d_inner=64, num_heads=4, n_layers=2)
+    cfg = spec.extra["cfg"]
+    rng = np.random.RandomState(seed)
+    variables = spec.model.init(0, *spec.synth_batch(2, rng))
+    dc = dict(max_slots=3, page_size=4, max_context=40, prefill_chunk=8,
+              num_pages=16, recovery_base_delay_s=0.001,
+              recovery_max_delay_s=0.005, breaker_cooldown_s=0.05)
+    prompt = rng.randint(1, vocab, size=(10,)).astype(np.int32)
+    srv = pt.observability.server()
+    check(srv is not None, "exporter not running for the fleet phase")
+
+    def _http_trace_doc(trace_id):
+        return json.loads(urllib.request.urlopen(
+            srv.url + "/trace/" + trace_id, timeout=30
+        ).read().decode("utf-8"))
+
+    def _check_cross_engine(doc, want_names, label):
+        check(doc["problems"] == [],
+              f"{label}: trace {doc['trace_id']} has problems "
+              f"(orphans/structure): {doc['problems']}")
+        check(len(doc["engines"]) >= 2,
+              f"{label}: trace touched {doc['engines']}, want >= 2 engines")
+        tids = {s["trace_id"] for s in doc["spans"]}
+        check(tids == {doc["trace_id"]},
+              f"{label}: more than one trace id in the tree: {tids}")
+        by_id = {s["span_id"]: s for s in doc["spans"]}
+        for s in doc["spans"]:
+            check(s["parent_id"] is None or s["parent_id"] in by_id,
+                  f"{label}: span {s['name']} orphaned "
+                  f"(parent {s['parent_id']} not in trace)")
+        names = {s["name"] for s in doc["spans"]}
+        missing = want_names - names
+        check(not missing, f"{label}: spans missing: {missing} (have {names})")
+
+    # -- prefill → handoff → decode across two engines --------------------
+    pre = DecodeEngine(variables, cfg, decode=DecodeConfig(**dc))
+    dec = DecodeEngine(variables, cfg, decode=DecodeConfig(**dc))
+    router = DisaggRouter([pre, dec], [PREFILL, DECODE])
+    view = obs_fleet.install(obs_fleet.FleetView(router, name="smoke"))
+    try:
+        h = router.submit(prompt, 8)
+        h.result(timeout=120)
+        check(h.trace is not None, "disagg request completed without a trace")
+        doc = _http_trace_doc(h.trace.trace_id)
+        _check_cross_engine(
+            doc,
+            {"serving.decode.queue_wait", "serving.decode.prefill",
+             "serving.handoff.transfer", "serving.handoff.adopt",
+             "serving.decode.request"},
+            "handoff")
+
+        # /fleet serves the merged rollup for the installed view
+        fleet_doc = json.loads(urllib.request.urlopen(
+            srv.url + "/fleet", timeout=30).read().decode("utf-8"))
+        check(isinstance(fleet_doc, list) and len(fleet_doc) == 1,
+              f"/fleet: want one installed view, got {fleet_doc!r:.200}")
+        roll = fleet_doc[0]["rollup"]
+        for key in ("engines", "engines_healthy", "prefix_hit_frac",
+                    "host_tier_hit_rate", "handoffs_total", "rescued_total"):
+            check(key in roll, f"/fleet rollup missing {key!r}: {roll}")
+        check(roll["engines"] == 2 and roll["engines_healthy"] == 2,
+              f"/fleet rollup engine counts wrong: {roll}")
+        check(roll["handoffs_total"] >= 1,
+              f"/fleet rollup saw no handoffs: {roll}")
+        from paddle_tpu.observability import metrics as obs_metrics
+        reg = obs_metrics.default_registry()
+        check(reg.get("serving.fleet.engines",
+                      labels={"fleet": "smoke"}, default=None) == 2.0,
+              "serving.fleet.engines gauge not published")
+    finally:
+        obs_fleet.uninstall(view)
+        router.close(60)
+
+    # -- forced migration + chaos kill() + flight recorder -----------------
+    rec = flight_recorder.install(flight_recorder.FlightRecorder(
+        os.path.join(work, "flightrec"), keep=4))
+    ea = DecodeEngine(variables, cfg, decode=DecodeConfig(**dc))
+    eb = DecodeEngine(variables, cfg, decode=DecodeConfig(**dc))
+    fleet = DecodeFleet([ea, eb])
+    try:
+        with faults.injected(
+            faults.FaultSpec(faults.DECODE_STEP, "error", after=1,
+                             times=10 ** 9,
+                             match={"engine": ea.metrics.engine_label}),
+            seed=seed,
+        ):
+            mh = ea.submit(prompt, 8)  # pin to A; A's breaker will trip
+            mh.result(timeout=120)
+        check(mh.trace is not None, "migrated request has no trace")
+        mdoc = _http_trace_doc(mh.trace.trace_id)
+        _check_cross_engine(
+            mdoc,
+            {"serving.decode.queue_wait", "serving.rescue",
+             "serving.decode.request"},
+            "migration")
+
+        eb.kill()  # chaos: the flight recorder must capture the wreck
+        bundles = rec.bundles()
+        check(bool(bundles), "no flight-recorder bundle after kill()")
+        with open(bundles[-1], "r", encoding="utf-8") as f:
+            bundle = json.load(f)
+        check(bundle["reason"] == "kill",
+              f"last bundle reason {bundle['reason']!r}, want 'kill'")
+        for key in ("spans", "runlog", "locks", "breaker", "metrics",
+                    "kv_refcounts", "engine"):
+            check(key in bundle, f"flight bundle missing {key!r}")
+        check(bundle["engine"] == eb.metrics.engine_label,
+              f"bundle engine {bundle['engine']!r} != killed engine")
+        reasons = {json.load(open(p))["reason"] for p in bundles}
+        check("breaker_trip" in reasons,
+              f"breaker trip left no bundle (have {reasons})")
+        print(f"[obs] fleet: handoff trace {doc['trace_id'][:8]}… over "
+              f"{doc['engines']}, migration trace {mdoc['trace_id'][:8]}… "
+              f"over {mdoc['engines']}, {len(bundles)} flight bundles")
+    finally:
+        flight_recorder.uninstall()
+        fleet.close(timeout=30)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=0)
@@ -339,6 +487,7 @@ def main(argv=None) -> int:
         _scrape_phase()
         _runlog_phase(work)
         _trace_phase(work, serving_traces)
+        _fleet_phase(work, args.seed)
     except ObsFailure as e:
         print(f"[obs] FAIL: {e}", file=sys.stderr)
         return 1
@@ -349,7 +498,7 @@ def main(argv=None) -> int:
         if not args.keep and args.dir is None:
             shutil.rmtree(work, ignore_errors=True)
     print("[obs] OK: exposition valid, families populated, runlog complete, "
-          "traces reconstruct")
+          "traces reconstruct, fleet rollup + flight recorder verified")
     return 0
 
 
