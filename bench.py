@@ -731,7 +731,11 @@ def serve_decode_main(n_requests: int = 24) -> dict:
     mean step occupancy, preemption count, and whether the jitted decode
     step stayed compile-flat under the mixed traffic. Compile time is
     excluded from both sides (engine warmup / per-shape prewarm), so the
-    ratio isolates the scheduling win, not recompile overhead."""
+    ratio isolates the scheduling win, not recompile overhead. Also
+    carries the continuous leg's token-latency percentiles from the
+    waterfall docs (``ttft_p50/p99``, ``tpot_p50/p99`` in ms;
+    ``decode_tpot_p99_ms`` is the gated lower-better entry) and a
+    ``roofline_summary`` block from the kernel cost ledger."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -770,7 +774,13 @@ def serve_decode_main(n_requests: int = 24) -> dict:
         # side of lock_check_overhead_pct below (and the production
         # default)
         from paddle_tpu.core import locks as _locks
+        from paddle_tpu.observability import roofline as _roofline
+        from paddle_tpu.tracing import waterfall as _waterfall
         _locks.set_enabled(False)
+        # fresh cost ledger + waterfall store: the roofline summary and
+        # the token-latency percentiles below describe THIS run only
+        _roofline.reset_ledger()
+        _waterfall.reset()
         eng = DecodeEngine(variables, cfg, decode=DecodeConfig(
             max_slots=slots, page_size=16, max_context=128,
             prefill_chunk=16))
@@ -784,6 +794,16 @@ def serve_decode_main(n_requests: int = 24) -> dict:
                         and eng.prefill_cache_size() == 1)
         eng.close()
         eng.kv.assert_no_leaks()
+        # token-latency samples from the continuous leg's waterfall docs
+        # (exact per-request TTFT + per-token TPOT, not bucket estimates)
+        ttfts, tpots = [], []
+        for rid in _waterfall.rids(finished_only=True):
+            d = _waterfall.doc(rid)
+            if d is None:
+                continue
+            if d["ttft_s"] is not None:
+                ttfts.append(d["ttft_s"])
+            tpots.extend(d["tpot_s"])
 
         # -- continuous + lock-order detector: same traffic with
         # core.locks checking forced ON; the delta vs the leg above is the
@@ -891,6 +911,16 @@ def serve_decode_main(n_requests: int = 24) -> dict:
         eng.kv.assert_no_leaks()
 
         result["value"] = round(gen_cont / dt_cont, 1)
+        # token-latency percentiles (milliseconds) for the continuous
+        # leg; decode_tpot_p99_ms is the gated lower-better entry
+        if ttfts:
+            result["ttft_p50"] = round(float(np.percentile(ttfts, 50)) * 1e3, 3)
+            result["ttft_p99"] = round(float(np.percentile(ttfts, 99)) * 1e3, 3)
+        if tpots:
+            result["tpot_p50"] = round(float(np.percentile(tpots, 50)) * 1e3, 3)
+            result["tpot_p99"] = round(float(np.percentile(tpots, 99)) * 1e3, 3)
+            result["decode_tpot_p99_ms"] = result["tpot_p99"]
+        result["roofline_summary"] = _roofline.summary()
         result["decode_serve_lockcheck_tok_per_sec"] = round(
             gen_lock / dt_lock, 1)
         result["lock_check_overhead_pct"] = round(

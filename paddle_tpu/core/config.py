@@ -75,6 +75,18 @@ class Flags:
     # per-device peak FLOP/s override for MFU accounting (0 = use the
     # device-kind table in observability/mfu.py)
     peak_flops: float = 0.0
+    # peak HBM bandwidth (bytes/s) override for roofline classification
+    # (0 = use the device-kind table in observability/mfu.py)
+    peak_hbm_bw: float = 0.0
+    # roofline cost ledger: capture per-executable cost_analysis() /
+    # memory_analysis() at compile time and per-call wall times
+    # (observability/roofline.py; /roofline on the exporter)
+    roofline: bool = True
+    # memory_analysis() peak-HBM capture costs a duplicate AOT compile
+    # per executable. "auto" pays it only where the number is a real
+    # device peak (non-CPU backends; on TPU the persistent compile cache
+    # absorbs the cost); "on"/"off" force it
+    roofline_memory: str = "auto"
     # tracing: bounded in-memory span store size (oldest spans evicted;
     # evictions counted under tracing.spans_evicted)
     trace_max_spans: int = 200_000

@@ -32,7 +32,10 @@ class _InstrumentedCompiled:
     growth across one call means XLA compiled for a new (shape, dtype)
     signature, so that call's wall time is (approximately) trace + compile
     + first run. Emits ``executor.compiles_total`` / the
-    ``executor.compile_seconds`` histogram and a ``compile`` runlog event.
+    ``executor.compile_seconds`` histogram and a ``compile`` runlog event,
+    and feeds the roofline cost ledger (observability/roofline.py): the
+    compiling call captures the executable's ``cost_analysis()`` /
+    ``memory_analysis()``, every later call books its wall seconds.
     Transparent otherwise: attribute access (``lower``, ``_cache_size``,
     ...) delegates to the wrapped jit object."""
 
@@ -46,6 +49,9 @@ class _InstrumentedCompiled:
     def __call__(self, *args, **kwargs):
         if not self._tracked:
             return self._fn(*args, **kwargs)
+        from paddle_tpu.observability import roofline
+
+        ledger_on = roofline.enabled()
         before = self._fn._cache_size()
         t0 = time.perf_counter()
         out = self._fn(*args, **kwargs)
@@ -68,6 +74,20 @@ class _InstrumentedCompiled:
             # parents under the caller's active span (a trainer step, a
             # serving warmup), so compiles show up inside the step trace
             tracing.record_span("executor.compile", t0, t1, target=self._label)
+            if ledger_on:
+                try:
+                    roofline.capture_costs(
+                        self._fn, roofline.call_key(self._label, args, kwargs),
+                        args, kwargs)
+                except Exception:
+                    pass
+        elif ledger_on:
+            try:
+                roofline.observe_call(
+                    roofline.call_key(self._label, args, kwargs),
+                    time.perf_counter() - t0)
+            except Exception:
+                pass
         return out
 
     def __getattr__(self, name):

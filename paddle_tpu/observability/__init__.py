@@ -8,6 +8,10 @@ Four pieces, layered on the counter/gauge bridge in ``core.profiler``:
   log (step / compile / checkpoint / resilience events);
 - :mod:`~paddle_tpu.observability.mfu` — MFU from XLA ``cost_analysis()``
   FLOPs vs. per-device peak, plus goodput/badput accounting;
+- :mod:`~paddle_tpu.observability.roofline` — per-executable kernel cost
+  ledger (cost-model FLOPs/bytes + measured wall time) with roofline
+  verdicts (``compute_bound`` / ``memory_bound`` / ``overhead_bound``),
+  served at the exporter's ``/roofline`` endpoint;
 - :mod:`~paddle_tpu.observability.exporter` — stdlib Prometheus
   ``/metrics`` + ``/healthz`` HTTP endpoint, plus ``/runlog/tail?n=`` and
   ``/trace`` debug endpoints (last runlog events / merged Chrome trace);
@@ -46,6 +50,7 @@ from paddle_tpu.observability import (
     flight_recorder,
     metrics,
     mfu,
+    roofline,
     runlog,
 )
 from paddle_tpu.observability.exporter import MetricsServer, render_text
@@ -68,6 +73,7 @@ __all__ = [
     "metrics",
     "runlog",
     "mfu",
+    "roofline",
     "exporter",
     "fleet",
     "flight_recorder",

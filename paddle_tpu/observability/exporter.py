@@ -272,6 +272,14 @@ class _Handler(BaseHTTPRequestHandler):
             body, status = self._locks()
             self.send_response(status)
             self.send_header("Content-Type", JSON_CONTENT_TYPE)
+        elif path == "/roofline":
+            body, status = self._roofline()
+            self.send_response(status)
+            self.send_header("Content-Type", JSON_CONTENT_TYPE)
+        elif path.startswith("/waterfall/"):
+            body, status = self._waterfall_by_rid(path[len("/waterfall/"):])
+            self.send_response(status)
+            self.send_header("Content-Type", JSON_CONTENT_TYPE)
         else:
             body = b"not found\n"
             self.send_response(404)
@@ -406,6 +414,43 @@ class _Handler(BaseHTTPRequestHandler):
         return json.dumps(doc).encode() + b"\n", 200
 
     @staticmethod
+    def _roofline() -> Tuple[bytes, int]:
+        """The kernel cost ledger with roofline verdicts: per compiled
+        executable, cost-model FLOPs / bytes, arithmetic intensity,
+        achieved-vs-peak rates, and a ``compute_bound`` /
+        ``memory_bound`` / ``overhead_bound`` classification."""
+        from paddle_tpu.observability import roofline as _roofline
+
+        try:
+            doc = {
+                "enabled": _roofline.enabled(),
+                "summary": _roofline.summary(),
+                "entries": _roofline.snapshot(),
+            }
+        except Exception as e:  # never take the exporter down with roofline
+            return (json.dumps({"error": repr(e)}).encode() + b"\n", 500)
+        return json.dumps(doc).encode() + b"\n", 200
+
+    @staticmethod
+    def _waterfall_by_rid(rid: str) -> Tuple[bytes, int]:
+        """One decode request's token-latency waterfall: TTFT, per-token
+        TPOT samples (speculation-aware), jitter, and the raw iteration
+        event timeline."""
+        from paddle_tpu import tracing
+
+        if not re.fullmatch(r"[A-Za-z0-9._:-]{1,128}", rid):
+            return (json.dumps({"error": "malformed request id"}
+                               ).encode() + b"\n", 400)
+        try:
+            doc = tracing.waterfall.doc(rid)
+        except Exception as e:  # never take the exporter down with tracing
+            return (json.dumps({"error": repr(e)}).encode() + b"\n", 500)
+        if doc is None:
+            return (json.dumps({"error": "unknown request id",
+                                "rid": rid}).encode() + b"\n", 404)
+        return json.dumps(doc).encode() + b"\n", 200
+
+    @staticmethod
     def _fleet() -> Tuple[bytes, int]:
         """Merged fleet rollups from every installed
         :class:`~paddle_tpu.observability.fleet.FleetView` — the
@@ -437,9 +482,14 @@ class MetricsServer:
     shed/brownout state), ``/locks`` (the ``core.locks`` held-locks
     registry, lock-order graph, and any recorded order violations),
     ``/fleet`` (installed ``FleetView`` rollups: merged
-    ``serving.fleet.*`` numbers plus per-engine snapshots), and
+    ``serving.fleet.*`` numbers plus per-engine snapshots),
     ``/trace/<trace_id>`` (one request's cross-engine span timeline,
-    hop order, validation problems, and correlated runlog events)."""
+    hop order, validation problems, and correlated runlog events),
+    ``/roofline`` (the kernel cost ledger: per-executable FLOPs/bytes,
+    arithmetic intensity, achieved-vs-peak rates, and compute/memory/
+    overhead-bound verdicts), and ``/waterfall/<rid>`` (one decode
+    request's token-latency waterfall: TTFT, speculation-aware per-token
+    TPOT samples, jitter, and the iteration event timeline)."""
 
     def __init__(self, registry: Optional[obs_metrics.MetricRegistry] = None,
                  host: str = "127.0.0.1", port: int = 0):

@@ -8,7 +8,9 @@ the time someone attaches, the process is gone. A :class:`FlightRecorder`
 keeps nothing extra at steady state (spans and runlog already ring); on a
 trip it snapshots the tails plus the engine's crash-state — held locks,
 ``PageAllocator.refcounts()``, host-tier and breaker state, the full
-metrics snapshot — into one JSON bundle, written atomically (tmp +
+metrics snapshot, and the roofline cost-ledger snapshot (which kernels
+were compute/memory/overhead-bound when it died) — into one JSON bundle,
+written atomically (tmp +
 ``os.replace``) so a half-written bundle can never be mistaken for a
 post-mortem. Retention is bounded: only the newest ``keep`` bundles
 survive, so a crash-looping engine cannot fill the disk.
@@ -150,6 +152,16 @@ class FlightRecorder:
             return {"enabled": False, "held": []}
 
     @staticmethod
+    def _roofline() -> Dict[str, Any]:
+        try:
+            from paddle_tpu.observability import roofline as _roofline
+
+            return {"summary": _roofline.summary(),
+                    "entries": _roofline.snapshot()}
+        except Exception:
+            return {"summary": {}, "entries": []}
+
+    @staticmethod
     def _engine_state(engine: Any) -> Dict[str, Any]:
         if engine is None:
             return {}
@@ -194,6 +206,7 @@ class FlightRecorder:
                 "runlog": self._runlog(),
                 "alerts": self._alerts(),
                 "locks": self._locks(),
+                "roofline": self._roofline(),
                 **self._engine_state(engine),
             }
             name = f"flightrec_{seq:06d}_{reason}.json"

@@ -28,9 +28,13 @@ from paddle_tpu.core import locks
 
 __all__ = [
     "PEAK_FLOPS_TABLE",
+    "PEAK_HBM_BW_TABLE",
     "peak_flops",
     "peak_flops_for_kind",
+    "peak_hbm_bw_for_kind",
     "set_peak_flops",
+    "set_peak_hbm_bw",
+    "cost_analysis_totals",
     "cost_flops",
     "lowered_flops",
     "mfu",
@@ -51,8 +55,23 @@ PEAK_FLOPS_TABLE: Tuple[Tuple[str, float], ...] = (
     ("cpu", 5e10),
 )
 
+# Peak HBM bandwidth (bytes/s) per chip generation, same substring-match
+# discipline as PEAK_FLOPS_TABLE — the denominator of the roofline's
+# memory side. The ``cpu`` entry is a nominal DDR figure so CPU-backend
+# runs still classify; override with PADDLE_TPU_PEAK_HBM_BW.
+PEAK_HBM_BW_TABLE: Tuple[Tuple[str, float], ...] = (
+    ("v6", 1640e9),
+    ("v5p", 2765e9),
+    ("v5", 819e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+    ("cpu", 50e9),
+)
+
 _override_lock = locks.Lock("observability.mfu_override")
 _override: Optional[float] = None
+_bw_override: Optional[float] = None
 
 
 def set_peak_flops(value: Optional[float]) -> None:
@@ -62,10 +81,24 @@ def set_peak_flops(value: Optional[float]) -> None:
         _override = float(value) if value else None
 
 
+def set_peak_hbm_bw(value: Optional[float]) -> None:
+    """Programmatic peak-HBM-bandwidth override (None clears it)."""
+    global _bw_override
+    with _override_lock:
+        _bw_override = float(value) if value else None
+
+
 def _flag_override() -> Optional[float]:
     from paddle_tpu.core import config
 
     v = config.flags().peak_flops
+    return float(v) if v and v > 0 else None
+
+
+def _bw_flag_override() -> Optional[float]:
+    from paddle_tpu.core import config
+
+    v = getattr(config.flags(), "peak_hbm_bw", 0.0)
     return float(v) if v and v > 0 else None
 
 
@@ -84,6 +117,22 @@ def peak_flops_for_kind(device_kind: str) -> Optional[float]:
     return None
 
 
+def peak_hbm_bw_for_kind(device_kind: str) -> Optional[float]:
+    """Peak HBM bytes/s for a device-kind string; override beats the
+    table. None when the kind matches no generation."""
+    with _override_lock:
+        if _bw_override is not None:
+            return _bw_override
+    flagged = _bw_flag_override()
+    if flagged is not None:
+        return flagged
+    kind = (device_kind or "").lower()
+    for marker, peak in PEAK_HBM_BW_TABLE:
+        if marker in kind:
+            return peak
+    return None
+
+
 def peak_flops(device=None) -> Optional[float]:
     """Peak FLOP/s for one device (default: the first local device)."""
     import jax
@@ -97,26 +146,40 @@ def peak_flops(device=None) -> Optional[float]:
     return peak_flops_for_kind(str(kind))
 
 
-def cost_flops(cost_source) -> float:
-    """Total FLOPs from a Lowered/Compiled computation's cost analysis.
+def cost_analysis_totals(cost_source) -> Dict[str, float]:
+    """Normalized ``cost_analysis()`` totals from a Lowered or Compiled
+    computation: ``{"flops": ..., "bytes": ..., "transcendentals": ...}``.
+
+    This is the ONE place that absorbs the cross-version shape drift:
     ``cost_analysis()`` returns a dict on Lowered and (on some jax
-    versions) a per-computation list on Compiled; handle both. Returns
-    0.0 when the backend exposes no cost model."""
+    versions) a per-computation list of dicts on Compiled; both the MFU
+    path and the roofline ledger read through this accessor. All-zero
+    totals when the backend exposes no cost model."""
+    zero = {"flops": 0.0, "bytes": 0.0, "transcendentals": 0.0}
     try:
         cost = cost_source.cost_analysis()
     except Exception:
-        return 0.0
+        return zero
     if cost is None:
-        return 0.0
+        return zero
     if isinstance(cost, dict):
         cost = [cost]
-    total = 0.0
+    totals = dict(zero)
     for entry in cost:
         try:
-            total += float(entry.get("flops", 0.0))
+            totals["flops"] += float(entry.get("flops", 0.0))
+            totals["bytes"] += float(entry.get("bytes accessed", 0.0))
+            totals["transcendentals"] += float(
+                entry.get("transcendentals", 0.0))
         except (AttributeError, TypeError, ValueError):
             continue
-    return total
+    return totals
+
+
+def cost_flops(cost_source) -> float:
+    """Total FLOPs from a Lowered/Compiled computation's cost analysis
+    (see :func:`cost_analysis_totals` for the shape handling)."""
+    return cost_analysis_totals(cost_source)["flops"]
 
 
 def lowered_flops(jitted, *args, **kwargs) -> float:

@@ -73,8 +73,9 @@ from paddle_tpu.models.transformer_lm import (
     paged_prefill_chunk,
     paged_verify_step,
 )
-from paddle_tpu.observability import runlog
+from paddle_tpu.observability import roofline, runlog
 from paddle_tpu.parallel import collective
+from paddle_tpu.tracing import waterfall
 from paddle_tpu.resilience import faults
 from paddle_tpu.resilience.circuit import CircuitBreaker
 from paddle_tpu.serving import admission as admission_mod
@@ -480,12 +481,17 @@ class DecodeEngine:
         jit_kw = {} if group is None else {"out_shardings": (rep, kvs, kvs)}
         sample_kw = dict(temperature=dconf.temperature, top_k=dconf.top_k,
                          top_p=dconf.top_p)
-        self._step = jax.jit(functools.partial(
-            paged_decode_step, cfg=self.model_cfg,
-            page_size=dconf.page_size, **sample_kw), **jit_kw)
-        self._prefill = jax.jit(functools.partial(
-            paged_prefill_chunk, cfg=self.model_cfg,
-            page_size=dconf.page_size, **sample_kw), **jit_kw)
+        # roofline-instrumented: these jits bypass Executor.prepare(), so
+        # they feed the cost ledger through their own wrapper (compiles
+        # capture cost/memory analysis, later calls book wall seconds)
+        self._step = roofline.instrument(
+            "serving.decode.step", jax.jit(functools.partial(
+                paged_decode_step, cfg=self.model_cfg,
+                page_size=dconf.page_size, **sample_kw), **jit_kw))
+        self._prefill = roofline.instrument(
+            "serving.decode.prefill", jax.jit(functools.partial(
+                paged_prefill_chunk, cfg=self.model_cfg,
+                page_size=dconf.page_size, **sample_kw), **jit_kw))
         # disagg KV handoff (serving.disagg): one page is the fixed-shape
         # [L, H_kv, page_size, dh] slice, so gather/implant compile once.
         # In group mode the gather's output is pinned replicated — the
@@ -536,15 +542,18 @@ class DecodeEngine:
                 self._dv_pages = jax.device_put(
                     jnp.zeros(dshape, self._cache_dtype), dkvs)
                 djit_kw = {"out_shardings": (rep, dkvs, dkvs)}
-            self._draft_step = jax.jit(functools.partial(
-                paged_decode_step, cfg=self.draft_cfg,
-                page_size=dconf.page_size, temperature=0.0), **djit_kw)
-            self._draft_prefill = jax.jit(functools.partial(
-                paged_prefill_chunk, cfg=self.draft_cfg,
-                page_size=dconf.page_size, temperature=0.0), **djit_kw)
-            self._verify = jax.jit(functools.partial(
-                paged_verify_step, cfg=self.model_cfg,
-                page_size=dconf.page_size), **jit_kw)
+            self._draft_step = roofline.instrument(
+                "serving.decode.draft_step", jax.jit(functools.partial(
+                    paged_decode_step, cfg=self.draft_cfg,
+                    page_size=dconf.page_size, temperature=0.0), **djit_kw))
+            self._draft_prefill = roofline.instrument(
+                "serving.decode.draft_prefill", jax.jit(functools.partial(
+                    paged_prefill_chunk, cfg=self.draft_cfg,
+                    page_size=dconf.page_size, temperature=0.0), **djit_kw))
+            self._verify = roofline.instrument(
+                "serving.decode.verify", jax.jit(functools.partial(
+                    paged_verify_step, cfg=self.model_cfg,
+                    page_size=dconf.page_size), **jit_kw))
 
         # -- radix prefix cache -------------------------------------------
         self._prefix: Optional[RadixPrefixCache] = None
@@ -921,6 +930,10 @@ class DecodeEngine:
             req.trace = tracing.SpanContext.new_trace()
             req.handle.trace = req.trace
             req.t_enqueue_pc = time.perf_counter()
+        # token-latency waterfall opens at submit: TTFT includes queue wait
+        waterfall.start(req.rid, time.perf_counter(),
+                        engine=self.metrics.engine_label, tenant=req.tenant,
+                        cls=req.cls)
         # journal BEFORE enqueue: the loop may start generating (and
         # journaling tokens) the instant the scheduler has the request
         self._j_admit(req)
@@ -931,9 +944,11 @@ class DecodeEngine:
                 self._queue.send(req, timeout=timeout)
         except ChannelClosedError:
             self._j_fin(req, "shed")
+            waterfall.finish(req.rid, time.perf_counter(), "shed")
             raise EngineClosedError("engine is closed") from None
         except AdmissionRejected:
             self._j_fin(req, "shed")
+            waterfall.finish(req.rid, time.perf_counter(), "shed")
             if req.trace is not None:
                 self._finish_trace(req, time.perf_counter(), status="shed")
             raise
@@ -977,12 +992,28 @@ class DecodeEngine:
             tenant=req.tenant, cls=req.cls,
             generated=len(req.generated), **attrs)
 
+    def _wf_tokens(self, req: _DecodeRequest, t_pc: float, n: int,
+                   phase: str) -> None:
+        """Book ``n`` tokens landing at ``t_pc`` in the request's
+        waterfall and mirror the returned TTFT / per-token TPOT samples
+        into the labeled histogram families. Called BEFORE the tokens are
+        appended — an append can finish the request, and a finished
+        waterfall refuses further bookings."""
+        if req.rid is None:
+            return
+        ttft, samples = waterfall.on_tokens(req.rid, t_pc, n, phase=phase)
+        if ttft is not None:
+            self.metrics.record_ttft(ttft, cls=req.cls)
+        if samples:
+            self.metrics.record_tpot(samples, cls=req.cls)
+
     def _expire(self, req: _DecodeRequest) -> None:
         """Deadline lapsed while queued (scheduler callback) or mid-
         generation (loop check)."""
         self.metrics.record_timeout()
         self.metrics.record_evict("deadline")
         self._j_fin(req, "deadline")
+        waterfall.finish(req.rid, time.perf_counter(), "deadline")
         self._finish_trace(req, time.perf_counter(),
                            status="deadline_exceeded")
         req.handle._fail(DeadlineExceeded(
@@ -1005,6 +1036,7 @@ class DecodeEngine:
             self.metrics.record_cancel()
         latency = time.monotonic() - req.t_submit
         self.metrics.record_response(latency)
+        waterfall.finish(req.rid, time.perf_counter(), reason)
         self._finish_trace(req, time.perf_counter(), status=reason)
         runlog.emit("decode_evict", reason=reason, tenant=req.tenant,
                     generated=len(req.generated),
@@ -1020,6 +1052,7 @@ class DecodeEngine:
         self._j_fin(req, "error")
         self.metrics.record_error()
         self.metrics.record_evict("error")
+        waterfall.finish(req.rid, time.perf_counter(), "error")
         self._finish_trace(req, time.perf_counter(), status="error",
                            error=type(exc).__name__)
         req.handle._fail(exc)
@@ -1627,6 +1660,7 @@ class DecodeEngine:
                 # the final chunk's sample IS the next token after the
                 # prefilled sequence — the first (or, after a resume, the
                 # next) generated token
+                self._wf_tokens(req, t1, 1, "prefill")
                 self._append_token(req, tok)
                 # prefill role (serving.disagg): publish instead of
                 # decoding here — unless that one sampled token already
@@ -1718,6 +1752,7 @@ class DecodeEngine:
         for req in list(decoding):
             req.cur_len += 1
             self._kv.seq_lens[req.slot] = req.cur_len
+            self._wf_tokens(req, t1, 1, "decode")
             self._append_token(req, int(nxt[req.slot]))
         return True
 
@@ -1790,6 +1825,7 @@ class DecodeEngine:
         self._note_step_ok()
         new_tokens = 0
         drafts_accepted = 0
+        eos = self.decode_config.eos_id
         for req in list(spec):
             row = out[req.slot]
             n_acc = 0
@@ -1797,6 +1833,17 @@ class DecodeEngine:
                    and int(draft_mat[req.slot, n_acc]) == int(row[n_acc])):
                 n_acc += 1
             drafts_accepted += n_acc
+            # waterfall booking mirrors _append_token's finish conditions
+            # exactly: the block truncates at eos / budget, and the n
+            # tokens this iteration lands book n TPOT samples of dt/n —
+            # the speculation-aware accounting contract
+            n_land = min(n_acc + 1, req.mnt - len(req.generated))
+            if eos is not None:
+                for j in range(n_land):
+                    if int(row[j]) == eos:
+                        n_land = j + 1
+                        break
+            self._wf_tokens(req, t1, n_land, "verify")
             for j in range(n_acc + 1):
                 if req not in self._active:
                     break  # finished (eos / budget) mid-block

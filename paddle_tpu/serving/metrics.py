@@ -332,6 +332,17 @@ class DecodeMetrics:
             help="Wall time to promote one host-tier page into the radix "
                  "tree (CRC verify + device implant + insert).",
             buckets=_LATENCY_BUCKETS)
+        reg.histogram(
+            "serving.decode.ttft_seconds",
+            help="Submit to first generated token per request (queue wait "
+                 "+ prefill), by request class.",
+            buckets=_LATENCY_BUCKETS)
+        reg.histogram(
+            "serving.decode.tpot_seconds",
+            help="Per-token latency after the first, by request class. "
+                 "Speculation-aware: a verify step landing n tokens books "
+                 "n samples, so spec on/off distributions are comparable.",
+            buckets=obs_metrics.exponential_buckets(0.0001, 2.0, 15))
         self.requests_total = 0
         self.responses_total = 0
         self.tokens_total = 0          # generated tokens across all requests
@@ -377,6 +388,9 @@ class DecodeMetrics:
         # tenant-quota admission accounting (serving.tenant.* families)
         self._tenant_admitted: collections.Counter = collections.Counter()
         self._tenant_shed: collections.Counter = collections.Counter()
+        # token-latency waterfall rollup (serving.decode.ttft/tpot families)
+        self.ttft_observed_total = 0
+        self.tpot_samples_total = 0
 
     def record_submit(self) -> None:
         with self._lock:
@@ -508,6 +522,28 @@ class DecodeMetrics:
                          labels=self._labels)
         prof.observe("serving.decode.request_latency_seconds", latency_s,
                      labels=self._labels)
+
+    # -- token-latency waterfall rollup (ttft/tpot families) -----------------
+
+    def record_ttft(self, seconds: float, cls: str = "default") -> None:
+        """One request's time-to-first-token (booked by the waterfall on
+        the iteration that produced the first generated token)."""
+        with self._lock:
+            self.ttft_observed_total += 1
+        prof.observe("serving.decode.ttft_seconds", seconds,
+                     labels={**self._labels, "cls": cls or "default"})
+
+    def record_tpot(self, samples, cls: str = "default") -> None:
+        """Book per-token latency samples — one per generated token after
+        the first; a multi-token verify iteration passes several equal
+        samples (see tracing/waterfall.py)."""
+        if not samples:
+            return
+        with self._lock:
+            self.tpot_samples_total += len(samples)
+        labels = {**self._labels, "cls": cls or "default"}
+        for s in samples:
+            prof.observe("serving.decode.tpot_seconds", s, labels=labels)
 
     # -- speculative decoding (serving.decode.spec_* families) ---------------
 
@@ -782,6 +818,8 @@ class DecodeMetrics:
                 "handoffs_in_total": self.handoffs_in_total,
                 "group_member_faults_total": self.group_member_faults_total,
                 "shard_stragglers_total": self.shard_stragglers_total,
+                "ttft_observed_total": self.ttft_observed_total,
+                "tpot_samples_total": self.tpot_samples_total,
                 "mean_step_occupancy": (
                     self.tokens_total / self.steps_total
                     if self.steps_total else 0.0),

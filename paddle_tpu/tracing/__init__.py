@@ -14,7 +14,7 @@ span tree without call-site changes.
 
 from __future__ import annotations
 
-from paddle_tpu.tracing import export, memory, straggler  # noqa: F401
+from paddle_tpu.tracing import export, memory, straggler, waterfall  # noqa: F401
 from paddle_tpu.tracing.context import (  # noqa: F401
     Span,
     SpanContext,
@@ -78,6 +78,7 @@ __all__ = [
     "export",
     "memory",
     "straggler",
+    "waterfall",
 ]
 
 

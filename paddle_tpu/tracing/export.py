@@ -11,6 +11,9 @@ Trace Event Format JSON, combining four sources on one timebase
 * runlog events (``ph:"i"`` instants; epoch timestamps converted via the
   import-time clock offset)
 * device HBM samples (``ph:"C"`` counter tracks per device)
+* roofline achieved-rate samples from the kernel cost ledger
+  (``ph:"C"`` counter tracks ``roofline.achieved_gflops_per_s`` /
+  ``roofline.achieved_gbytes_per_s``, one series per kernel)
 
 ``validate_chrome_trace`` is the strict schema parser the smoke gate and
 tests run over the artifact — same posture as
@@ -32,16 +35,20 @@ from paddle_tpu.tracing import memory as _mem
 __all__ = ["chrome_trace_doc", "export_chrome_trace", "validate_chrome_trace"]
 
 # Stable synthetic tids for the non-thread tracks. Host thread tracks are
-# numbered from _FIRST_THREAD_TID up.
+# numbered from _FIRST_THREAD_TID up; the roofline track draws from that
+# range through the same tid allocator (keyed by a sentinel raw tid that
+# no real thread id can collide with).
 _RUNLOG_TID = 0
 _DEVICE_TID = 1
 _FIRST_THREAD_TID = 2
+_ROOFLINE_RAW_TID = -1
 
 
 def chrome_trace_doc(
     runlog_path: Optional[str] = None,
     include_profiler: bool = True,
     include_device: bool = True,
+    include_roofline: bool = True,
 ) -> dict:
     """Build the merged trace document. ``runlog_path`` defaults to the
     installed runlog's file (if any)."""
@@ -110,6 +117,24 @@ def chrome_trace_doc(
                 "args": {dev_label: in_use},
             })
 
+    if include_roofline:
+        from paddle_tpu.observability import roofline as _roofline
+
+        samples = _roofline.history()
+        if samples:
+            tid = chrome_tid(_ROOFLINE_RAW_TID, "roofline")
+            for t_us, kernel, flops_per_s, bytes_per_s in samples:
+                events.append({
+                    "name": "roofline.achieved_gflops_per_s", "ph": "C",
+                    "cat": "roofline", "ts": t_us, "pid": pid, "tid": tid,
+                    "args": {kernel: flops_per_s / 1e9},
+                })
+                events.append({
+                    "name": "roofline.achieved_gbytes_per_s", "ph": "C",
+                    "cat": "roofline", "ts": t_us, "pid": pid, "tid": tid,
+                    "args": {kernel: bytes_per_s / 1e9},
+                })
+
     meta_tracks = dict(thread_names)
     meta_tracks[_RUNLOG_TID] = "runlog"
     meta_tracks[_DEVICE_TID] = "device.hbm"
@@ -131,6 +156,7 @@ def export_chrome_trace(
     runlog_path: Optional[str] = None,
     include_profiler: bool = True,
     include_device: bool = True,
+    include_roofline: bool = True,
 ) -> str:
     """Write the merged trace atomically (tmp + rename, same contract as
     ``profiler.export_chrome_trace``) and return ``path``."""
@@ -138,6 +164,7 @@ def export_chrome_trace(
         runlog_path=runlog_path,
         include_profiler=include_profiler,
         include_device=include_device,
+        include_roofline=include_roofline,
     )
     parent = os.path.dirname(os.path.abspath(path))
     os.makedirs(parent, exist_ok=True)

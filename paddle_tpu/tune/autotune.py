@@ -12,6 +12,16 @@ when ``flags().autotune`` is on). The lookup is process-level memoized:
 the store file is read once, each (key, shape) resolution is computed
 once, and ``tune.cache.{hit,miss,stale}`` counters plus a one-shot
 ``tune`` runlog event record what happened.
+
+The sweep consults the roofline cost ledger
+(:mod:`paddle_tpu.observability.roofline`): shapes classified
+memory-bound run first — block-size choice moves bytes, not FLOPs, so
+memory-bound buckets are where tuning pays and a cut time budget should
+spend its window there. Each winner's measured time is compared against
+the roofline-predicted device time; a >2x disagreement in either
+direction bumps ``tune.cost_model_divergence_total`` (the cost model is
+lying about this kernel), and the measurement is fed back into the
+ledger so later sweeps and ``/roofline`` see tuned reality.
 """
 
 from __future__ import annotations
@@ -166,6 +176,56 @@ def lookup_blocks(t_q: int, t_kv: int, dtype=None, causal: bool = False,
     return result
 
 
+# measured/predicted outside [1/x, x] means the cost model and the chip
+# disagree about this kernel — worth a counter, not worth failing a sweep
+COST_MODEL_DIVERGENCE_RATIO = 2.0
+
+
+def _flash_flops_bytes(B: int, H: int, T: int, d: int,
+                       itemsize: int) -> Tuple[float, float]:
+    """Analytic fwd-attention cost: QK^T + PV are ``2*T*T*d`` MACs each
+    per head; bytes are the q/k/v/o tensor traffic. Coarse on purpose —
+    only the compute-vs-memory *side* matters for sweep ordering."""
+    flops = 4.0 * B * H * T * T * d
+    bytes_ = 4.0 * B * H * T * d * float(itemsize)
+    return flops, bytes_
+
+
+def _sweep_order(
+    shapes: Sequence[Tuple[int, int, int, int]], dtype, dk: str,
+) -> Sequence[Tuple[int, int, int, int]]:
+    """Memory-bound-first sweep order. A shape whose bucket already has a
+    measured flash-attention row in the roofline ledger uses that row's
+    verdict; otherwise the analytic flash cost against the device peaks
+    decides which roofline slope it sits under. Stable within each class,
+    so caller-specified priority survives."""
+    from paddle_tpu.observability import mfu as obs_mfu
+    from paddle_tpu.observability import roofline
+
+    ledger_verdicts: Dict[str, str] = {}
+    try:
+        for row in roofline.snapshot():
+            if row["kernel"] == KERNEL and row["device_kind"] == dk:
+                ledger_verdicts[row["shape_bucket"]] = row["verdict"]
+    except Exception:
+        pass
+    peak_f = obs_mfu.peak_flops_for_kind(dk)
+    peak_b = obs_mfu.peak_hbm_bw_for_kind(dk)
+    itemsize = jnp.dtype(dtype).itemsize
+
+    def memory_bound(shape: Tuple[int, int, int, int]) -> bool:
+        B, H, T, d = shape
+        verdict = ledger_verdicts.get(search.shape_bucket(T, T))
+        if verdict is not None:
+            return verdict == roofline.MEMORY_BOUND
+        if not peak_f or not peak_b:
+            return False
+        flops, bytes_ = _flash_flops_bytes(B, H, T, d, itemsize)
+        return bytes_ / peak_b > flops / peak_f
+
+    return sorted(shapes, key=lambda s: 0 if memory_bound(s) else 1)
+
+
 def autotune_flash_attention(
     shapes: Sequence[Tuple[int, int, int, int]] = ((1, 4, 1024, 128),),
     causal: bool = True,
@@ -200,7 +260,7 @@ def autotune_flash_attention(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     results: Dict[str, dict] = {}
-    for (B, H, T, d) in shapes:
+    for (B, H, T, d) in _sweep_order(shapes, dtype, dk):
         key = TuneKey.render(KERNEL, search.shape_bucket(T, T), dt,
                              search.variant_tag(causal, window), dk)
         rng = np.random.default_rng(0)
@@ -250,6 +310,33 @@ def autotune_flash_attention(
             entry["default_ms"] = default_ms
             entry["speedup_vs_default"] = round(
                 default_ms / max(best["ms"], 1e-9), 4)
+            # measured vs. roofline-predicted for the winner: feed the
+            # ledger, and count when the cost model diverges from the chip
+            try:
+                from paddle_tpu.observability import mfu as obs_mfu
+                from paddle_tpu.observability import roofline
+
+                lowered = make_fn(best["block_q"],
+                                  best["block_k"]).lower(q, k, v)
+                totals = obs_mfu.cost_analysis_totals(lowered)
+                ledger_key = roofline.SEP.join(
+                    (KERNEL, search.shape_bucket(T, T), dt, dk))
+                roofline.note_compile(
+                    ledger_key, flops=totals["flops"],
+                    bytes_accessed=totals["bytes"],
+                    transcendentals=totals["transcendentals"])
+                roofline.observe_call(ledger_key, best["ms"] / 1e3)
+                pred = roofline.predicted_seconds(
+                    totals["flops"], totals["bytes"], kind=dk)
+                if pred and pred > 0:
+                    entry["predicted_ms"] = round(pred * 1e3, 4)
+                    ratio = best["ms"] / (pred * 1e3)
+                    entry["cost_model_ratio"] = round(ratio, 4)
+                    if (ratio > COST_MODEL_DIVERGENCE_RATIO
+                            or ratio < 1.0 / COST_MODEL_DIVERGENCE_RATIO):
+                        prof.inc_counter("tune.cost_model_divergence_total")
+            except Exception:
+                pass  # cost attribution must never fail a sweep
             if not partial:  # a cut sweep's winner is not a tuned default
                 st.put(key, fp,
                        {"block_q": best["block_q"],

@@ -44,7 +44,7 @@ from paddle_tpu.observability import metrics as obs_metrics
 from paddle_tpu.watch import alerts as alerts_mod
 
 __all__ = ["SLO", "SloEngine", "install", "uninstall", "installed_engines",
-           "serving_slos", "disagg_slos"]
+           "serving_slos", "disagg_slos", "decode_token_slos"]
 
 LATENCY = "latency"
 ERROR_RATE = "error_rate"
@@ -377,6 +377,39 @@ def serving_slos(
             "serving.errors_total", error_rate_objective,
             total_metric="serving.responses_total",
             window_s=window_s, labels=labels, severity=severity),
+    ]
+
+
+def decode_token_slos(
+    engine_label: str,
+    ttft_p99_objective_s: float = 1.0,
+    tpot_p99_objective_s: float = 0.1,
+    window_s: float = 60.0,
+    cls: str = "default",
+    severity: str = alerts_mod.WARNING,
+) -> List[SLO]:
+    """The default token-latency objectives for one decode engine: p99
+    TTFT (submit → first token, queue wait included) and p99 TPOT
+    (per-generated-token latency after the first; speculation-aware — a
+    verify step accepting N tokens booked N samples, so the objective
+    means the same thing spec-on and spec-off). Burn-rate alerting rides
+    the standard multi-window rule. The labels must match what
+    ``DecodeMetrics`` stamps on the histograms: the ``engine`` tag plus
+    the priority class (``"default"`` unless requests set one)::
+
+        DecodeConfig(watch=WatchConfig(
+            enabled=True, slos=decode_token_slos("decode0")))
+    """
+    labels = {"engine": engine_label, "cls": cls}
+    return [
+        SLO(f"decode_{engine_label}_{cls}_ttft_p99", LATENCY,
+            "serving.decode.ttft_seconds", ttft_p99_objective_s,
+            window_s=window_s, quantile=0.99, labels=labels,
+            severity=severity),
+        SLO(f"decode_{engine_label}_{cls}_tpot_p99", LATENCY,
+            "serving.decode.tpot_seconds", tpot_p99_objective_s,
+            window_s=window_s, quantile=0.99, labels=labels,
+            severity=severity),
     ]
 
 

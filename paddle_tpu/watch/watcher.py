@@ -131,6 +131,17 @@ def default_rules() -> List[WatchRule]:
                   det_mod.EwmaDetector(alpha=0.2, z_threshold=6.0,
                                        min_samples=16),
                   invert=True),
+        # per-token latency (TPOT), inverted: the HIGH side is covered by
+        # the slo.decode_token_slos burn-rate objectives, so the standing
+        # watch guards the too-good-to-be-true side — an anomalous TPOT
+        # collapse means tokens are landing implausibly fast (degenerate
+        # speculation acceptance, a truncated decode loop booking
+        # near-zero iteration gaps), i.e. the engine is probably not
+        # doing the work the numbers claim
+        WatchRule("serving.decode.tpot_seconds",
+                  det_mod.EwmaDetector(alpha=0.2, z_threshold=8.0,
+                                       min_samples=32),
+                  invert=True),
         # disaggregated serving (serving.disagg): per-engine backlog and
         # live load. A sustained spike on a prefill-role worker is the
         # queue-depth anomaly signal the Autoscaler's scale_prefill rule

@@ -1,0 +1,175 @@
+"""paddle_tpu.tracing.waterfall — per-request token-latency accounting.
+
+The speculation contract under test: an engine iteration that lands ``n``
+tokens ``dt`` after the previous one books ``n`` TPOT samples of ``dt/n``
+each, so spec-on and spec-off runs over the same prompts produce the same
+*per-token* sample counts — one TTFT plus ``tokens - 1`` TPOT samples —
+even though the spec-on engine takes far fewer iterations. Unit tests pin
+the booking math directly; the integration half runs a real
+:class:`~paddle_tpu.serving.DecodeEngine` with and without a draft model
+and compares the resulting waterfall docs.
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import models
+from paddle_tpu.serving import DecodeConfig, DecodeEngine
+from paddle_tpu.tracing import waterfall
+
+VOCAB = 97
+
+
+@pytest.fixture(autouse=True)
+def _clean_store():
+    waterfall.reset()
+    yield
+    waterfall.reset()
+
+
+# ---- booking math ---------------------------------------------------------
+
+
+def test_first_token_books_ttft_not_tpot():
+    waterfall.start("r1", 10.0)
+    ttft, samples = waterfall.on_tokens("r1", 10.25, 1, phase="prefill")
+    assert ttft == pytest.approx(0.25)
+    assert samples == []
+    d = waterfall.doc("r1")
+    assert d["ttft_s"] == pytest.approx(0.25)
+    assert d["tpot_s"] == []
+    assert d["tokens"] == 1
+
+
+def test_multi_token_iteration_splits_dt_evenly():
+    """A verify step accepting 4 tokens 0.2s after the previous landing
+    books 4 samples of 0.05s — the speculation contract."""
+    waterfall.start("r1", 0.0)
+    waterfall.on_tokens("r1", 1.0, 1)
+    ttft, samples = waterfall.on_tokens("r1", 1.2, 4, phase="verify")
+    assert ttft is None
+    assert samples == pytest.approx([0.05] * 4)
+    d = waterfall.doc("r1")
+    assert d["tokens"] == 5
+    assert len(d["tpot_s"]) == d["tokens"] - 1
+
+
+def test_first_iteration_landing_many_tokens():
+    """When the very first iteration lands n tokens, one is the TTFT
+    token and the remaining n-1 book zero-dt TPOT samples (they landed
+    in the same instant as the first)."""
+    waterfall.start("r1", 0.0)
+    ttft, samples = waterfall.on_tokens("r1", 0.5, 3)
+    assert ttft == pytest.approx(0.5)
+    assert samples == pytest.approx([0.0, 0.0])
+    d = waterfall.doc("r1")
+    assert d["tokens"] == 3 and len(d["tpot_s"]) == 2
+
+
+def test_finish_is_terminal_and_refuses_late_bookings():
+    waterfall.start("r1", 0.0)
+    waterfall.on_tokens("r1", 0.1, 1)
+    waterfall.finish("r1", 0.2, "eos")
+    ttft, samples = waterfall.on_tokens("r1", 0.3, 2)
+    assert ttft is None and samples == []
+    d = waterfall.doc("r1")
+    assert d["finished"] and d["reason"] == "eos"
+    assert d["tokens"] == 1
+    assert d["events"][-1]["phase"] == "finish"
+    # double-finish is a no-op (first reason wins)
+    waterfall.finish("r1", 0.4, "cancel")
+    assert waterfall.doc("r1")["reason"] == "eos"
+
+
+def test_unknown_rid_is_ignored():
+    assert waterfall.on_tokens("nope", 1.0, 1) == (None, [])
+    waterfall.finish("nope", 1.0, "eos")  # must not raise
+    assert waterfall.doc("nope") is None
+
+
+def test_stats_and_jitter():
+    waterfall.start("r1", 0.0)
+    waterfall.on_tokens("r1", 0.1, 1)
+    for i, dt in enumerate((0.01, 0.03, 0.01, 0.03)):
+        t_prev = waterfall.doc("r1")["t_last_token_pc"]
+        waterfall.on_tokens("r1", t_prev + dt, 1)
+    st = waterfall.doc("r1")["tpot"]
+    assert st["count"] == 4
+    assert st["mean_s"] == pytest.approx(0.02)
+    assert st["jitter_s"] == pytest.approx(0.01)  # population stdev
+
+
+def test_store_is_bounded_and_evicts_oldest():
+    for i in range(waterfall.MAX_DOCS + 8):
+        waterfall.start(f"r{i}", float(i))
+    known = waterfall.rids()
+    assert len(known) == waterfall.MAX_DOCS
+    assert waterfall.doc("r0") is None
+    assert waterfall.doc(f"r{waterfall.MAX_DOCS + 7}") is not None
+
+
+def test_restart_replaces_doc():
+    waterfall.start("r1", 0.0)
+    waterfall.on_tokens("r1", 0.1, 1)
+    waterfall.start("r1", 5.0)
+    d = waterfall.doc("r1")
+    assert d["tokens"] == 0 and d["t_submit_pc"] == 5.0
+
+
+# ---- spec-on vs spec-off end to end ---------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lm():
+    spec = models.get_model("transformer_lm", seq_len=64, vocab=VOCAB,
+                            d_model=32, d_inner=64, num_heads=4, n_layers=2)
+    cfg = spec.extra["cfg"]
+    rng = np.random.RandomState(7)
+    variables = spec.model.init(0, *spec.synth_batch(2, rng))
+    prompts = [rng.randint(1, VOCAB, size=(tp,)).astype(np.int32)
+               for tp in (5, 9)]
+    return variables, cfg, prompts
+
+
+def _run(lm, spec_tokens):
+    variables, cfg, prompts = lm
+    kw = {}
+    if spec_tokens:
+        kw = dict(draft_variables=variables, draft_cfg=cfg)
+    engine = DecodeEngine(variables, cfg, decode=DecodeConfig(
+        max_slots=3, page_size=4, max_context=48, prefill_chunk=8,
+        num_pages=24, spec_tokens=spec_tokens), **kw)
+    try:
+        docs = []
+        for p in prompts:
+            out = engine.infer(p, 10)
+            rid = waterfall.rids(finished_only=True)[-1]
+            d = waterfall.doc(rid)
+            docs.append((out, d))
+        return docs
+    finally:
+        engine.close()
+
+
+def test_spec_on_and_off_book_one_sample_per_token(lm):
+    """Sample counts follow generated tokens, not engine iterations: a
+    spec-on run (verify steps landing several tokens at once) and a
+    spec-off run over the same prompts both produce TTFT + exactly
+    ``tokens - 1`` TPOT samples per request."""
+    plain = _run(lm, spec_tokens=0)
+    waterfall.reset()
+    spec = _run(lm, spec_tokens=4)
+    for (out, d), (sout, sd) in zip(plain, spec):
+        for o, doc_ in ((out, d), (sout, sd)):
+            assert doc_["finished"] and doc_["reason"] in ("eos", "length")
+            assert doc_["ttft_s"] is not None and doc_["ttft_s"] >= 0.0
+            assert doc_["tokens"] == len(o.tokens)
+            assert len(doc_["tpot_s"]) == len(o.tokens) - 1
+        # identical greedy models → identical token counts → identical
+        # per-token sample counts despite different iteration counts
+        assert sd["tokens"] == d["tokens"]
+        assert len(sd["tpot_s"]) == len(d["tpot_s"])
+        # spec run used fewer token-landing iterations than tokens
+        landings = [e for e in sd["events"] if e["n"] > 0]
+        assert len(landings) < sd["tokens"]
+        assert any(e["n"] > 1 for e in landings)

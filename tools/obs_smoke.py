@@ -29,7 +29,14 @@ exporter enabled, then:
   spanning ≥2 engines, zero orphaned spans
   (``validate_trace(multi_engine=True)`` returns no problems), with
   ``/fleet`` serving the merged ``serving.fleet.*`` rollup and a chaos
-  ``kill()`` leaving a complete flight-recorder bundle on disk.
+  ``kill()`` leaving a complete flight-recorder bundle on disk;
+- runs a speculative decode burst and checks the roofline +
+  token-latency contracts: every ``/roofline`` ledger entry carries a
+  compute/memory/overhead-bound verdict with finite arithmetic
+  intensity, a finished request's ``/waterfall/<rid>`` timeline is
+  monotone (TTFT then one TPOT sample per generated token, verify steps
+  notwithstanding), and the Chrome trace re-exports with the
+  ``roofline.achieved_g{flops,bytes}_per_s`` counter tracks.
 
 Exit code 0 = the scrape parsed and every contract held; 1 = anything
 missing or malformed. CI-registered next to ``tools/chaos_smoke.py``
@@ -470,6 +477,121 @@ def _fleet_phase(work: str, seed: int) -> None:
         fleet.close(timeout=30)
 
 
+def _roofline_phase(work: str, seed: int) -> None:
+    """Roofline + waterfall contracts on a live speculative decode run:
+    every ``/roofline`` ledger entry classified with finite intensity, a
+    finished request's ``/waterfall/<rid>`` timeline monotone with one
+    TPOT sample per generated token after the first (speculation-aware),
+    and the Chrome trace re-exporting with the roofline counter tracks."""
+    import urllib.error
+
+    import paddle_tpu as pt
+    from paddle_tpu import models, tracing
+    from paddle_tpu.serving import DecodeConfig, DecodeEngine
+    from paddle_tpu.tracing import waterfall
+
+    srv = pt.observability.server()
+    check(srv is not None, "exporter not running for the roofline phase")
+
+    vocab = 97
+    spec = models.get_model("transformer_lm", seq_len=64, vocab=vocab,
+                            d_model=32, d_inner=64, num_heads=4, n_layers=2)
+    cfg = spec.extra["cfg"]
+    rng = np.random.RandomState(seed)
+    variables = spec.model.init(0, *spec.synth_batch(2, rng))
+    eng = DecodeEngine(variables, cfg, decode=DecodeConfig(
+        max_slots=3, page_size=4, max_context=48, prefill_chunk=8,
+        num_pages=24, spec_tokens=4), draft_variables=variables,
+        draft_cfg=cfg)
+    label = eng.metrics.engine_label
+    n_new = 10
+    try:
+        prompt = rng.randint(1, vocab, size=(6,)).astype(np.int32)
+        out = eng.infer(prompt, n_new)
+        check(len(out.tokens) > 1,
+              f"speculative decode generated {len(out.tokens)} tokens")
+    finally:
+        eng.close()
+
+    # -- /roofline: every ledger entry classified, intensity finite -------
+    roof = json.loads(urllib.request.urlopen(
+        srv.url + "/roofline", timeout=30).read().decode("utf-8"))
+    check(roof.get("enabled") is True, "/roofline reports ledger disabled")
+    entries = roof.get("entries", [])
+    check(bool(entries), "/roofline has no ledger entries after decode")
+    kernels = {e["kernel"] for e in entries}
+    for want in ("serving.decode.prefill", "serving.decode.verify"):
+        check(want in kernels, f"/roofline missing {want!r} (have {kernels})")
+    for e in entries:
+        check(e.get("verdict") in ("compute_bound", "memory_bound",
+                                   "overhead_bound"),
+              f"/roofline entry {e.get('key')} unclassified: "
+              f"{e.get('verdict')!r}")
+        intensity = e.get("arithmetic_intensity")
+        check(isinstance(intensity, (int, float)) and np.isfinite(intensity),
+              f"/roofline entry {e.get('key')} intensity not finite: "
+              f"{intensity!r}")
+        check(len(e["key"].split("|")) == 4,
+              f"/roofline key not kernel|bucket|dtype|kind: {e['key']!r}")
+    summary = roof.get("summary", {})
+    check(summary.get("entries") == len(entries),
+          f"/roofline summary entries {summary.get('entries')} != "
+          f"{len(entries)}")
+
+    # -- /waterfall/<rid>: monotone TTFT → TPOT, one sample per token ----
+    rid = next((r for r in reversed(waterfall.rids(finished_only=True))
+                if (waterfall.doc(r) or {}).get("engine") == label), None)
+    check(rid is not None, "no finished waterfall doc for the decode engine")
+    wf = json.loads(urllib.request.urlopen(
+        srv.url + "/waterfall/" + rid, timeout=30).read().decode("utf-8"))
+    check(wf["finished"] and wf["reason"] in ("eos", "length"),
+          f"waterfall {rid} not cleanly finished: {wf['reason']!r}")
+    check(wf["ttft_s"] is not None and wf["ttft_s"] >= 0,
+          f"waterfall {rid} has no TTFT")
+    check(wf["tokens"] == len(out.tokens),
+          f"waterfall tokens {wf['tokens']} != generated {len(out.tokens)}")
+    check(len(wf["tpot_s"]) == len(out.tokens) - 1,
+          f"TPOT samples {len(wf['tpot_s'])} != generated tokens - 1 "
+          f"({len(out.tokens) - 1}) — speculation must book per-token, "
+          f"not per-verify-step")
+    check(wf["t_submit_pc"] <= wf["t_first_token_pc"]
+          <= wf["t_last_token_pc"],
+          f"waterfall {rid} timeline not monotone: submit/first/last = "
+          f"{wf['t_submit_pc']}/{wf['t_first_token_pc']}/"
+          f"{wf['t_last_token_pc']}")
+    ts = [e["t_pc"] for e in wf["events"]]
+    check(ts == sorted(ts), f"waterfall {rid} events not monotone")
+    phases = [e["phase"] for e in wf["events"]]
+    check(phases[0] == "prefill" and phases[-1] == "finish",
+          f"waterfall {rid} phases not prefill→…→finish: {phases}")
+    check(wf["tpot"]["count"] == len(wf["tpot_s"]),
+          "waterfall tpot stats disagree with the sample list")
+    # unknown rid → 404, not an empty doc
+    try:
+        urllib.request.urlopen(srv.url + "/waterfall/no-such-rid-0",
+                               timeout=10)
+        check(False, "/waterfall/<unknown> did not 404")
+    except urllib.error.HTTPError as e:
+        check(e.code == 404, f"/waterfall/<unknown> returned {e.code}")
+
+    # -- Chrome trace re-export carries the roofline counter tracks ------
+    path = os.path.join(work, "trace_roofline.json")
+    tracing.export_chrome_trace(path)
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    counts = tracing.validate_chrome_trace(doc)
+    names = {ev["name"] for ev in doc["traceEvents"] if ev.get("ph") == "C"}
+    for want in ("roofline.achieved_gflops_per_s",
+                 "roofline.achieved_gbytes_per_s"):
+        check(want in names,
+              f"Chrome trace missing counter track {want!r} (have {names})")
+    print(f"[obs] roofline: {len(entries)} ledger entries classified "
+          f"({summary.get('verdicts')}), waterfall {rid[:16]}… "
+          f"ttft={wf['ttft_s']*1e3:.1f}ms + {len(wf['tpot_s'])} tpot "
+          f"samples, trace counter tracks valid ({counts.get('C', 0)} C "
+          f"events)")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=0)
@@ -488,6 +610,7 @@ def main(argv=None) -> int:
         _runlog_phase(work)
         _trace_phase(work, serving_traces)
         _fleet_phase(work, args.seed)
+        _roofline_phase(work, args.seed)
     except ObsFailure as e:
         print(f"[obs] FAIL: {e}", file=sys.stderr)
         return 1
@@ -498,7 +621,8 @@ def main(argv=None) -> int:
         if not args.keep and args.dir is None:
             shutil.rmtree(work, ignore_errors=True)
     print("[obs] OK: exposition valid, families populated, runlog complete, "
-          "traces reconstruct, fleet rollup + flight recorder verified")
+          "traces reconstruct, fleet rollup + flight recorder verified, "
+          "roofline + waterfall contracts hold")
     return 0
 
 
